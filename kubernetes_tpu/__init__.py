@@ -1,0 +1,24 @@
+"""kubernetes_tpu — a TPU-native cluster control plane.
+
+A from-scratch re-design of the capabilities of Kubernetes (reference:
+wt351/kubernetes) built TPU-first: the scheduler's Filter/Score/assignment hot
+loop runs as batched JAX/XLA kernels over dense pods x nodes tensors (sharded
+across a device mesh with shard_map), while the control plane around it — typed
+API objects, a versioned watchable store, informers, controllers, a node agent,
+and a CLI — is pure Python designed to feed those kernels incrementally.
+
+Layer map (mirrors SURVEY.md section 1):
+  api/         typed object model + validation/defaulting/serde   (ref: pkg/apis, staging/src/k8s.io/api)
+  runtime/     scheme & codec machinery                           (ref: staging/src/k8s.io/apimachinery)
+  state/       versioned store, watch, informers, workqueue       (ref: etcd3/store.go, client-go/tools/cache)
+  apiserver/   REST + watch HTTP surface, admission, registry     (ref: staging/src/k8s.io/apiserver)
+  scheduler/   batched TPU scheduler: queue, cache, kernels       (ref: pkg/scheduler)
+  controllers/ async reconcilers                                  (ref: pkg/controller)
+  nodeagent/   kubelet-equivalent node agent (hollow-capable)     (ref: pkg/kubelet, pkg/kubemark)
+  cli/         kubectl-subset command line                        (ref: pkg/kubectl)
+  ops/         pallas/XLA kernels for the hot ops
+  parallel/    device mesh + sharding helpers
+  utils/, metrics/, events/, config/  cross-cutting support
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Typed API object model (ref: pkg/apis + staging/src/k8s.io/api)."""
+
+from . import helpers, labels, serde, validation, wellknown
+from .apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
+from .batch import CronJob, Job
+from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
+                   Endpoints, Event, Namespace, Node, NodeAffinity,
+                   NodeCondition, NodeSelector, NodeSelectorRequirement,
+                   NodeSelectorTerm, NodeSpec, NodeStatus, ObjectReference,
+                   PersistentVolume, PersistentVolumeClaim, Pod, PodAffinity,
+                   PodAffinityTerm, PodAntiAffinity, PodCondition, PodSpec,
+                   PodStatus, PodTemplateSpec, PreferredSchedulingTerm,
+                   ReplicationController, ResourceRequirements, Service,
+                   ServicePort, ServiceSpec, Taint, Toleration, Volume,
+                   WeightedPodAffinityTerm)
+from .defaults import default
+from .meta import (LabelSelector, LabelSelectorRequirement, ObjectMeta,
+                   OwnerReference, controller_ref, new_controller_ref)
+from .policy import Lease, PodDisruptionBudget, PriorityClass, StorageClass
+from .quantity import Quantity
+from .serde import decode, deepcopy_obj, encode, from_json_str, to_json_str
+from .validation import ValidationError, validate
+
+__all__ = [n for n in dir() if not n.startswith("_")]

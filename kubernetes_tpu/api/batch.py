@@ -1,0 +1,75 @@
+"""batch/v1 types. Ref: staging/src/k8s.io/api/batch/v1/types.go."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .core import PodTemplateSpec
+from .meta import LabelSelector, ObjectMeta
+
+
+@dataclass
+class JobSpec:
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: int = 6
+    selector: Optional[LabelSelector] = None
+    manual_selector: Optional[bool] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    ttl_seconds_after_finished: Optional[int] = None
+
+
+@dataclass
+class JobCondition:
+    type: str = ""  # Complete | Failed
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[str] = None
+
+
+@dataclass
+class JobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class Job:
+    api_version: str = "batch/v1"
+    kind: str = "Job"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = ""
+    starting_deadline_seconds: Optional[int] = None
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    suspend: Optional[bool] = None
+    job_template: Optional[dict] = None
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+
+
+@dataclass
+class CronJobStatus:
+    active: List[dict] = field(default_factory=list)
+    last_schedule_time: Optional[str] = None
+
+
+@dataclass
+class CronJob:
+    api_version: str = "batch/v1beta1"
+    kind: str = "CronJob"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)

@@ -1,0 +1,63 @@
+"""Defaulting. Ref: pkg/apis/core/v1/defaults.go (SetDefaults_*)."""
+
+from __future__ import annotations
+
+from .apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
+from .core import Pod, PodSpec
+from .meta import LabelSelector
+from .quantity import Quantity
+
+
+def default_pod(pod: Pod) -> Pod:
+    spec = pod.spec
+    if not spec.restart_policy:
+        spec.restart_policy = "Always"
+    if spec.termination_grace_period_seconds is None:
+        spec.termination_grace_period_seconds = 30
+    if not spec.scheduler_name:
+        spec.scheduler_name = "default-scheduler"
+    for c in spec.containers + spec.init_containers:
+        for p in c.ports:
+            if not p.protocol:
+                p.protocol = "TCP"
+        # requests default from limits (ref: SetDefaults_ResourceList semantics
+        # in defaults.go: limits set + requests unset -> requests = limits)
+        for name, q in c.resources.limits.items():
+            if name not in c.resources.requests:
+                c.resources.requests[name] = Quantity(q)
+    if not pod.metadata.namespace:
+        pod.metadata.namespace = "default"
+    return pod
+
+
+def _default_workload(obj, kind_labels_from_template: bool = True):
+    if not obj.metadata.namespace:
+        obj.metadata.namespace = "default"
+    spec = obj.spec
+    if getattr(spec, "replicas", None) is None:
+        spec.replicas = 1
+    # apps/v1 requires an explicit selector; default it from template labels
+    # only for convenience in tests (v1beta legacy behavior)
+    if getattr(spec, "selector", None) is None and kind_labels_from_template:
+        tmpl = getattr(spec, "template", None)
+        if tmpl is not None and tmpl.metadata.labels:
+            spec.selector = LabelSelector(match_labels=dict(tmpl.metadata.labels))
+    tmpl = getattr(spec, "template", None)
+    if tmpl is not None:
+        shell = Pod(metadata=tmpl.metadata, spec=tmpl.spec)
+        default_pod(shell)
+        shell.metadata.namespace = ""
+    return obj
+
+
+def default(obj):
+    if isinstance(obj, Pod):
+        return default_pod(obj)
+    if isinstance(obj, (Deployment, ReplicaSet, StatefulSet, DaemonSet)):
+        return _default_workload(obj)
+    meta = getattr(obj, "metadata", None)
+    if meta is not None and not meta.namespace and getattr(obj, "kind", "") in (
+            "Service", "Endpoints", "PersistentVolumeClaim", "Job", "CronJob",
+            "PodDisruptionBudget", "Event", "ConfigMap", "Lease", "ReplicationController"):
+        meta.namespace = "default"
+    return obj

@@ -1,0 +1,134 @@
+"""Dataclass <-> JSON codec with Kubernetes-manifest field naming.
+
+Replaces the reference's generated deepcopy/conversion/json machinery
+(staging/src/k8s.io/apimachinery/pkg/runtime) with one reflective codec:
+python dataclasses use snake_case; the wire format is the reference's
+camelCase JSON, so real Kubernetes manifests round-trip.
+
+Conventions:
+  - field `api_version` <-> "apiVersion", `tls_config` <-> "tlsConfig", etc.
+  - a field may override its wire name via metadata={"json": "name"}
+  - Optional/None fields are omitted on encode (k8s `omitempty` semantics)
+  - types with to_json()/from_json(cls, data) hooks (e.g. Quantity) use them
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import typing
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+_ACRONYMS = {"ip": "IP", "cidr": "CIDR", "tls": "TLS", "uid": "UID", "url": "URL",
+             "api": "API", "pvc": "PVC", "qos": "QOS", "id": "ID"}
+
+
+def snake_to_camel(name: str) -> str:
+    parts = name.split("_")
+    out = [parts[0]]
+    for p in parts[1:]:
+        out.append(_ACRONYMS.get(p, p.capitalize()))
+    # leading-acronym fields like `ip_family` -> ipFamily (first part stays lower)
+    return "".join(out)
+
+
+def _wire_name(f: dataclasses.Field) -> str:
+    if "json" in f.metadata:
+        return f.metadata["json"]
+    return snake_to_camel(f.name)
+
+
+def _is_optional(tp) -> bool:
+    return get_origin(tp) is typing.Union and type(None) in get_args(tp)
+
+
+def _strip_optional(tp):
+    if _is_optional(tp):
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return args[0] if len(args) == 1 else typing.Union[tuple(args)]
+    return tp
+
+
+def encode(obj: Any) -> Any:
+    """Encode a dataclass (or container of them) to plain JSON-able data."""
+    if obj is None:
+        return None
+    if hasattr(obj, "to_json") and not isinstance(obj, type):
+        return obj.to_json()
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            if v == [] or v == {}:
+                # omitempty — but only when empty IS the field's default
+                # (default_factory). For Optional fields (default None) an
+                # empty dict is meaningful: `emptyDir: {}` marks the volume
+                # source type and must survive round-trips.
+                if (f.default_factory is not dataclasses.MISSING
+                        and not f.metadata.get("keep_empty")):
+                    continue
+            out[_wire_name(f)] = encode(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)):
+        return obj
+    raise TypeError(f"cannot encode {type(obj)!r}")
+
+
+def decode(cls: Type[T], data: Any) -> T:
+    """Decode JSON-able data into an instance of dataclass `cls`."""
+    return _decode_value(cls, data)
+
+
+def _decode_value(tp, data):
+    if data is None:
+        return None
+    tp = _strip_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return [_decode_value(elem, v) for v in data]
+    if origin is dict:
+        args = get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _decode_value(vt, v) for k, v in data.items()}
+    if tp is Any:
+        return data
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if hasattr(tp, "from_json"):
+        return tp.from_json(data)
+    if dataclasses.is_dataclass(tp):
+        hints = typing.get_type_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            wire = _wire_name(f)
+            if wire in data:
+                kwargs[f.name] = _decode_value(hints[f.name], data[wire])
+        return tp(**kwargs)
+    if tp is float and isinstance(data, int):
+        return float(data)
+    return data
+
+
+def to_json_str(obj: Any, **kw) -> str:
+    return json.dumps(encode(obj), **kw)
+
+
+def from_json_str(cls: Type[T], s: str) -> T:
+    return decode(cls, json.loads(s))
+
+
+def deepcopy_obj(obj: T) -> T:
+    """Semantic deep copy via the codec (mirrors generated DeepCopy)."""
+    return decode(type(obj), encode(obj))

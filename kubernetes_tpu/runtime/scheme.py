@@ -1,0 +1,102 @@
+"""Scheme — kind registry mapping (apiVersion, kind) <-> python type and
+resource (plural) names, with decode dispatch on TypeMeta.
+
+Ref: staging/src/k8s.io/apimachinery/pkg/runtime/scheme.go, reduced: there is
+one internal representation (the dataclasses) and one wire version per group,
+so conversion collapses to serde.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+from ..api import serde
+from ..api.apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
+from ..api.batch import CronJob, Job
+from ..api.core import (Binding, Endpoints, Event, Namespace, Node,
+                        PersistentVolume, PersistentVolumeClaim, Pod,
+                        ReplicationController, Service)
+from ..api.policy import Lease, PodDisruptionBudget, PriorityClass, StorageClass
+
+
+class Scheme:
+    def __init__(self):
+        self._by_gvk: Dict[Tuple[str, str], Type] = {}
+        self._by_type: Dict[Type, Tuple[str, str]] = {}
+        self._resource_by_type: Dict[Type, str] = {}
+        self._type_by_resource: Dict[str, Type] = {}
+        self._namespaced: Dict[Type, bool] = {}
+
+    def register(self, cls: Type, api_version: str, kind: str, resource: str,
+                 namespaced: bool = True) -> None:
+        self._by_gvk[(api_version, kind)] = cls
+        self._by_type[cls] = (api_version, kind)
+        self._resource_by_type[cls] = resource
+        self._type_by_resource[resource] = cls
+        self._namespaced[cls] = namespaced
+
+    def type_for(self, api_version: str, kind: str) -> Optional[Type]:
+        return self._by_gvk.get((api_version, kind)) or \
+            next((cls for (v, k), cls in self._by_gvk.items() if k == kind), None)
+
+    def type_for_resource(self, resource: str) -> Optional[Type]:
+        return self._type_by_resource.get(resource)
+
+    def resource_for(self, cls_or_obj) -> str:
+        cls = cls_or_obj if isinstance(cls_or_obj, type) else type(cls_or_obj)
+        return self._resource_by_type[cls]
+
+    def gvk_for(self, cls_or_obj) -> Tuple[str, str]:
+        cls = cls_or_obj if isinstance(cls_or_obj, type) else type(cls_or_obj)
+        return self._by_type[cls]
+
+    def is_namespaced(self, cls_or_obj) -> bool:
+        cls = cls_or_obj if isinstance(cls_or_obj, type) else type(cls_or_obj)
+        return self._namespaced[cls]
+
+    def resources(self):
+        return list(self._type_by_resource)
+
+    def decode_any(self, data: Dict[str, Any]):
+        """Decode arbitrary manifest data by its TypeMeta."""
+        kind = data.get("kind", "")
+        api_version = data.get("apiVersion", "")
+        cls = self.type_for(api_version, kind)
+        if cls is None:
+            raise KeyError(f"no kind registered for {api_version}/{kind}")
+        return serde.decode(cls, data)
+
+
+def default_scheme() -> Scheme:
+    s = Scheme()
+    s.register(Pod, "v1", "Pod", "pods")
+    s.register(Node, "v1", "Node", "nodes", namespaced=False)
+    s.register(Service, "v1", "Service", "services")
+    s.register(Endpoints, "v1", "Endpoints", "endpoints")
+    s.register(Namespace, "v1", "Namespace", "namespaces", namespaced=False)
+    s.register(Event, "v1", "Event", "events")
+    s.register(Binding, "v1", "Binding", "bindings")
+    s.register(PersistentVolume, "v1", "PersistentVolume",
+               "persistentvolumes", namespaced=False)
+    s.register(PersistentVolumeClaim, "v1", "PersistentVolumeClaim",
+               "persistentvolumeclaims")
+    s.register(ReplicationController, "v1", "ReplicationController",
+               "replicationcontrollers")
+    s.register(Deployment, "apps/v1", "Deployment", "deployments")
+    s.register(ReplicaSet, "apps/v1", "ReplicaSet", "replicasets")
+    s.register(StatefulSet, "apps/v1", "StatefulSet", "statefulsets")
+    s.register(DaemonSet, "apps/v1", "DaemonSet", "daemonsets")
+    s.register(Job, "batch/v1", "Job", "jobs")
+    s.register(CronJob, "batch/v1beta1", "CronJob", "cronjobs")
+    s.register(PodDisruptionBudget, "policy/v1beta1", "PodDisruptionBudget",
+               "poddisruptionbudgets")
+    s.register(PriorityClass, "scheduling.k8s.io/v1", "PriorityClass",
+               "priorityclasses", namespaced=False)
+    s.register(StorageClass, "storage.k8s.io/v1", "StorageClass",
+               "storageclasses", namespaced=False)
+    s.register(Lease, "coordination.k8s.io/v1", "Lease", "leases")
+    return s
+
+
+#: process-wide scheme, mirroring the reference's legacyscheme.Scheme
+SCHEME = default_scheme()

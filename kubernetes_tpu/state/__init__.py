@@ -1,0 +1,15 @@
+"""State plane: versioned store, watch, typed client, informers, workqueues.
+
+Ref layers L0/L1/L4 of SURVEY.md — etcd3 store + watch cache + client-go.
+"""
+
+from .client import Client, PodClient, ResourceClient
+from .informer import (EventHandlers, Indexer, SharedInformer,
+                       SharedInformerFactory)
+from .store import (ADDED, BOOKMARK, DELETED, MODIFIED, AlreadyExistsError,
+                    ConflictError, ExpiredError, NotFoundError, Store, Watch,
+                    WatchEvent)
+from .workqueue import (DelayingQueue, RateLimiter, RateLimitingQueue,
+                        WorkQueue)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

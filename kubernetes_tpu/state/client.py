@@ -1,0 +1,214 @@
+"""Typed client over the Store — the clientset analog.
+
+Ref: staging/src/k8s.io/client-go generated clientsets. One generic
+ResourceClient per registered kind (vs 34,948 generated LoC in the reference);
+pods get the bind/status subresources the scheduler and node agent use.
+
+The same interface is implemented by apiserver/httpclient.py over REST, so
+components are wireable either in-process (tests, single box) or over HTTP.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Type
+
+from ..api import core as corev1
+from ..api import labels as labelsmod
+from ..api import serde
+from ..api.defaults import default as apply_defaults
+from ..api.meta import LabelSelector
+from ..api.validation import validate as validate_obj
+from ..runtime.scheme import SCHEME, Scheme
+from .store import Store, Watch
+
+
+class ResourceClient:
+    def __init__(self, store: Store, scheme: Scheme, cls: Type,
+                 namespace: Optional[str] = None, *, validate: bool = True):
+        self._store = store
+        self._scheme = scheme
+        self._cls = cls
+        self._resource = scheme.resource_for(cls)
+        self._namespaced = scheme.is_namespaced(cls)
+        self._ns = namespace if self._namespaced else ""
+        self._validate = validate
+
+    def _effective_ns(self, obj=None) -> str:
+        if not self._namespaced:
+            return ""
+        if obj is not None and obj.metadata.namespace:
+            return obj.metadata.namespace
+        return self._ns or "default"
+
+    def create(self, obj):
+        obj = serde.deepcopy_obj(obj)
+        if self._namespaced and not obj.metadata.namespace:
+            obj.metadata.namespace = self._effective_ns()
+        apply_defaults(obj)
+        if self._validate:
+            validate_obj(obj)
+        return self._store.create(self._resource, obj)
+
+    def get(self, name: str, namespace: Optional[str] = None):
+        ns = namespace if namespace is not None else self._effective_ns()
+        return self._store.get(self._resource, ns if self._namespaced else "", name)
+
+    def list(self, namespace: Optional[str] = None,
+             label_selector: Optional[LabelSelector] = None) -> List[Any]:
+        ns = namespace if namespace is not None else (self._ns or None)
+        pred: Optional[Callable[[Any], bool]] = None
+        if label_selector is not None:
+            pred = lambda o: labelsmod.matches(label_selector, o.metadata.labels)
+        items, _ = self._store.list(self._resource,
+                                    ns if self._namespaced else None, pred)
+        return items
+
+    def update(self, obj):
+        if self._validate:
+            validate_obj(obj)
+        return self._store.update(self._resource, serde.deepcopy_obj(obj))
+
+    def update_status(self, obj):
+        """Status subresource: only .status is applied onto the live object
+        (ref: registry strategies split spec/status update paths)."""
+        def mutate(cur):
+            cur.status = serde.deepcopy_obj(obj.status)
+            return cur
+        return self._store.guaranteed_update(
+            self._resource, self._effective_ns(obj) if self._namespaced else "",
+            obj.metadata.name, mutate)
+
+    def patch(self, name: str, mutate: Callable[[Any], Any],
+              namespace: Optional[str] = None):
+        """Read-modify-write with CAS retry (strategic-merge-patch stand-in)."""
+        ns = namespace if namespace is not None else self._effective_ns()
+        return self._store.guaranteed_update(
+            self._resource, ns if self._namespaced else "", name, mutate)
+
+    def delete(self, name: str, namespace: Optional[str] = None,
+               resource_version: Optional[str] = None):
+        ns = namespace if namespace is not None else self._effective_ns()
+        return self._store.delete(self._resource, ns if self._namespaced else "",
+                                  name, resource_version=resource_version)
+
+    def watch(self, namespace: Optional[str] = None,
+              resource_version: Optional[int] = None) -> Watch:
+        ns = namespace if namespace is not None else (self._ns or None)
+        return self._store.watch(self._resource,
+                                 ns if self._namespaced else None,
+                                 resource_version)
+
+    def list_rv(self, namespace: Optional[str] = None):
+        """(items, resourceVersion) for reflector list-then-watch."""
+        ns = namespace if namespace is not None else (self._ns or None)
+        return self._store.list(self._resource, ns if self._namespaced else None)
+
+
+class PodClient(ResourceClient):
+    def bind(self, binding: corev1.Binding):
+        """The scheduler's bind subresource: sets spec.nodeName
+        (ref: pkg/registry/core/pod/rest BindingREST.Create)."""
+        def mutate(pod):
+            if pod.spec.node_name and pod.spec.node_name != binding.target.name:
+                from .store import ConflictError
+                raise ConflictError(
+                    f"pod {pod.metadata.name} is already bound to {pod.spec.node_name}")
+            pod.spec.node_name = binding.target.name
+            _set_pod_condition(pod, "PodScheduled", "True", "")
+            return pod
+        ns = binding.metadata.namespace or self._effective_ns()
+        return self._store.guaranteed_update("pods", ns, binding.metadata.name, mutate)
+
+
+def _set_pod_condition(pod, ctype: str, status: str, reason: str) -> None:
+    from ..utils.clock import now_iso
+    for cond in pod.status.conditions:
+        if cond.type == ctype:
+            if cond.status != status:
+                cond.status = status
+                cond.reason = reason
+                cond.last_transition_time = now_iso()
+            return
+    pod.status.conditions.append(corev1.PodCondition(
+        type=ctype, status=status, reason=reason, last_transition_time=now_iso()))
+
+
+class Client:
+    """The clientset: one accessor per resource, namespace-scoped views."""
+
+    def __init__(self, store: Optional[Store] = None, scheme: Scheme = SCHEME,
+                 *, validate: bool = True):
+        self.store = store if store is not None else Store()
+        self.scheme = scheme
+        self._validate = validate
+
+    def resource(self, cls: Type, namespace: Optional[str] = None) -> ResourceClient:
+        if cls is corev1.Pod:
+            return PodClient(self.store, self.scheme, cls, namespace,
+                             validate=self._validate)
+        return ResourceClient(self.store, self.scheme, cls, namespace,
+                              validate=self._validate)
+
+    # convenience accessors, mirroring clientset.CoreV1().Pods(ns) etc.
+    def pods(self, namespace: Optional[str] = None) -> PodClient:
+        return self.resource(corev1.Pod, namespace)  # type: ignore[return-value]
+
+    def nodes(self) -> ResourceClient:
+        return self.resource(corev1.Node)
+
+    def services(self, namespace: Optional[str] = None) -> ResourceClient:
+        return self.resource(corev1.Service, namespace)
+
+    def endpoints(self, namespace: Optional[str] = None) -> ResourceClient:
+        return self.resource(corev1.Endpoints, namespace)
+
+    def namespaces(self) -> ResourceClient:
+        return self.resource(corev1.Namespace)
+
+    def events(self, namespace: Optional[str] = None) -> ResourceClient:
+        return self.resource(corev1.Event, namespace)
+
+    def persistent_volumes(self) -> ResourceClient:
+        return self.resource(corev1.PersistentVolume)
+
+    def persistent_volume_claims(self, namespace: Optional[str] = None) -> ResourceClient:
+        return self.resource(corev1.PersistentVolumeClaim, namespace)
+
+    def replication_controllers(self, namespace: Optional[str] = None) -> ResourceClient:
+        return self.resource(corev1.ReplicationController, namespace)
+
+    def deployments(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.apps import Deployment
+        return self.resource(Deployment, namespace)
+
+    def replica_sets(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.apps import ReplicaSet
+        return self.resource(ReplicaSet, namespace)
+
+    def stateful_sets(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.apps import StatefulSet
+        return self.resource(StatefulSet, namespace)
+
+    def daemon_sets(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.apps import DaemonSet
+        return self.resource(DaemonSet, namespace)
+
+    def jobs(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.batch import Job
+        return self.resource(Job, namespace)
+
+    def pod_disruption_budgets(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.policy import PodDisruptionBudget
+        return self.resource(PodDisruptionBudget, namespace)
+
+    def priority_classes(self) -> ResourceClient:
+        from ..api.policy import PriorityClass
+        return self.resource(PriorityClass)
+
+    def storage_classes(self) -> ResourceClient:
+        from ..api.policy import StorageClass
+        return self.resource(StorageClass)
+
+    def leases(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.policy import Lease
+        return self.resource(Lease, namespace)

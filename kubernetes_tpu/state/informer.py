@@ -1,0 +1,270 @@
+"""Informers: reflector + indexer + shared event fan-out.
+
+Ref: staging/src/k8s.io/client-go/tools/cache — Reflector.ListAndWatch
+(reflector.go:159), thread-safe Indexer store, sharedIndexInformer
+(shared_informer.go:189) with per-listener delivery, and the
+SharedInformerFactory. The DeltaFIFO stage is collapsed: the in-process store
+already delivers ordered events, so the reflector applies them straight to the
+indexer and notifies listeners.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from .client import Client, ResourceClient
+from .store import ADDED, DELETED, ExpiredError, MODIFIED
+
+
+class Indexer:
+    """Thread-safe key->object store with named secondary indices
+    (ref: tools/cache/thread_safe_store.go)."""
+
+    def __init__(self, index_funcs: Optional[Dict[str, Callable[[Any], List[str]]]] = None):
+        self._lock = threading.RLock()
+        self._items: Dict[str, Any] = {}
+        self._index_funcs = index_funcs or {}
+        # index name -> index value -> set of keys
+        self._indices: Dict[str, Dict[str, set]] = defaultdict(lambda: defaultdict(set))
+
+    @staticmethod
+    def key_of(obj: Any) -> str:
+        return obj.metadata.key()
+
+    def _update_indices(self, old: Optional[Any], new: Optional[Any], key: str) -> None:
+        for name, fn in self._index_funcs.items():
+            idx = self._indices[name]
+            if old is not None:
+                for v in fn(old):
+                    idx[v].discard(key)
+                    if not idx[v]:
+                        del idx[v]
+            if new is not None:
+                for v in fn(new):
+                    idx[v].add(key)
+
+    def add(self, obj: Any) -> None:
+        key = self.key_of(obj)
+        with self._lock:
+            old = self._items.get(key)
+            self._items[key] = obj
+            self._update_indices(old, obj, key)
+
+    update = add
+
+    def delete(self, obj: Any) -> None:
+        key = self.key_of(obj)
+        with self._lock:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._update_indices(old, None, key)
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self, namespace: Optional[str] = None) -> List[Any]:
+        with self._lock:
+            items = list(self._items.values())
+        if namespace is not None:
+            items = [o for o in items if o.metadata.namespace == namespace]
+        return items
+
+    def by_index(self, index_name: str, value: str) -> List[Any]:
+        with self._lock:
+            keys = list(self._indices[index_name].get(value, ()))
+            return [self._items[k] for k in keys if k in self._items]
+
+    def replace(self, objs: List[Any]) -> None:
+        with self._lock:
+            self._items.clear()
+            self._indices.clear()
+            for obj in objs:
+                self.add(obj)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items)
+
+
+class EventHandlers:
+    def __init__(self, on_add=None, on_update=None, on_delete=None):
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+
+
+class SharedInformer:
+    """One reflector per resource type; many handler sets.
+
+    Handlers run on the informer's delivery thread (the reference's
+    processorListener goroutines collapse to direct calls here; handlers must
+    be fast and push work onto workqueues, which is also the reference's
+    contract)."""
+
+    def __init__(self, rc: ResourceClient,
+                 index_funcs: Optional[Dict[str, Callable]] = None):
+        self._rc = rc
+        self.indexer = Indexer(index_funcs)
+        self._handlers: List[EventHandlers] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch = None
+
+    def add_event_handlers(self, handlers: EventHandlers) -> None:
+        with self._lock:
+            self._handlers.append(handlers)
+            if self._synced.is_set():
+                for obj in self.indexer.list():
+                    self._dispatch(handlers.on_add, obj)
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            if self._watch is not None:
+                self._watch.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except ExpiredError:
+                continue  # relist (ref: reflector resourceVersion-too-old path)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(0.05)
+
+    def _dispatch(self, fn, *args) -> None:
+        """Handler exceptions must not tear down the watch loop (a failing
+        handler would otherwise force relist storms and leak watches)."""
+        if fn is None:
+            return
+        try:
+            fn(*args)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+
+    def _list_and_watch(self) -> None:
+        with self._lock:
+            if self._watch is not None:  # drop a stale watch from a prior round
+                self._watch.stop()
+                self._watch = None
+        items, rv = self._rc.list_rv()
+        old = {k: v for k, v in ((Indexer.key_of(o), o) for o in self.indexer.list())}
+        self.indexer.replace(items)
+        with self._lock:
+            handlers = list(self._handlers)
+        for obj in items:
+            key = Indexer.key_of(obj)
+            prev = old.pop(key, None)
+            for h in handlers:
+                if prev is None:
+                    self._dispatch(h.on_add, obj)
+                elif prev.metadata.resource_version != obj.metadata.resource_version:
+                    self._dispatch(h.on_update, prev, obj)
+        for prev in old.values():
+            for h in handlers:
+                self._dispatch(h.on_delete, prev)
+        self._synced.set()
+        watch = self._rc.watch(resource_version=rv)
+        with self._lock:
+            self._watch = watch
+            if self._stop.is_set():  # stop() raced the watch creation
+                watch.stop()
+                return
+        for ev in watch:
+            if self._stop.is_set():
+                return
+            obj = ev.object
+            with self._lock:
+                handlers = list(self._handlers)
+            if ev.type == ADDED:
+                prev = self.indexer.get_by_key(Indexer.key_of(obj))
+                self.indexer.add(obj)
+                for h in handlers:
+                    if prev is None:
+                        self._dispatch(h.on_add, obj)
+                    else:
+                        self._dispatch(h.on_update, prev, obj)
+            elif ev.type == MODIFIED:
+                prev = self.indexer.get_by_key(Indexer.key_of(obj))
+                self.indexer.update(obj)
+                for h in handlers:
+                    self._dispatch(h.on_update, prev if prev is not None else obj, obj)
+            elif ev.type == DELETED:
+                self.indexer.delete(obj)
+                for h in handlers:
+                    self._dispatch(h.on_delete, obj)
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        """False fast if the informer is stopped (ref: WaitForCacheSync
+        returning false when the stop channel closes)."""
+        deadline = time.time() + timeout
+        while True:
+            if self._synced.is_set():
+                return True
+            if self._stop.is_set() or time.time() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+
+def pod_node_name_index(pod) -> List[str]:
+    return [pod.spec.node_name] if pod.spec.node_name else []
+
+
+class SharedInformerFactory:
+    """Ref: client-go informers.NewSharedInformerFactory — one informer per
+    type, shared across all consumers."""
+
+    def __init__(self, client: Client):
+        self._client = client
+        self._informers: Dict[Type, SharedInformer] = {}
+        self._lock = threading.Lock()
+
+    def informer_for(self, cls: Type) -> SharedInformer:
+        with self._lock:
+            inf = self._informers.get(cls)
+            if inf is None:
+                index_funcs = {}
+                from ..api.core import Pod
+                if cls is Pod:
+                    index_funcs["nodeName"] = pod_node_name_index
+                inf = SharedInformer(self._client.resource(cls), index_funcs)
+                self._informers[cls] = inf
+            return inf
+
+    def start(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        with self._lock:
+            informers = list(self._informers.values())
+        return all(inf.wait_for_sync(timeout) for inf in informers)
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()

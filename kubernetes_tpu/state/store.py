@@ -1,0 +1,253 @@
+"""Versioned, watchable object store — the L0/L3 storage collapsed in-process.
+
+Semantics follow the reference's etcd3 store + watch cache:
+  - one monotonically increasing cluster-wide resourceVersion (etcd revision)
+    stamped on every write (ref: etcd3/store.go Create/GuaranteedUpdate)
+  - optimistic concurrency: update/delete may require the caller's
+    resourceVersion to match (CAS, ref: GuaranteedUpdate preconditions)
+  - watches resume from any resourceVersion held in the bounded event history
+    window (ref: storage/cacher/cacher.go watchCache), delivered in order
+  - per-(resource, namespace) keying like etcd key paths
+
+Thread-safe; watchers receive events on their own unbounded queues so a slow
+consumer never blocks writers (the reference's buffered watch channels +
+terminate-slow-watcher policy is unnecessary in-process).
+
+A C++ MVCC backend (native/) can replace the dict storage behind the same
+interface; this python implementation is the semantic reference.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..api import serde
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+
+
+class ConflictError(Exception):
+    """resourceVersion precondition failed (HTTP 409 analog)."""
+
+
+class NotFoundError(KeyError):
+    """object does not exist (HTTP 404 analog)."""
+
+
+class AlreadyExistsError(Exception):
+    """create of an existing key (HTTP 409 AlreadyExists analog)."""
+
+
+class ExpiredError(Exception):
+    """watch resourceVersion fell out of the history window (HTTP 410 Gone)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED | BOOKMARK
+    object: Any
+    resource_version: int = 0
+
+
+class Watch:
+    """A single watch subscription; iterate or poll via queue."""
+
+    def __init__(self, store: "Store", wid: int):
+        self._store = store
+        self._id = wid
+        self.events: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = False
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            self._store._remove_watch(self._id)
+            self.events.put(None)
+
+    def __iter__(self):
+        while True:
+            ev = self.events.get()
+            if ev is None:
+                return
+            yield ev
+
+
+class Store:
+    """The cluster state store. Keys are (resource, namespace, name)."""
+
+    HISTORY_WINDOW = 4096  # retained events for watch resume (watchCache capacity)
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rv = 0
+        # resource -> {(namespace, name) -> (obj, rv)}
+        self._data: Dict[str, Dict[Tuple[str, str], Tuple[Any, int]]] = {}
+        # ring of (rv, resource, WatchEvent)
+        self._history: List[Tuple[int, str, WatchEvent]] = []
+        self._watches: Dict[int, Tuple[str, Optional[str], Watch]] = {}
+        self._next_watch_id = 0
+        self._uid_counter = 0
+
+    # ------------------------------------------------------------- writes
+
+    def create(self, resource: str, obj: Any) -> Any:
+        with self._lock:
+            meta = obj.metadata
+            if meta.generate_name and not meta.name:
+                self._uid_counter += 1
+                meta.name = f"{meta.generate_name}{self._uid_counter:x}"
+            key = (meta.namespace, meta.name)
+            bucket = self._data.setdefault(resource, {})
+            # an object pending finalization still owns its key (ref: the
+            # apiserver returns 409 AlreadyExists until finalizers clear)
+            if key in bucket:
+                raise AlreadyExistsError(f"{resource} {key} already exists")
+            self._rv += 1
+            if not meta.uid:
+                self._uid_counter += 1
+                meta.uid = f"uid-{self._uid_counter:08x}"
+            meta.resource_version = str(self._rv)
+            stored = serde.deepcopy_obj(obj)
+            bucket[key] = (stored, self._rv)
+            self._publish(resource, WatchEvent(ADDED, stored, self._rv))
+            return serde.deepcopy_obj(stored)
+
+    def update(self, resource: str, obj: Any, *, enforce_rv: bool = True) -> Any:
+        with self._lock:
+            meta = obj.metadata
+            key = (meta.namespace, meta.name)
+            bucket = self._data.setdefault(resource, {})
+            existing = bucket.get(key)
+            if existing is None:
+                raise NotFoundError(f"{resource} {key} not found")
+            cur_obj, cur_rv = existing
+            if enforce_rv and meta.resource_version and int(meta.resource_version) != cur_rv:
+                raise ConflictError(
+                    f"{resource} {key}: resourceVersion {meta.resource_version} != {cur_rv}")
+            self._rv += 1
+            meta.resource_version = str(self._rv)
+            if not meta.uid:
+                meta.uid = cur_obj.metadata.uid
+            stored = serde.deepcopy_obj(obj)
+            # removing the last finalizer completes a pending deletion
+            # (ref: registry/generic Store.Update deleteCollection path)
+            if stored.metadata.deletion_timestamp is not None and \
+                    not stored.metadata.finalizers:
+                del bucket[key]
+                self._publish(resource, WatchEvent(DELETED, stored, self._rv))
+                return serde.deepcopy_obj(stored)
+            bucket[key] = (stored, self._rv)
+            self._publish(resource, WatchEvent(MODIFIED, stored, self._rv))
+            return serde.deepcopy_obj(stored)
+
+    def delete(self, resource: str, namespace: str, name: str,
+               *, resource_version: Optional[str] = None) -> Any:
+        with self._lock:
+            key = (namespace, name)
+            bucket = self._data.setdefault(resource, {})
+            existing = bucket.get(key)
+            if existing is None:
+                raise NotFoundError(f"{resource} {key} not found")
+            cur_obj, cur_rv = existing
+            if resource_version is not None and int(resource_version) != cur_rv:
+                raise ConflictError(f"{resource} {key}: stale resourceVersion")
+            # finalizer semantics: objects with finalizers get a deletion
+            # timestamp instead of vanishing (ref: registry/generic Store.Delete)
+            if cur_obj.metadata.finalizers and cur_obj.metadata.deletion_timestamp is None:
+                marked = serde.deepcopy_obj(cur_obj)
+                from ..utils.clock import now_iso
+                marked.metadata.deletion_timestamp = now_iso()
+                self._rv += 1
+                marked.metadata.resource_version = str(self._rv)
+                bucket[key] = (marked, self._rv)
+                self._publish(resource, WatchEvent(MODIFIED, marked, self._rv))
+                return serde.deepcopy_obj(marked)
+            del bucket[key]
+            self._rv += 1
+            final = serde.deepcopy_obj(cur_obj)
+            final.metadata.resource_version = str(self._rv)
+            self._publish(resource, WatchEvent(DELETED, final, self._rv))
+            return serde.deepcopy_obj(final)
+
+    def guaranteed_update(self, resource: str, namespace: str, name: str,
+                          mutate: Callable[[Any], Any], retries: int = 16) -> Any:
+        """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238)."""
+        for _ in range(retries):
+            cur = self.get(resource, namespace, name)
+            updated = mutate(serde.deepcopy_obj(cur))
+            try:
+                return self.update(resource, updated)
+            except ConflictError:
+                continue
+        raise ConflictError(f"{resource} {namespace}/{name}: too many conflicts")
+
+    # ------------------------------------------------------------- reads
+
+    def get(self, resource: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            existing = self._data.get(resource, {}).get((namespace, name))
+            if existing is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            return serde.deepcopy_obj(existing[0])
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Callable[[Any], bool]] = None
+             ) -> Tuple[List[Any], int]:
+        """Returns (items, listResourceVersion)."""
+        with self._lock:
+            out = []
+            for (ns, _), (obj, _rv) in sorted(self._data.get(resource, {}).items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector is not None and not label_selector(obj):
+                    continue
+                out.append(serde.deepcopy_obj(obj))
+            return out, self._rv
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # ------------------------------------------------------------- watch
+
+    def watch(self, resource: str, namespace: Optional[str] = None,
+              resource_version: Optional[int] = None) -> Watch:
+        """Subscribe to events after `resource_version` (exclusive). None means
+        'from now'. Raises ExpiredError if rv is older than the history window
+        (clients must relist, ref: 410 Gone -> Reflector relist)."""
+        with self._lock:
+            self._next_watch_id += 1
+            w = Watch(self, self._next_watch_id)
+            if resource_version is not None and resource_version < self._rv:
+                oldest = self._history[0][0] if self._history else self._rv + 1
+                if resource_version + 1 < oldest and resource_version < self._rv:
+                    # rv no longer replayable unless it covers everything retained
+                    if not (not self._history and resource_version >= self._rv):
+                        raise ExpiredError(
+                            f"resourceVersion {resource_version} is too old "
+                            f"(oldest retained: {oldest})")
+                for rv, res, ev in self._history:
+                    if rv > resource_version and res == resource:
+                        if namespace is None or ev.object.metadata.namespace == namespace:
+                            w.events.put(ev)
+            self._watches[w._id] = (resource, namespace, w)
+            return w
+
+    def _publish(self, resource: str, ev: WatchEvent) -> None:
+        self._history.append((ev.resource_version, resource, ev))
+        if len(self._history) > self.HISTORY_WINDOW:
+            self._history = self._history[-self.HISTORY_WINDOW:]
+        for res, ns, w in list(self._watches.values()):
+            if res == resource and (ns is None or ev.object.metadata.namespace == ns):
+                w.events.put(ev)
+
+    def _remove_watch(self, wid: int) -> None:
+        with self._lock:
+            self._watches.pop(wid, None)

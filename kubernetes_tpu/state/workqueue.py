@@ -1,0 +1,159 @@
+"""Work queues for controllers.
+
+Ref: staging/src/k8s.io/client-go/util/workqueue — Type (dedup + in-flight
+tracking), DelayingQueue (time-ordered heap), RateLimitingQueue (per-item
+exponential backoff + overall token bucket).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.clock import Clock, REAL_CLOCK
+
+
+class WorkQueue:
+    """Dedup FIFO with dirty/processing sets (ref: workqueue/queue.go): an item
+    re-added while being processed is re-queued once processing finishes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Any] = []
+        self._dirty = set()
+        self._processing = set()
+        self._shutting_down = False
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def get(self, block: bool = True, timeout: Optional[float] = None
+            ) -> Tuple[Optional[Any], bool]:
+        """Returns (item, shutdown)."""
+        with self._cond:
+            while not self._queue and not self._shutting_down:
+                if not block or not self._cond.wait(timeout):
+                    if not self._queue and not self._shutting_down:
+                        return None, False
+            if not self._queue:
+                return None, True
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item, False
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def len(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._lock:
+            return self._shutting_down
+
+
+class DelayingQueue(WorkQueue):
+    """add_after support via a waiting heap drained by a background thread
+    (ref: workqueue/delaying_queue.go waitingLoop)."""
+
+    def __init__(self, clock: Clock = REAL_CLOCK):
+        super().__init__()
+        self._clock = clock
+        self._waiting: List[Tuple[float, int, Any]] = []
+        self._seq = 0
+        self._wait_cond = threading.Condition()
+        self._thread = threading.Thread(target=self._waiting_loop, daemon=True)
+        self._thread.start()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._wait_cond:
+            self._seq += 1
+            heapq.heappush(self._waiting, (self._clock.now() + delay, self._seq, item))
+            self._wait_cond.notify()
+
+    def _waiting_loop(self) -> None:
+        while True:
+            with self._wait_cond:
+                if self.shutting_down:
+                    return
+                now = self._clock.now()
+                ready = []
+                while self._waiting and self._waiting[0][0] <= now:
+                    _, _, item = heapq.heappop(self._waiting)
+                    ready.append(item)
+                timeout = (self._waiting[0][0] - now) if self._waiting else 0.2
+            for item in ready:
+                self.add(item)
+            with self._wait_cond:
+                if self.shutting_down:
+                    return
+                self._wait_cond.wait(min(max(timeout, 0.001), 0.2))
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        with self._wait_cond:
+            self._wait_cond.notify_all()
+
+
+class RateLimiter:
+    """Per-item exponential backoff (ref: workqueue/default_rate_limiters.go
+    ItemExponentialFailureRateLimiter: base*2^failures capped)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+            return min(self.base_delay * (2 ** n), self.max_delay)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def retries(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class RateLimitingQueue(DelayingQueue):
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None,
+                 clock: Clock = REAL_CLOCK):
+        super().__init__(clock)
+        self.rate_limiter = rate_limiter or RateLimiter()
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self.rate_limiter.retries(item)

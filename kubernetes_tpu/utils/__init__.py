@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (clock, heap, backoff)."""
+
+from .clock import Clock, FakeClock, REAL_CLOCK, now_iso
+
+__all__ = ["Clock", "FakeClock", "REAL_CLOCK", "now_iso"]

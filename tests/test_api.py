@@ -1,0 +1,310 @@
+"""M0 API core tests: quantities, serde round-trip, selectors, helpers,
+validation/defaulting. Modeled on the reference's table-driven API tests
+(pkg/apis/core/validation/validation_test.go, apimachinery quantity tests)."""
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import helpers, labels, serde, validation, wellknown
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.runtime import SCHEME
+
+
+class TestQuantity:
+    @pytest.mark.parametrize("s,value", [
+        ("1", 1), ("100", 100), ("1Ki", 1024), ("1Mi", 1024**2),
+        ("1Gi", 1024**3), ("1k", 1000), ("1M", 10**6), ("1G", 10**9),
+        ("1.5Gi", 1610612736), ("0", 0), ("2e3", 2000), ("500m", 1),
+    ])
+    def test_value(self, s, value):
+        assert Quantity(s).value() == value
+
+    @pytest.mark.parametrize("s,mv", [
+        ("100m", 100), ("1", 1000), ("2", 2000), ("1500m", 1500),
+        ("0.1", 100), ("1u", 1), ("250m", 250),
+    ])
+    def test_milli_value(self, s, mv):
+        assert Quantity(s).milli_value() == mv
+
+    def test_value_rounds_up(self):
+        # ref quantity.go Value() rounds up
+        assert Quantity("100m").value() == 1
+        assert Quantity("1100m").value() == 2
+
+    @pytest.mark.parametrize("bad", ["", "abc", "1Qi", "--1", "1.2.3", "m"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            Quantity(bad)
+
+    def test_arithmetic(self):
+        assert Quantity("1Gi") + Quantity("1Gi") == Quantity("2Gi")
+        assert Quantity("500m") + Quantity("500m") == Quantity("1")
+        assert Quantity("2") - Quantity("500m") == Quantity("1500m")
+        assert Quantity("1Gi") > Quantity("1Mi")
+
+    def test_canonical_round_trip(self):
+        for s in ["100m", "1Gi", "512Mi", "4", "0", "1500m"]:
+            assert str(Quantity(str(Quantity(s)))) == str(Quantity(s))
+
+    def test_binary_canonical(self):
+        assert str(Quantity("1024Ki")) == "1Mi"
+        assert str(Quantity("1Gi")) == "1Gi"
+
+
+class TestSerde:
+    def make_pod(self):
+        return api.Pod(
+            metadata=api.ObjectMeta(name="web-1", namespace="prod",
+                                    labels={"app": "web"}),
+            spec=api.PodSpec(
+                containers=[api.Container(
+                    name="c", image="nginx",
+                    ports=[api.ContainerPort(container_port=80, host_port=8080)],
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": Quantity("250m"),
+                                  "memory": Quantity("64Mi")}))],
+                node_selector={"disktype": "ssd"},
+                tolerations=[api.Toleration(key="gpu", operator="Exists",
+                                            effect="NoSchedule")]))
+
+    def test_round_trip(self):
+        pod = self.make_pod()
+        data = serde.encode(pod)
+        back = serde.decode(api.Pod, data)
+        assert serde.encode(back) == data
+        assert back.spec.containers[0].resources.requests["cpu"] == Quantity("250m")
+
+    def test_camel_case_wire_format(self):
+        data = serde.encode(self.make_pod())
+        assert data["apiVersion"] == "v1"
+        assert data["metadata"]["name"] == "web-1"
+        assert data["spec"]["nodeSelector"] == {"disktype": "ssd"}
+        assert data["spec"]["containers"][0]["ports"][0]["hostPort"] == 8080
+        assert data["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "250m"
+
+    def test_decodes_real_k8s_manifest(self):
+        manifest = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nginx", "labels": {"app": "nginx"}},
+            "spec": {
+                "containers": [{
+                    "name": "nginx", "image": "nginx:1.14",
+                    "resources": {"requests": {"cpu": "100m", "memory": "200Mi"},
+                                  "limits": {"cpu": "1"}},
+                    "ports": [{"containerPort": 80}]}],
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            {"key": "zone", "operator": "In",
+                             "values": ["us-east1-a"]}]}]}}},
+            },
+        }
+        pod = SCHEME.decode_any(manifest)
+        assert isinstance(pod, api.Pod)
+        assert pod.spec.containers[0].resources.requests["memory"].value() == 200 * 1024**2
+        aff = pod.spec.affinity.node_affinity
+        terms = aff.required_during_scheduling_ignored_during_execution.node_selector_terms
+        assert terms[0].match_expressions[0].values == ["us-east1-a"]
+
+    def test_deepcopy(self):
+        pod = self.make_pod()
+        cp = serde.deepcopy_obj(pod)
+        cp.metadata.labels["app"] = "other"
+        assert pod.metadata.labels["app"] == "web"
+
+    def test_deployment_round_trip(self):
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.apps.DeploymentSpec(
+                replicas=3,
+                selector=api.LabelSelector(match_labels={"app": "web"}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "web"}),
+                    spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))))
+        data = serde.encode(dep)
+        back = serde.decode(api.Deployment, data)
+        assert back.spec.replicas == 3
+        assert back.spec.selector.match_labels == {"app": "web"}
+
+
+class TestLabels:
+    def test_match_labels(self):
+        sel = api.LabelSelector(match_labels={"app": "web"})
+        assert labels.matches(sel, {"app": "web", "tier": "fe"})
+        assert not labels.matches(sel, {"app": "db"})
+
+    def test_match_expressions(self):
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement(key="env", operator="In", values=["prod", "stage"]),
+            api.LabelSelectorRequirement(key="canary", operator="DoesNotExist"),
+        ])
+        assert labels.matches(sel, {"env": "prod"})
+        assert not labels.matches(sel, {"env": "dev"})
+        assert not labels.matches(sel, {"env": "prod", "canary": "true"})
+
+    def test_nil_vs_empty(self):
+        assert not labels.matches(None, {"a": "b"})
+        assert labels.matches(api.LabelSelector(), {"a": "b"})
+
+    def test_gt_lt(self):
+        req = api.LabelSelectorRequirement(key="cores", operator="Gt", values=["4"])
+        assert labels.match_requirement(req, {"cores": "8"})
+        assert not labels.match_requirement(req, {"cores": "2"})
+        assert not labels.match_requirement(req, {"cores": "many"})
+
+
+class TestHelpers:
+    def test_pod_requests_sum_and_init_max(self):
+        pod = api.Pod(spec=api.PodSpec(
+            containers=[
+                api.Container(name="a", image="i", resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("100m"), "memory": Quantity("100Mi")})),
+                api.Container(name="b", image="i", resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("200m")})),
+            ],
+            init_containers=[
+                api.Container(name="init", image="i", resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("500m"), "memory": Quantity("50Mi")})),
+            ]))
+        req = helpers.pod_requests(pod)
+        # init container dominates cpu (500 > 300); containers dominate memory
+        assert req["cpu"] == 500
+        assert req["memory"] == 100 * 1024**2
+
+    def test_nonzero_defaults(self):
+        pod = api.Pod(spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+        nz = helpers.pod_requests_nonzero(pod)
+        assert nz["cpu"] == helpers.DEFAULT_MILLI_CPU_REQUEST
+        assert nz["memory"] == helpers.DEFAULT_MEMORY_REQUEST
+
+    def test_tolerates_taints(self):
+        taints = [api.Taint(key="gpu", value="true", effect="NoSchedule")]
+        assert not helpers.tolerates_taints([], taints, ["NoSchedule", "NoExecute"])
+        tol = [api.Toleration(key="gpu", operator="Exists")]
+        assert helpers.tolerates_taints(tol, taints, ["NoSchedule", "NoExecute"])
+        # PreferNoSchedule taints don't block scheduling
+        soft = [api.Taint(key="x", effect="PreferNoSchedule")]
+        assert helpers.tolerates_taints([], soft, ["NoSchedule", "NoExecute"])
+
+    def test_toleration_equal_operator(self):
+        t = api.Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        assert t.tolerates(api.Taint(key="k", value="v", effect="NoSchedule"))
+        assert not t.tolerates(api.Taint(key="k", value="w", effect="NoSchedule"))
+        # empty effect tolerates all effects
+        t2 = api.Toleration(key="k", operator="Exists")
+        assert t2.tolerates(api.Taint(key="k", value="x", effect="NoExecute"))
+
+    def test_node_selector_terms(self):
+        node = api.Node(metadata=api.ObjectMeta(
+            name="n1", labels={"zone": "a", "disk": "ssd"}))
+        terms = [api.NodeSelectorTerm(match_expressions=[
+            api.NodeSelectorRequirement(key="zone", operator="In", values=["a", "b"])])]
+        assert helpers.match_node_selector_terms(terms, node)
+        terms_or = terms + [api.NodeSelectorTerm(match_expressions=[
+            api.NodeSelectorRequirement(key="nope", operator="Exists")])]
+        assert helpers.match_node_selector_terms(terms_or, node)  # OR semantics
+        assert not helpers.match_node_selector_terms(
+            [api.NodeSelectorTerm(match_expressions=[
+                api.NodeSelectorRequirement(key="zone", operator="In", values=["c"])])],
+            node)
+
+    def test_match_fields_metadata_name(self):
+        node = api.Node(metadata=api.ObjectMeta(name="n1"))
+        terms = [api.NodeSelectorTerm(match_fields=[
+            api.NodeSelectorRequirement(key="metadata.name", operator="In", values=["n1"])])]
+        assert helpers.match_node_selector_terms(terms, node)
+
+    def test_host_ports(self):
+        pod = api.Pod(spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            ports=[api.ContainerPort(container_port=80, host_port=8080),
+                   api.ContainerPort(container_port=443)])]))
+        assert helpers.pod_host_ports(pod) == [("TCP", "0.0.0.0", 8080)]
+
+
+class TestValidation:
+    def good_pod(self):
+        return api.Pod(metadata=api.ObjectMeta(name="p", namespace="default"),
+                       spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+
+    def test_valid(self):
+        validation.validate(self.good_pod())
+
+    def test_no_containers(self):
+        pod = self.good_pod()
+        pod.spec.containers = []
+        with pytest.raises(validation.ValidationError):
+            validation.validate(pod)
+
+    def test_bad_name(self):
+        pod = self.good_pod()
+        pod.metadata.name = "Bad_Name"
+        with pytest.raises(validation.ValidationError):
+            validation.validate(pod)
+
+    def test_duplicate_container_names(self):
+        pod = self.good_pod()
+        pod.spec.containers.append(api.Container(name="c", image="j"))
+        with pytest.raises(validation.ValidationError):
+            validation.validate(pod)
+
+    def test_request_exceeds_limit(self):
+        pod = self.good_pod()
+        pod.spec.containers[0].resources = api.ResourceRequirements(
+            requests={"cpu": Quantity("2")}, limits={"cpu": Quantity("1")})
+        with pytest.raises(validation.ValidationError):
+            validation.validate(pod)
+
+    def test_empty_workload_selector_rejected(self):
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="d", namespace="default"),
+            spec=api.apps.DeploymentSpec(
+                selector=api.LabelSelector(),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "y"}))))
+        with pytest.raises(validation.ValidationError):
+            validation.validate(dep)
+
+    def test_workload_selector_must_match_template(self):
+        dep = api.Deployment(
+            metadata=api.ObjectMeta(name="d", namespace="default"),
+            spec=api.apps.DeploymentSpec(
+                selector=api.LabelSelector(match_labels={"app": "x"}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "y"}))))
+        with pytest.raises(validation.ValidationError):
+            validation.validate(dep)
+
+    def test_node_taint_validation(self):
+        node = api.Node(metadata=api.ObjectMeta(name="n"),
+                        spec=api.NodeSpec(taints=[api.Taint(key="k", effect="Bogus")]))
+        with pytest.raises(validation.ValidationError):
+            validation.validate(node)
+
+
+class TestDefaults:
+    def test_pod_defaults(self):
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"),
+                      spec=api.PodSpec(containers=[api.Container(
+                          name="c", image="i",
+                          resources=api.ResourceRequirements(
+                              limits={"cpu": Quantity("1")}))]))
+        api.default(pod)
+        assert pod.metadata.namespace == "default"
+        assert pod.spec.termination_grace_period_seconds == 30
+        assert pod.spec.scheduler_name == "default-scheduler"
+        # requests defaulted from limits
+        assert pod.spec.containers[0].resources.requests["cpu"] == Quantity("1")
+
+
+class TestScheme:
+    def test_resource_names(self):
+        assert SCHEME.resource_for(api.Pod) == "pods"
+        assert SCHEME.type_for_resource("deployments") is api.Deployment
+        assert not SCHEME.is_namespaced(api.Node)
+        assert SCHEME.is_namespaced(api.Pod)
+
+    def test_decode_by_kind(self):
+        obj = SCHEME.decode_any({"apiVersion": "apps/v1", "kind": "ReplicaSet",
+                                 "metadata": {"name": "rs"}})
+        assert isinstance(obj, api.ReplicaSet)
