@@ -16,11 +16,27 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import json
 import typing
 from typing import Any, Optional, Type, TypeVar, get_args, get_origin
 
 T = TypeVar("T")
+
+
+@functools.lru_cache(maxsize=None)
+def _hints_of(tp) -> dict:
+    """get_type_hints is pathologically slow (re-compiles annotation strings
+    every call); dataclass hints are static, so cache per class."""
+    return typing.get_type_hints(tp)
+
+
+@functools.lru_cache(maxsize=None)
+def _wire_fields(tp) -> tuple:
+    """(field, wire_name, resolved_type) per dataclass field, cached."""
+    hints = _hints_of(tp)
+    return tuple((f, _wire_name(f), hints[f.name])
+                 for f in dataclasses.fields(tp))
 
 _ACRONYMS = {"ip": "IP", "cidr": "CIDR", "tls": "TLS", "uid": "UID", "url": "URL",
              "api": "API", "pvc": "PVC", "qos": "QOS", "id": "ID"}
@@ -109,12 +125,10 @@ def _decode_value(tp, data):
     if hasattr(tp, "from_json"):
         return tp.from_json(data)
     if dataclasses.is_dataclass(tp):
-        hints = typing.get_type_hints(tp)
         kwargs = {}
-        for f in dataclasses.fields(tp):
-            wire = _wire_name(f)
+        for f, wire, ftp in _wire_fields(tp):
             if wire in data:
-                kwargs[f.name] = _decode_value(hints[f.name], data[wire])
+                kwargs[f.name] = _decode_value(ftp, data[wire])
         return tp(**kwargs)
     if tp is float and isinstance(data, int):
         return float(data)
@@ -130,5 +144,25 @@ def from_json_str(cls: Type[T], s: str) -> T:
 
 
 def deepcopy_obj(obj: T) -> T:
-    """Semantic deep copy via the codec (mirrors generated DeepCopy)."""
-    return decode(type(obj), encode(obj))
+    """Semantic deep copy (mirrors generated DeepCopy) — structural, without
+    the wire round trip; hot path for every store read/write."""
+    return _copy_value(obj)
+
+
+def _copy_value(v):
+    if v is None or isinstance(v, (str, int, float, bool)):
+        return v
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        out = object.__new__(type(v))
+        for f in dataclasses.fields(v):
+            setattr(out, f.name, _copy_value(getattr(v, f.name)))
+        return out
+    if isinstance(v, dict):
+        return {k: _copy_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_value(x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_copy_value(x) for x in v)
+    if hasattr(v, "to_json"):  # Quantity: immutable value object
+        return v
+    return v

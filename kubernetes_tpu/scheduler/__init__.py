@@ -1,0 +1,28 @@
+"""TPU-native scheduler.
+
+The reference's kube-scheduler (pkg/scheduler, 17.7k LoC) schedules ONE pod
+per iteration: scheduleOne -> findNodesThatFit -> PrioritizeNodes -> bind, with
+16-way goroutine fan-out inside each phase (core/generic_scheduler.go:518,725).
+
+This package replaces that with a batched TPU design:
+  - the scheduler cache mirrors cluster state into dense host tensors with
+    generation-based O(delta) incremental updates (cache.py, snapshot.py)
+  - Filter becomes a pods x nodes feasibility mask and Score a pods x nodes
+    score matrix, computed by jax kernels in one shot (kernels/)
+  - host-side assignment binds a whole batch while preserving the reference's
+    serial decision semantics (core.py); an on-device lax.scan assignment
+    kernel removes the host loop entirely (kernels/assign.py)
+
+Python implementations of every predicate/priority (predicates.py,
+priorities.py) are the semantic source of truth the kernels are parity-tested
+against, and serve preemption's host-side victim search.
+"""
+
+from .cache import Cache, Snapshot
+from .core import BatchScheduler, FitError, ScheduleResult
+from .nodeinfo import NodeInfo, Resource
+from .queue import SchedulingQueue
+from .scheduler import Scheduler
+
+__all__ = ["BatchScheduler", "Cache", "FitError", "NodeInfo", "Resource",
+           "ScheduleResult", "Scheduler", "SchedulingQueue", "Snapshot"]

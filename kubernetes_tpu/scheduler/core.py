@@ -1,0 +1,230 @@
+"""Batch scheduling core — the genericScheduler equivalent.
+
+Ref: pkg/scheduler/core/generic_scheduler.go. Where the reference's
+`Schedule` handles ONE pod (snapshot -> findNodesThatFit -> PrioritizeNodes ->
+selectHost, :184-254), `BatchScheduler.schedule` handles a whole batch:
+
+    cache.update_snapshot      O(delta) generation scan   (cache.go:210-246)
+    mirror.apply(dirty)        O(delta) rows to HBM
+    PodBatchTensors            term-compile the pod axis
+    kernels.schedule_batch     serial-semantics assign scan, on device
+    -> [(pod, node_name | None)]
+
+No node sampling: the reference trades decision quality for speed via
+numFeasibleNodesToFind (50%, :434-453); the batch kernel evaluates every node
+for every pod in one shot, so sampling is unnecessary.
+
+Predicates the kernel does not evaluate natively yet (MatchInterPodAffinity,
+NoDiskConflict) run on the host in two places, both skipped entirely when the
+cluster has no such constraints:
+  - pre-kernel: a per-pod extra mask over nodes (the reference's same
+    predicate fns, vectorized by the term compiler's caching)
+  - post-kernel: in-batch repair — the scan's serial usage tracking covers
+    resources/pod-count, but host ports and (anti-)affinity created by
+    EARLIER WINNERS IN THE SAME BATCH are validated on the host; a conflict
+    demotes the pod to retry (next cycle sees the winner via assume).
+
+Failure diagnosis (`explain`) reruns the python predicates to produce the
+reference's per-node FitError reasons (:598-664) — off the hot path, only for
+pods that failed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import helpers
+from ..api.core import Pod
+from ..api.serde import deepcopy_obj
+from .cache import Cache, Snapshot
+from .nodeinfo import NodeInfo, pod_has_affinity_constraints
+from . import predicates as preds
+from .tensorize import PodBatchTensors, TensorMirror, TermCompiler
+
+
+@dataclass
+class FitError(Exception):
+    """Ref: core.FitError — why a pod fit nowhere."""
+    pod: Optional[Pod] = None
+    failed_predicates: Dict[str, List[str]] = field(default_factory=dict)
+
+    def error(self) -> str:
+        # aggregate like the reference's FitError.Error()
+        counts: Dict[str, int] = {}
+        for reasons in self.failed_predicates.values():
+            for r in reasons:
+                counts[r] = counts.get(r, 0) + 1
+        parts = [f"{n} {r}" for r, n in sorted(counts.items())]
+        return ("0/%d nodes are available: %s." %
+                (len(self.failed_predicates), ", ".join(parts)))
+
+
+@dataclass
+class ScheduleResult:
+    pod: Pod
+    node_name: Optional[str]          # None -> unschedulable (or retry)
+    score: float = 0.0
+    retry: bool = False               # lost an in-batch conflict; requeue
+
+
+def _pod_has_conflict_volumes(pod: Pod) -> bool:
+    for v in pod.spec.volumes:
+        if v.gce_persistent_disk or v.aws_elastic_block_store or v.rbd or v.iscsi:
+            return True
+    return False
+
+
+class BatchScheduler:
+    def __init__(self, cache: Cache):
+        self.cache = cache
+        self.snapshot = Snapshot()
+        self.mirror = TensorMirror()
+        self.terms = TermCompiler(self.mirror)
+        self._seq_base = 0  # selectHost round-robin state across batches
+        self._has_affinity_pods = False
+
+    def refresh(self) -> None:
+        dirty = self.cache.update_snapshot(self.snapshot)
+        self.mirror.apply(self.snapshot, dirty)
+        if dirty:
+            self._has_affinity_pods = any(
+                ni.pods_with_affinity for ni in self.snapshot.node_infos.values())
+
+    # ------------------------------------------------------- residual host path
+
+    def _needs_residual(self, pod: Pod) -> bool:
+        """MatchInterPodAffinity / NoDiskConflict need the host path."""
+        return (self._has_affinity_pods or pod_has_affinity_constraints(pod)
+                or _pod_has_conflict_volumes(pod))
+
+    def _residual_mask(self, pods: List[Pod]
+                       ) -> Tuple[Optional[np.ndarray], Dict[int, preds.PredicateMetadata]]:
+        metas: Dict[int, preds.PredicateMetadata] = {}
+        extra: Optional[np.ndarray] = None
+        for i, pod in enumerate(pods):
+            if not self._needs_residual(pod):
+                continue
+            if extra is None:
+                extra = np.ones((len(pods), self.mirror.t.capacity), bool)
+            meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+            metas[i] = meta
+            for name, ni in self.snapshot.node_infos.items():
+                row = self.mirror.row_of.get(name)
+                if row is None:
+                    continue
+                ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
+                if ok and _pod_has_conflict_volumes(pod):
+                    ok, _ = preds.no_disk_conflict(pod, meta, ni)
+                extra[i, row] = ok
+        return extra, metas
+
+    def _repair_batch(self, results: List[ScheduleResult],
+                      metas: Dict[int, preds.PredicateMetadata]) -> None:
+        """Validate host-evaluated predicates against earlier winners in the
+        same batch; losers are demoted to retry. Skipped when nothing in the
+        batch carries ports/affinity/disk constraints."""
+        needs_any = bool(metas) or any(
+            helpers.pod_host_ports(r.pod) or _pod_has_conflict_volumes(r.pod)
+            for r in results)
+        if not needs_any:
+            return
+        overlay: Dict[str, NodeInfo] = {}
+        winners: List[Pod] = []
+        # a winner with required anti-affinity constrains EVERY later pod in
+        # the batch, constrained or not
+        winners_have_anti = False
+
+        def overlay_node(name: str) -> Optional[NodeInfo]:
+            ni = overlay.get(name)
+            if ni is None:
+                base = self.snapshot.node_infos.get(name)
+                if base is None:
+                    return None
+                ni = base.clone()
+                overlay[name] = ni
+            return ni
+
+        for i, res in enumerate(results):
+            if res.node_name is None:
+                continue
+            pod = res.pod
+            has_ports = bool(helpers.pod_host_ports(pod))
+            has_aff = (pod_has_affinity_constraints(pod) or i in metas
+                       or winners_have_anti)
+            has_disk = _pod_has_conflict_volumes(pod)
+            if winners and (has_ports or has_aff or has_disk):
+                ni = overlay_node(res.node_name)
+                ok = ni is not None
+                if ok and has_ports:
+                    ok, _ = preds.pod_fits_host_ports(pod, None, ni)
+                if ok and has_disk:
+                    ok, _ = preds.no_disk_conflict(pod, None, ni)
+                if ok and has_aff:
+                    meta = metas.get(i)
+                    if meta is None:
+                        # snapshot pods only matter when the cluster has
+                        # affinity pods (then i would be in metas already);
+                        # here only in-batch winners can constrain
+                        base = self.snapshot.node_infos \
+                            if self._has_affinity_pods else {}
+                        meta = preds.PredicateMetadata(pod, base)
+                    for w in winners:
+                        wni = overlay.get(w.spec.node_name)
+                        if wni is not None:
+                            meta.add_pod(w, wni)
+                    ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
+                if not ok:
+                    res.node_name = None
+                    res.retry = True
+                    continue
+            # record the winner in the overlay
+            bound = deepcopy_obj(pod)
+            bound.spec.node_name = res.node_name
+            ni = overlay_node(res.node_name)
+            if ni is not None:
+                ni.add_pod(bound)
+            winners.append(bound)
+            aff = pod.spec.affinity
+            if aff and aff.pod_anti_affinity and \
+                    aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution:
+                winners_have_anti = True
+
+    # ------------------------------------------------------------- schedule
+
+    def schedule(self, pods: List[Pod]) -> List[ScheduleResult]:
+        """Schedule a batch; results preserve input order (which is the
+        queue's priority-then-FIFO order, so the scan's serial semantics
+        match the reference's one-at-a-time loop)."""
+        if not pods:
+            return []
+        from .kernels import schedule_batch
+        self.refresh()
+        extra_mask, metas = self._residual_mask(pods)
+        batch = PodBatchTensors(pods, self.mirror, self.terms,
+                                extra_mask=extra_mask,
+                                seq_base=self._seq_base)
+        self._seq_base += len(pods)
+        node_state = self.mirror.device_state()
+        assign, scores, _usage = schedule_batch(node_state, batch.device())
+        assign = np.asarray(assign)
+        scores = np.asarray(scores)
+        out: List[ScheduleResult] = []
+        for i, pod in enumerate(pods):
+            row = int(assign[i])
+            name = self.mirror.name_of.get(row) if row >= 0 else None
+            out.append(ScheduleResult(pod, name, float(scores[i])))
+        self._repair_batch(out, metas)
+        return out
+
+    def explain(self, pod: Pod) -> FitError:
+        """Host-path per-node failure reasons for events/conditions."""
+        meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+        failed: Dict[str, List[str]] = {}
+        for name, ni in self.snapshot.node_infos.items():
+            ok, reasons = preds.pod_fits_on_node(pod, meta, ni)
+            if not ok:
+                failed[name] = reasons
+        return FitError(pod=pod, failed_predicates=failed)

@@ -1,0 +1,5 @@
+"""Device kernels for the scheduling hot loop."""
+
+from .batch import schedule_batch, filter_score
+
+__all__ = ["schedule_batch", "filter_score"]

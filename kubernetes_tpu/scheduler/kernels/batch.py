@@ -1,0 +1,154 @@
+"""Batched Filter+Score+Assign on device.
+
+Replaces the reference's per-pod hot loop (pkg/scheduler/core/
+generic_scheduler.go — findNodesThatFit :457 with 16 goroutines,
+PrioritizeNodes :672, selectHost :286) with two kernels:
+
+  filter_score(node_state, pod_batch) -> (fits[P,N] bool, score[P,N] f32)
+    the full pods x nodes feasibility mask and score matrix against a frozen
+    snapshot — one fused XLA computation, no sampling
+    (vs numFeasibleNodesToFind's 50% shortcut, :434-453).
+
+  schedule_batch(node_state, pod_batch) -> (assign[P] i32, new node usage)
+    a lax.scan over the pod axis that reproduces the reference's SERIAL
+    semantics exactly — each pod sees node usage updated by every earlier
+    bind (the reference achieves this with cache.AssumePod between
+    iterations, scheduler.go:514) — but never leaves the device: per step it
+    recomputes resource feasibility + resource scores against the running
+    usage, combines the batch-invariant mask/score terms, argmaxes, and
+    scatter-adds the winner's requests onto the usage tensors.
+
+Scores follow the reference's integer arithmetic (LeastRequested
+least_requested.go:53, BalancedAllocation balanced_resource_allocation.go:77)
+via f32 floor; priorities.py is the parity oracle.
+
+Tie-break: jnp.argmax takes the lowest max-score row, where the reference
+round-robins among ties (selectHost :286-296); parity fixtures compare score
+classes, not tie order.
+
+All shapes are static (padded buckets); int/bool tensors stay in VMEM-friendly
+dtypes; the P-step scan compiles to a single device program so a 50k-pod batch
+costs zero host round-trips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAX_PRIORITY = 10.0
+NEG = jnp.float32(-1e30)
+
+# column layout (keep in sync with tensorize.py)
+COL_CPU = 0
+COL_MEM = 1
+
+
+def _least_requested(nz_used: jnp.ndarray, nz_req: jnp.ndarray,
+                     cap_cpu: jnp.ndarray, cap_mem: jnp.ndarray) -> jnp.ndarray:
+    """least_requested.go:53 — ((cap-req)*10/cap int div, avg of cpu+mem)."""
+    req_cpu = nz_used[:, 0] + nz_req[0]
+    req_mem = nz_used[:, 1] + nz_req[1]
+    cpu = jnp.where((cap_cpu > 0) & (req_cpu <= cap_cpu),
+                    jnp.floor((cap_cpu - req_cpu) * MAX_PRIORITY / jnp.maximum(cap_cpu, 1.0)),
+                    0.0)
+    mem = jnp.where((cap_mem > 0) & (req_mem <= cap_mem),
+                    jnp.floor((cap_mem - req_mem) * MAX_PRIORITY / jnp.maximum(cap_mem, 1.0)),
+                    0.0)
+    return jnp.floor((cpu + mem) / 2.0)
+
+
+def _balanced_allocation(nz_used: jnp.ndarray, nz_req: jnp.ndarray,
+                         cap_cpu: jnp.ndarray, cap_mem: jnp.ndarray) -> jnp.ndarray:
+    """balanced_resource_allocation.go:77 — 10 - |cpuFrac-memFrac|*10."""
+    req_cpu = nz_used[:, 0] + nz_req[0]
+    req_mem = nz_used[:, 1] + nz_req[1]
+    cpu_frac = jnp.where(cap_cpu > 0, req_cpu / jnp.maximum(cap_cpu, 1.0), 1.0)
+    mem_frac = jnp.where(cap_mem > 0, req_mem / jnp.maximum(cap_mem, 1.0), 1.0)
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = jnp.floor((1.0 - diff) * MAX_PRIORITY)
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
+
+
+def _pod_feasible(node_state: dict, used, nz_used, pod_count, pod: dict
+                  ) -> jnp.ndarray:
+    """One pod's [N] feasibility against running usage."""
+    fits_res = jnp.all(pod["req"][None, :] + used <= node_state["alloc"], axis=1)
+    fits_count = pod_count + 1.0 <= node_state["max_pods"]
+    blocked = pod["mem_pressure_blocked"] & node_state["mem_pressure"]
+    return (fits_res & fits_count & node_state["node_ok"] &
+            node_state["valid"] & pod["static_mask"] & ~blocked)
+
+
+def _pod_score(node_state: dict, nz_used, pod: dict) -> jnp.ndarray:
+    """One pod's [N] batch-varying score (resource priorities) plus the
+    host-precomputed batch-invariant terms (static_score)."""
+    cap_cpu = node_state["alloc"][:, COL_CPU]
+    cap_mem = node_state["alloc"][:, COL_MEM]
+    score = _least_requested(nz_used, pod["nonzero_req"], cap_cpu, cap_mem)
+    score = score + _balanced_allocation(nz_used, pod["nonzero_req"],
+                                         cap_cpu, cap_mem)
+    if "static_score" in pod:
+        score = score + pod["static_score"]
+    return score
+
+
+@jax.jit
+def filter_score(node_state: dict, pod_batch: dict
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The full pods x nodes mask + score matrix against the frozen snapshot
+    (no in-batch usage updates). vmap over the pod axis."""
+    def one(pod):
+        fits = _pod_feasible(node_state, node_state["used"],
+                             node_state["nonzero_used"],
+                             node_state["pod_count"], pod)
+        score = _pod_score(node_state, node_state["nonzero_used"], pod)
+        return fits, jnp.where(fits, score, NEG)
+    return jax.vmap(one)(pod_batch)
+
+
+@jax.jit
+def schedule_batch(node_state: dict, pod_batch: dict):
+    """Serial-semantics greedy assignment, fully on device.
+
+    Returns (assign [P] int32 node row or -1, chosen_score [P] f32,
+    new_usage dict). The production path does NOT consume new_usage: binds
+    flow through cache.assume_pod, whose dirty rows refresh the mirror O(delta)
+    next cycle (single source of truth). It exists for tests and for a future
+    multi-batch pipelining mode that chains batches device-side.
+    """
+    N = node_state["alloc"].shape[0]
+    # selectHost rotates among max-score nodes across cycles (:286-296). Here:
+    # a sub-integer pseudo-random penalty keyed on (row, pod seq) — uniform
+    # choice within a tie class, robust to row gaps. Base scores are integers
+    # spaced >= 1, and the penalty is < 0.5, so cross-class ranking is intact.
+    rows = jnp.arange(N, dtype=jnp.int32)
+
+    def step(carry, pod):
+        used, nz_used, pod_count = carry
+        fits = _pod_feasible(node_state, used, nz_used, pod_count, pod)
+        score = _pod_score(node_state, nz_used, pod)
+        masked = jnp.where(fits, score, NEG)
+        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
+                            pod["seq"] * jnp.int32(40503), 0xFFFF)
+        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
+        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        ok = fits[best] & pod["active"]
+        onehot = (jnp.arange(used.shape[0], dtype=jnp.int32) == best) & ok
+        oh_f = onehot.astype(jnp.float32)
+        used = used + oh_f[:, None] * pod["req"][None, :]
+        nz_used = nz_used + oh_f[:, None] * pod["nonzero_req"][None, :]
+        pod_count = pod_count + oh_f
+        assign = jnp.where(ok, best, jnp.int32(-1))
+        return (used, nz_used, pod_count), (assign, masked[best])
+
+    carry0 = (node_state["used"], node_state["nonzero_used"],
+              node_state["pod_count"])
+    (used, nz_used, pod_count), (assign, scores) = lax.scan(
+        step, carry0, pod_batch)
+    return assign, scores, {"used": used, "nonzero_used": nz_used,
+                            "pod_count": pod_count}

@@ -1,0 +1,301 @@
+"""SchedulingQueue — activeQ / backoffQ / unschedulableQ.
+
+Ref: pkg/scheduler/internal/queue/scheduling_queue.go (917 LoC) and
+pod_backoff.go. Three sub-queues:
+  - activeQ: heap ordered by (priority desc, enqueue-timestamp asc)
+    (scheduling_queue.go:157-166)
+  - podBackoffQ: heap by backoff expiry; exponential 1s -> 10s cap
+    (pod_backoff.go)
+  - unschedulableQ: map; flushed to active/backoff when >= 60s old or when a
+    cluster event invalidates previous failures (MoveAllToActiveQueue)
+
+The moveRequestCycle / schedulingCycle race repair (:126-133,294-325) is kept:
+a pod that failed in a cycle started before the last move request goes to
+backoff instead of unschedulable, because an event it never saw might have
+made it schedulable.
+
+The TPU extension over the reference is `pop_batch`: the batch collector
+drains up to B pods in one call instead of Pop()ing one, preserving the heap's
+priority-then-FIFO order — this is what feeds the pods-axis of the kernels.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..api import helpers
+from ..api.core import Pod
+from ..utils.clock import Clock, REAL_CLOCK
+
+DEFAULT_UNSCHEDULABLE_DURATION = 60.0  # unschedulableQTimeInterval (:49-51)
+INITIAL_BACKOFF = 1.0                  # pod_backoff.go initialDuration
+MAX_BACKOFF = 10.0                     # pod_backoff.go maxDuration
+
+
+class PodBackoffMap:
+    """Per-pod attempt counter -> exponential backoff (ref: pod_backoff.go)."""
+
+    def __init__(self, clock: Clock):
+        self._clock = clock
+        self._attempts: Dict[str, int] = {}
+        self._last_update: Dict[str, float] = {}
+
+    def boost(self, key: str) -> None:
+        self._attempts[key] = self._attempts.get(key, 0) + 1
+        self._last_update[key] = self._clock.now()
+
+    def backoff_time(self, key: str) -> float:
+        """Absolute time the pod may be retried."""
+        n = self._attempts.get(key, 0)
+        if n == 0:
+            return 0.0
+        return self._last_update[key] + min(INITIAL_BACKOFF * 2 ** (n - 1), MAX_BACKOFF)
+
+    def clear(self, key: str) -> None:
+        self._attempts.pop(key, None)
+        self._last_update.pop(key, None)
+
+
+class _PodInfo:
+    __slots__ = ("pod", "timestamp", "attempts")
+
+    def __init__(self, pod: Pod, timestamp: float):
+        self.pod = pod
+        self.timestamp = timestamp
+
+
+class NominatedPodMap:
+    """node name -> pods nominated to it by preemption
+    (ref: scheduling_queue.go nominatedPodMap)."""
+
+    def __init__(self):
+        self._by_node: Dict[str, List[Pod]] = {}
+        self._node_of: Dict[str, str] = {}
+
+    def add(self, pod: Pod, node_name: str = "") -> None:
+        self.delete(pod)
+        nn = node_name or pod.status.nominated_node_name
+        if not nn:
+            return
+        self._node_of[pod.metadata.key()] = nn
+        self._by_node.setdefault(nn, []).append(pod)
+
+    def delete(self, pod: Pod) -> None:
+        key = pod.metadata.key()
+        nn = self._node_of.pop(key, None)
+        if nn is None:
+            return
+        pods = self._by_node.get(nn, [])
+        self._by_node[nn] = [p for p in pods if p.metadata.key() != key]
+        if not self._by_node[nn]:
+            del self._by_node[nn]
+
+    def pods_for_node(self, node_name: str) -> List[Pod]:
+        return list(self._by_node.get(node_name, ()))
+
+
+class SchedulingQueue:
+    """The PriorityQueue (ref: scheduling_queue.go:106-138)."""
+
+    def __init__(self, clock: Clock = REAL_CLOCK):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = itertools.count()  # FIFO tiebreak within equal priority
+        # activeQ heap entries: (-priority, timestamp, seq, key)
+        self._active: List[Tuple[int, float, int, str]] = []
+        # backoffQ heap entries: (expiry, seq, key)
+        self._backoff: List[Tuple[float, int, str]] = []
+        self._unschedulable: Dict[str, _PodInfo] = {}
+        self._pod_info: Dict[str, _PodInfo] = {}
+        self._in_active: set = set()
+        self._in_backoff: set = set()
+        self.backoff_map = PodBackoffMap(clock)
+        self.nominated = NominatedPodMap()
+        self._scheduling_cycle = 0
+        self._move_request_cycle = -1
+        self._closed = False
+
+    # ----------------------------------------------------------- feeding
+
+    def add(self, pod: Pod) -> None:
+        with self._cond:
+            key = pod.metadata.key()
+            info = _PodInfo(pod, self._clock.now())
+            self._pod_info[key] = info
+            self._unschedulable.pop(key, None)
+            self._in_backoff.discard(key)
+            self._push_active(key, info)
+            self.nominated.add(pod)
+            self._cond.notify_all()
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        with self._cond:
+            key = new.metadata.key()
+            info = self._pod_info.get(key)
+            if info is not None:
+                info.pod = new
+                self.nominated.add(new)
+                if key in self._unschedulable and _spec_changed(old, new):
+                    # updated pods get another chance immediately (:268-292)
+                    del self._unschedulable[key]
+                    self._push_active(key, info)
+                    self._cond.notify_all()
+            else:
+                self.add(new)
+
+    def delete(self, pod: Pod) -> None:
+        with self._cond:
+            key = pod.metadata.key()
+            self._pod_info.pop(key, None)
+            self._unschedulable.pop(key, None)
+            self._in_active.discard(key)
+            self._in_backoff.discard(key)
+            self.nominated.delete(pod)
+            self.backoff_map.clear(key)
+
+    def _push_active(self, key: str, info: _PodInfo) -> None:
+        if key in self._in_active:
+            return
+        prio = helpers.pod_priority(info.pod)
+        heapq.heappush(self._active, (-prio, info.timestamp, next(self._seq), key))
+        self._in_active.add(key)
+
+    # ----------------------------------------------------------- popping
+
+    @property
+    def scheduling_cycle(self) -> int:
+        with self._lock:
+            return self._scheduling_cycle
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
+        pods = self.pop_batch(1, timeout=timeout)
+        return pods[0] if pods else None
+
+    def pop_batch(self, max_pods: int, timeout: Optional[float] = None
+                  ) -> List[Pod]:
+        """Drain up to max_pods from activeQ in priority-then-FIFO order.
+        Blocks until at least one pod is available (or timeout/close). Each
+        call is one scheduling cycle (the whole batch shares it)."""
+        deadline = None if timeout is None else self._clock.now() + timeout
+        with self._cond:
+            while True:
+                self._flush_locked()
+                if self._active or self._closed:
+                    break
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - self._clock.now()
+                    if remaining <= 0:
+                        return []
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+            if self._closed and not self._active:
+                return []
+            self._scheduling_cycle += 1
+            out: List[Pod] = []
+            while self._active and len(out) < max_pods:
+                _, _, _, key = heapq.heappop(self._active)
+                if key not in self._in_active:
+                    continue  # stale heap entry (pod was deleted)
+                self._in_active.discard(key)
+                # popped pods leave the pending set; a failed attempt re-adds
+                # them via add_unschedulable_if_not_present (ref: Pop removes
+                # from activeQ; in-flight pods live only in the cycle)
+                info = self._pod_info.pop(key, None)
+                if info is not None:
+                    out.append(info.pod)
+            return out
+
+    # ------------------------------------------------- failure / requeue
+
+    def add_unschedulable_if_not_present(self, pod: Pod, pod_scheduling_cycle: int
+                                         ) -> None:
+        """Ref: AddUnschedulableIfNotPresent (:294-325). If a move request
+        arrived during this pod's cycle, it goes to backoff (retry soon) rather
+        than parking in unschedulableQ."""
+        with self._cond:
+            key = pod.metadata.key()
+            if key in self._in_active or key in self._in_backoff:
+                return
+            info = self._pod_info.get(key)
+            if info is None:
+                info = _PodInfo(pod, self._clock.now())
+                self._pod_info[key] = info
+            info.pod = pod
+            self.backoff_map.boost(key)
+            self.nominated.add(pod)
+            if self._move_request_cycle >= pod_scheduling_cycle:
+                self._push_backoff(key)
+            else:
+                self._unschedulable[key] = info
+            self._cond.notify_all()
+
+    def _push_backoff(self, key: str) -> None:
+        expiry = self.backoff_map.backoff_time(key)
+        heapq.heappush(self._backoff, (expiry, next(self._seq), key))
+        self._in_backoff.add(key)
+
+    def move_all_to_active_queue(self) -> None:
+        """A cluster event may have made unschedulable pods schedulable
+        (ref: MoveAllToActiveQueue — still-in-backoff pods go to backoffQ)."""
+        with self._cond:
+            for key, info in list(self._unschedulable.items()):
+                if self.backoff_map.backoff_time(key) > self._clock.now():
+                    self._push_backoff(key)
+                else:
+                    self._push_active(key, info)
+            self._unschedulable.clear()
+            self._move_request_cycle = self._scheduling_cycle
+            self._cond.notify_all()
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        """An assigned pod changed; pods with affinity may now fit
+        (ref: movePodsToActiveQueue on AssignedPodAdded/Updated)."""
+        self.move_all_to_active_queue()
+
+    def _flush_locked(self) -> None:
+        """flushBackoffQCompleted (1s ticker) + flushUnschedulableQLeftover
+        (30s ticker) collapsed into lazy flushing at pop time."""
+        now = self._clock.now()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, key = heapq.heappop(self._backoff)
+            if key not in self._in_backoff:
+                continue
+            self._in_backoff.discard(key)
+            info = self._pod_info.get(key)
+            if info is not None:
+                self._push_active(key, info)
+        for key, info in list(self._unschedulable.items()):
+            if now - info.timestamp >= DEFAULT_UNSCHEDULABLE_DURATION:
+                del self._unschedulable[key]
+                if self.backoff_map.backoff_time(key) > now:
+                    self._push_backoff(key)
+                else:
+                    self._push_active(key, info)
+
+    # ----------------------------------------------------------- admin
+
+    def pending_pods(self) -> List[Pod]:
+        with self._lock:
+            return [i.pod for i in self._pod_info.values()]
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pod_info)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+def _spec_changed(old: Optional[Pod], new: Pod) -> bool:
+    if old is None:
+        return True
+    return (old.spec != new.spec or
+            old.metadata.labels != new.metadata.labels or
+            old.status.nominated_node_name != new.status.nominated_node_name)

@@ -1,0 +1,222 @@
+"""Scheduler shell: watch -> batch-pop -> schedule -> assume -> bind.
+
+Ref: pkg/scheduler/scheduler.go (Scheduler, Run :250, scheduleOne :438,
+assume :382, bind :411) and eventhandlers.go:319-469 AddAllEventHandlers.
+
+Differences from the reference, by design:
+  - scheduleOne becomes schedule_batch: the queue drains up to `batch_size`
+    pods per cycle and the TPU kernel decides the whole batch.
+  - binds are issued synchronously against the in-process store (the
+    reference's async bind goroutine exists to overlap a ~100ms apiserver
+    round trip; the shape is preserved behind `_bind`).
+  - assume/finish_binding/forget semantics are identical: assumed pods count
+    against nodes immediately, are confirmed by the informer's add event, and
+    expire on TTL if a bind is lost (internal/cache/interface.go:40-120).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..api import helpers, serde
+from ..api.core import Binding, Event, ObjectReference, Pod
+from ..api.meta import ObjectMeta
+from ..state.client import Client
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..utils.clock import Clock, REAL_CLOCK
+from .cache import Cache
+from .core import BatchScheduler, ScheduleResult
+from .queue import SchedulingQueue
+
+DEFAULT_BATCH_SIZE = 1024
+
+
+class Scheduler:
+    def __init__(self, client: Client,
+                 informer_factory: Optional[SharedInformerFactory] = None,
+                 batch_size: int = DEFAULT_BATCH_SIZE,
+                 scheduler_name: str = "default-scheduler",
+                 clock: Clock = REAL_CLOCK):
+        self.client = client
+        self.scheduler_name = scheduler_name
+        self.batch_size = batch_size
+        self.clock = clock
+        self.cache = Cache(clock=clock)
+        self.queue = SchedulingQueue(clock=clock)
+        self.algorithm = BatchScheduler(self.cache)
+        self.informers = informer_factory or SharedInformerFactory(client)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._in_flight = 0  # pods popped but not yet decided this cycle
+        self.scheduled_count = 0
+        self.unschedulable_count = 0
+        self._add_all_event_handlers()
+
+    # ------------------------------------------------- event handlers
+
+    def _responsible(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name == self.scheduler_name
+
+    def _add_all_event_handlers(self) -> None:
+        """Ref: eventhandlers.go:319-469 — unassigned pods feed the queue,
+        assigned pods and nodes feed the cache; cache-affecting events move
+        unschedulable pods back to active."""
+        from ..api.core import Node
+        pod_inf = self.informers.informer_for(Pod)
+        pod_inf.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete))
+        node_inf = self.informers.informer_for(Node)
+        node_inf.add_event_handlers(EventHandlers(
+            on_add=lambda n: (self.cache.add_node(n),
+                              self.queue.move_all_to_active_queue()),
+            on_update=lambda o, n: (self.cache.update_node(o, n),
+                                    self.queue.move_all_to_active_queue()),
+            on_delete=lambda n: self.cache.remove_node(n)))
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            if not helpers.pod_is_terminal(pod):
+                self.cache.add_pod(pod)
+                self.queue.assigned_pod_updated(pod)
+        elif self._responsible(pod):
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if new.spec.node_name:
+            if helpers.pod_is_terminal(new):
+                self.cache.remove_pod(new)
+            elif old.spec.node_name:
+                self.cache.update_pod(old, new)
+            else:
+                # bind confirmation path: pod transitioned to assigned
+                self.cache.add_pod(new)
+                self.queue.delete(new)
+                self.queue.assigned_pod_updated(new)
+        elif self._responsible(new):
+            self.queue.update(old, new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.remove_pod(pod)
+            self.queue.move_all_to_active_queue()
+        else:
+            self.queue.delete(pod)
+
+    # ------------------------------------------------------ scheduling
+
+    def schedule_pending(self, max_pods: Optional[int] = None,
+                         timeout: float = 0.0) -> List[ScheduleResult]:
+        """One scheduling cycle: drain a batch and decide it. Returns the
+        results (callers: run loop, tests, benchmarks)."""
+        cycle = self.queue.scheduling_cycle
+        pods = self.queue.pop_batch(max_pods or self.batch_size, timeout=timeout)
+        if not pods:
+            return []
+        self._in_flight = len(pods)
+        try:
+            results = self._schedule_batch_locked(pods, cycle)
+        finally:
+            self._in_flight = 0
+        return results
+
+    def _schedule_batch_locked(self, pods: List[Pod], cycle: int
+                               ) -> List[ScheduleResult]:
+        results = self.algorithm.schedule(pods)
+        for res in results:
+            if res.node_name is None:
+                if res.retry:
+                    # lost an in-batch conflict; immediately rescheduleable
+                    self.queue.add(res.pod)
+                else:
+                    self._handle_unschedulable(res.pod, cycle + 1)
+            else:
+                self._assume_and_bind(res)
+        return results
+
+    def _assume_and_bind(self, res: ScheduleResult) -> None:
+        """Ref: scheduler.go assume :382 + bind :411."""
+        assumed = serde.deepcopy_obj(res.pod)
+        assumed.spec.node_name = res.node_name
+        try:
+            self.cache.assume_pod(assumed)
+        except ValueError:
+            return  # already known (duplicate event); nothing to do
+        try:
+            self._bind(res.pod, res.node_name)
+            self.cache.finish_binding(assumed)
+            self.scheduled_count += 1
+        except Exception:
+            self.cache.forget_pod(assumed)
+            self.queue.add_unschedulable_if_not_present(
+                res.pod, self.queue.scheduling_cycle)
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        binding = Binding(
+            metadata=ObjectMeta(name=pod.metadata.name,
+                                namespace=pod.metadata.namespace),
+            target=ObjectReference(kind="Node", name=node_name))
+        self.client.pods(pod.metadata.namespace).bind(binding)
+
+    def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
+        self.unschedulable_count += 1
+        self.queue.add_unschedulable_if_not_present(pod, cycle)
+        try:
+            fit_err = self.algorithm.explain(pod)
+            self._record_event(pod, "FailedScheduling", fit_err.error())
+        except Exception:
+            pass
+
+    def _record_event(self, pod: Pod, reason: str, message: str) -> None:
+        """Ref: client-go tools/record EventRecorder -> apiserver Events."""
+        ev = Event(
+            metadata=ObjectMeta(
+                generate_name=f"{pod.metadata.name}.",
+                namespace=pod.metadata.namespace or "default"),
+            involved_object=ObjectReference(
+                kind="Pod", namespace=pod.metadata.namespace,
+                name=pod.metadata.name, uid=pod.metadata.uid),
+            reason=reason, message=message, type="Warning", count=1)
+        try:
+            self.client.events(pod.metadata.namespace).create(ev)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- run
+
+    def start(self) -> None:
+        """Start informers and the scheduling loop (ref: Scheduler.Run)."""
+        from ..api.core import Node
+        self.informers.informer_for(Pod).start()
+        self.informers.informer_for(Node).start()
+        self.informers.wait_for_cache_sync()
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+        self._thread.start()
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.schedule_pending(timeout=0.2)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            self.cache.cleanup_expired_assumed_pods()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.informers.stop()
+
+    def wait_for_idle(self, timeout: float = 30.0) -> bool:
+        """Test helper: wait until no pod is pending OR in flight."""
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.queue.num_pending() == 0 and self._in_flight == 0:
+                return True
+            time.sleep(0.01)
+        return self.queue.num_pending() == 0 and self._in_flight == 0

@@ -179,8 +179,8 @@ class Store:
                           mutate: Callable[[Any], Any], retries: int = 16) -> Any:
         """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238)."""
         for _ in range(retries):
-            cur = self.get(resource, namespace, name)
-            updated = mutate(serde.deepcopy_obj(cur))
+            # get() already returns an isolated deep copy; mutate it in place
+            updated = mutate(self.get(resource, namespace, name))
             try:
                 return self.update(resource, updated)
             except ConflictError:
@@ -241,6 +241,12 @@ class Store:
             return w
 
     def _publish(self, resource: str, ev: WatchEvent) -> None:
+        # one copy per event, shared by history and every watcher: consumers
+        # must not mutate delivered objects (the client-go informer contract),
+        # but even a misbehaving consumer can't corrupt the store's canonical
+        # copy through the watch path
+        ev = WatchEvent(ev.type, serde.deepcopy_obj(ev.object),
+                        ev.resource_version)
         self._history.append((ev.resource_version, resource, ev))
         if len(self._history) > self.HISTORY_WINDOW:
             self._history = self._history[-self.HISTORY_WINDOW:]

@@ -1,12 +1,22 @@
 """Test env: force JAX onto CPU with 8 virtual devices so multi-chip sharding
-paths (Mesh/shard_map over the node axis) are exercised without TPU hardware.
-Must run before the first `import jax` anywhere in the test process.
+paths (Mesh over the node axis) are exercised without TPU hardware.
+
+The axon TPU tunnel (sitecustomize on PYTHONPATH) imports jax and sets
+JAX_PLATFORMS=axon at interpreter start, so env vars alone don't stick:
+override through jax.config before any backend initializes.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:
+    import jax
+    assert not jax._src.xla_bridge._backends, \
+        "a jax backend initialized before conftest could force CPU"
+    jax.config.update("jax_platforms", "cpu")
