@@ -77,12 +77,34 @@ def _pod_has_conflict_volumes(pod: Pod) -> bool:
     return False
 
 
+def _pod_has_pvc(pod: Pod) -> bool:
+    return any(v.persistent_volume_claim for v in pod.spec.volumes)
+
+
 class BatchScheduler:
-    def __init__(self, cache: Cache):
+    def __init__(self, cache: Cache, listers=None,
+                 weights: Optional[Dict[str, int]] = None,
+                 hard_pod_affinity_weight: Optional[int] = None,
+                 volume_binder=None,
+                 pvc_lister=None, pv_lister=None):
+        from . import priorities as prios_mod
+        from .scorer import ScoreCompiler
+        from .volumebinder import FakeVolumeBinder
+        self.volume_binder = volume_binder or FakeVolumeBinder()
+        self.pvc_lister = pvc_lister      # (namespace, name) -> PVC | None
+        self.pv_lister = pv_lister        # (name) -> PV | None
+        self._zone_conflict = preds.no_volume_zone_conflict_factory(
+            pvc_lister or (lambda ns, name: None),
+            pv_lister or (lambda name: None))
         self.cache = cache
         self.snapshot = Snapshot()
         self.mirror = TensorMirror()
         self.terms = TermCompiler(self.mirror)
+        self.scorer = ScoreCompiler(
+            self.mirror, self.terms, listers=listers, weights=weights,
+            hard_pod_affinity_weight=(
+                hard_pod_affinity_weight if hard_pod_affinity_weight is not None
+                else prios_mod.HARD_POD_AFFINITY_WEIGHT))
         self._seq_base = 0  # selectHost round-robin state across batches
         self._has_affinity_pods = False
 
@@ -92,13 +114,29 @@ class BatchScheduler:
         if dirty:
             self._has_affinity_pods = any(
                 ni.pods_with_affinity for ni in self.snapshot.node_infos.values())
+            self.scorer.set_cluster_has_affinity_pods(self._has_affinity_pods)
 
     # ------------------------------------------------------- residual host path
 
     def _needs_residual(self, pod: Pod) -> bool:
-        """MatchInterPodAffinity / NoDiskConflict need the host path."""
+        """MatchInterPodAffinity / NoDiskConflict / volume predicates need
+        the host path."""
         return (self._has_affinity_pods or pod_has_affinity_constraints(pod)
-                or _pod_has_conflict_volumes(pod))
+                or _pod_has_conflict_volumes(pod) or _pod_has_pvc(pod))
+
+    def _passes_basic_checks(self, pod: Pod) -> bool:
+        """Ref: podPassesBasicChecks (generic_scheduler.go:188) — referenced
+        PVCs must exist and not be deleting."""
+        if self.pvc_lister is None:
+            return True
+        for vol in pod.spec.volumes:
+            if not vol.persistent_volume_claim:
+                continue
+            pvc = self.pvc_lister(pod.metadata.namespace,
+                                  vol.persistent_volume_claim.claim_name)
+            if pvc is None or pvc.metadata.deletion_timestamp is not None:
+                return False
+        return True
 
     def _residual_mask(self, pods: List[Pod]
                        ) -> Tuple[Optional[np.ndarray], Dict[int, preds.PredicateMetadata]]:
@@ -109,15 +147,24 @@ class BatchScheduler:
                 continue
             if extra is None:
                 extra = np.ones((len(pods), self.mirror.t.capacity), bool)
+            if not self._passes_basic_checks(pod):
+                extra[i, :] = False
+                continue
             meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
             metas[i] = meta
+            has_disk = _pod_has_conflict_volumes(pod)
+            has_pvc = _pod_has_pvc(pod)
             for name, ni in self.snapshot.node_infos.items():
                 row = self.mirror.row_of.get(name)
                 if row is None:
                     continue
                 ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
-                if ok and _pod_has_conflict_volumes(pod):
+                if ok and has_disk:
                     ok, _ = preds.no_disk_conflict(pod, meta, ni)
+                if ok and has_pvc:
+                    ok, _ = self._zone_conflict(pod, meta, ni)
+                    if ok and ni.node is not None:
+                        ok = self.volume_binder.find_pod_volumes(pod, ni.node)
                 extra[i, row] = ok
         return extra, metas
 
@@ -207,6 +254,9 @@ class BatchScheduler:
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
+        static = self.scorer.static_scores(pods, batch.static_fits)
+        if static is not None:
+            batch.static_score[:len(pods)] = static
         node_state = self.mirror.device_state()
         assign, scores, _usage = schedule_batch(node_state, batch.device())
         assign = np.asarray(assign)

@@ -92,9 +92,7 @@ def _pod_score(node_state: dict, nz_used, pod: dict) -> jnp.ndarray:
     score = _least_requested(nz_used, pod["nonzero_req"], cap_cpu, cap_mem)
     score = score + _balanced_allocation(nz_used, pod["nonzero_req"],
                                          cap_cpu, cap_mem)
-    if "static_score" in pod:
-        score = score + pod["static_score"]
-    return score
+    return score + pod["static_score"]
 
 
 @jax.jit

@@ -223,18 +223,20 @@ def selector_spread_reduce(pod: Pod, meta: PriorityMetadata,
             score = MAX_PRIORITY * (max_count - counts.get(name, 0)) / max_count
         if have_zones and ni.node is not None:
             zone = ni.node.metadata.labels.get(wellknown.LABEL_ZONE, "")
+            # zone-less nodes keep the default MaxPriority zone score
+            # (selector_spreading.go: zoneScore only recomputed with a zone id)
             zone_score = float(MAX_PRIORITY)
             if zone and max_zone > 0:
                 zone_score = MAX_PRIORITY * (max_zone - zone_counts.get(zone, 0)) / max_zone
-            elif not zone:
-                zone_score = 0.0
             score = score * (1 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zone_score
         out[name] = int(score)
     return out
 
 
 def interpod_affinity_scores(pod: Pod, hard_pod_affinity_weight: int,
-                             node_infos: Dict[str, NodeInfo]) -> Dict[str, float]:
+                             node_infos: Dict[str, NodeInfo],
+                             score_nodes: Optional[Dict[str, NodeInfo]] = None
+                             ) -> Dict[str, float]:
     """Ref: interpod_affinity.go CalculateInterPodAffinityPriority — for every
     existing pod, accumulate onto all nodes in the same topology:
       + weight of the incoming pod's preferred-affinity terms it matches
@@ -285,7 +287,7 @@ def interpod_affinity_scores(pod: Pod, hard_pod_affinity_weight: int,
                         credit(existing, wt.pod_affinity_term, -float(wt.weight), node_labels)
 
     raw: Dict[str, float] = {}
-    for name, ni in node_infos.items():
+    for name, ni in (score_nodes if score_nodes is not None else node_infos).items():
         if ni.node is None:
             continue
         total = 0.0
@@ -348,11 +350,14 @@ HARD_POD_AFFINITY_WEIGHT = 1  # DefaultHardPodAffinitySymmetricWeight
 
 def prioritize_nodes(pod: Pod, meta: PriorityMetadata,
                      node_infos: Dict[str, NodeInfo],
-                     weights: Optional[Dict[str, int]] = None
+                     weights: Optional[Dict[str, int]] = None,
+                     all_node_infos: Optional[Dict[str, NodeInfo]] = None
                      ) -> Dict[str, int]:
     """Full Map/Reduce + weighted sum for one pod over a node set
-    (ref: generic_scheduler.go:672-812 PrioritizeNodes). Parity oracle for the
-    TPU score kernel."""
+    (ref: generic_scheduler.go:672-812 PrioritizeNodes — node_infos is the
+    FILTERED set the reduces normalize over; all_node_infos supplies the
+    whole cluster's pods for inter-pod topology pair accumulation). Parity
+    oracle for the TPU score kernel."""
     w = weights if weights is not None else DEFAULT_PRIORITY_WEIGHTS
     live = {n: ni for n, ni in node_infos.items() if ni.node is not None}
     totals: Dict[str, float] = {n: 0.0 for n in live}
@@ -384,6 +389,9 @@ def prioritize_nodes(pod: Pod, meta: PriorityMetadata,
         acc(selector_spread_reduce(pod, meta, live, counts),
             w["SelectorSpreadPriority"])
     if w.get("InterPodAffinityPriority"):
-        raw = interpod_affinity_scores(pod, HARD_POD_AFFINITY_WEIGHT, live)
+        raw = interpod_affinity_scores(
+            pod, HARD_POD_AFFINITY_WEIGHT,
+            all_node_infos if all_node_infos is not None else live,
+            score_nodes=live)
         acc(minmax_normalize(raw), w["InterPodAffinityPriority"])
     return {n: int(v) for n, v in totals.items()}

@@ -44,8 +44,16 @@ class Scheduler:
         self.clock = clock
         self.cache = Cache(clock=clock)
         self.queue = SchedulingQueue(clock=clock)
-        self.algorithm = BatchScheduler(self.cache)
         self.informers = informer_factory or SharedInformerFactory(client)
+        pvc_lister, pv_by_name, pv_all, sc_lister = self._volume_listers()
+        from .volumebinder import VolumeBinder
+        self.volume_binder = VolumeBinder(
+            pvc_lister=pvc_lister, pv_lister=pv_all,
+            sc_lister=sc_lister, client=client)
+        self.algorithm = BatchScheduler(
+            self.cache, listers=self._spread_listers(),
+            volume_binder=self.volume_binder,
+            pvc_lister=pvc_lister, pv_lister=pv_by_name)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
@@ -57,6 +65,31 @@ class Scheduler:
 
     def _responsible(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name == self.scheduler_name
+
+    def _spread_listers(self):
+        """SelectorSpread's selector sources, backed by informer indexers
+        (ref: factory.go wires Service/RC/RS/SS listers into the priority
+        metadata producer)."""
+        from ..api.apps import ReplicaSet, StatefulSet
+        from ..api.core import ReplicationController, Service
+        from .priorities import SpreadListers
+        inf = self.informers.informer_for
+        return SpreadListers(
+            services=lambda ns: inf(Service).indexer.list(ns),
+            rcs=lambda ns: inf(ReplicationController).indexer.list(ns),
+            rss=lambda ns: inf(ReplicaSet).indexer.list(ns),
+            statefulsets=lambda ns: inf(StatefulSet).indexer.list(ns))
+
+    def _volume_listers(self):
+        from ..api.core import PersistentVolume, PersistentVolumeClaim
+        from ..api.policy import StorageClass
+        inf = self.informers.informer_for
+        pvc_lister = lambda ns, name: inf(PersistentVolumeClaim) \
+            .indexer.get_by_key(f"{ns}/{name}")
+        pv_by_name = lambda name: inf(PersistentVolume).indexer.get_by_key(name)
+        pv_all = lambda: inf(PersistentVolume).indexer.list()
+        sc_lister = lambda name: inf(StorageClass).indexer.get_by_key(name)
+        return pvc_lister, pv_by_name, pv_all, sc_lister
 
     def _add_all_event_handlers(self) -> None:
         """Ref: eventhandlers.go:319-469 — unassigned pods feed the queue,
@@ -75,6 +108,15 @@ class Scheduler:
             on_update=lambda o, n: (self.cache.update_node(o, n),
                                     self.queue.move_all_to_active_queue()),
             on_delete=lambda n: self.cache.remove_node(n)))
+        # services/controllers affect SelectorSpread; their events may make
+        # parked pods schedulable-where-preferred (ref: eventhandlers.go
+        # onServiceAdd -> MoveAllToActiveQueue)
+        from ..api.apps import ReplicaSet, StatefulSet
+        from ..api.core import ReplicationController, Service
+        move = lambda *args: self.queue.move_all_to_active_queue()
+        for cls in (Service, ReplicationController, ReplicaSet, StatefulSet):
+            self.informers.informer_for(cls).add_event_handlers(
+                EventHandlers(on_add=move, on_update=move, on_delete=move))
 
     def _on_pod_add(self, pod: Pod) -> None:
         if pod.spec.node_name:
@@ -188,9 +230,7 @@ class Scheduler:
 
     def start(self) -> None:
         """Start informers and the scheduling loop (ref: Scheduler.Run)."""
-        from ..api.core import Node
-        self.informers.informer_for(Pod).start()
-        self.informers.informer_for(Node).start()
+        self.informers.start()
         self.informers.wait_for_cache_sync()
         self._thread = threading.Thread(target=self._run_loop, daemon=True)
         self._thread.start()

@@ -1,0 +1,295 @@
+"""Static score compilation: the non-resource priorities as [P, N] matrices.
+
+Ref: pkg/scheduler/algorithm/priorities/ and PrioritizeNodes
+(generic_scheduler.go:672-812). The reference runs Map per (priority, node)
+then Reduce per priority over the FILTERED node list. Here:
+
+  - raw per-node vectors are compiled on the host through the same term
+    cache as the filter terms (pods sharing tolerations/affinity/images hit
+    the cache), stacked into [P, N] raw matrices,
+  - Reduce (NormalizeReduce / reversed / min-max / spread's zone blend) is
+    vectorized numpy over the pod's statically-feasible node set,
+  - the weighted sum ships to the kernel as pod_batch["static_score"] and is
+    added to the on-device resource scores (LeastRequested/Balanced, which
+    the scan recomputes per step because they vary with in-batch usage).
+
+Priorities whose contribution is CONSTANT over a pod's feasible nodes (e.g.
+TaintToleration when no node has PreferNoSchedule taints: all 10) are
+selection-invariant and dropped — ScheduleResult.score is therefore the
+selection score, not the reference's absolute weighted sum.
+
+In-batch drift: SelectorSpread counts and InterPodAffinity terms are frozen
+at batch start (the reference re-runs them after every one-pod bind). Hard
+(anti-)affinity stays exact via core._repair_batch; soft scores may lag by
+one batch — the documented batching tradeoff.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import helpers, labels as labelsmod, wellknown
+from ..api.core import Pod
+from ..api.meta import controller_ref
+from . import priorities as prios
+from .nodeinfo import NodeInfo
+from .tensorize import TensorMirror, TermCompiler, _canon_tolerations
+
+MAXP = float(prios.MAX_PRIORITY)
+
+
+def _canon_preferred_node_affinity(pod: Pod) -> Tuple:
+    aff = pod.spec.affinity
+    if not aff or not aff.node_affinity:
+        return ()
+    return tuple(
+        (t.weight,
+         tuple((r.key, r.operator, tuple(r.values))
+               for r in t.preference.match_expressions),
+         tuple((r.key, r.operator, tuple(r.values))
+               for r in t.preference.match_fields))
+        for t in aff.node_affinity.preferred_during_scheduling_ignored_during_execution)
+
+
+def _has_preferred_pod_affinity(pod: Pod) -> bool:
+    aff = pod.spec.affinity
+    return bool(aff and (
+        (aff.pod_affinity and
+         aff.pod_affinity.preferred_during_scheduling_ignored_during_execution) or
+        (aff.pod_anti_affinity and
+         aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution)))
+
+
+class ScoreCompiler:
+    """Builds the static [P, N] score matrix for a batch."""
+
+    def __init__(self, mirror: TensorMirror, terms: TermCompiler,
+                 listers: Optional[prios.SpreadListers] = None,
+                 weights: Optional[Dict[str, int]] = None,
+                 hard_pod_affinity_weight: int = prios.HARD_POD_AFFINITY_WEIGHT):
+        self.mirror = mirror
+        self.terms = terms
+        self.listers = listers
+        self.weights = dict(weights if weights is not None
+                            else prios.DEFAULT_PRIORITY_WEIGHTS)
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self._epoch = -1
+        self._vec_cache: Dict[Tuple, np.ndarray] = {}
+        self._zone_ids: Optional[np.ndarray] = None
+        self._any_prefer_taints = False
+        self._any_avoid_annotations = False
+
+    # ------------------------------------------------------- cached vectors
+
+    def _refresh_epoch(self) -> None:
+        if self._epoch == self.mirror.epoch:
+            return
+        self._epoch = self.mirror.epoch
+        self._vec_cache.clear()
+        cap = self.mirror.t.capacity
+        zone_ids = np.zeros((cap,), np.int32)
+        zones: Dict[str, int] = {"": 0}
+        any_taints = False
+        any_avoid = False
+        any_images = False
+        for row, ni in enumerate(self.mirror.infos):
+            if ni is None or ni.node is None:
+                continue
+            z = ni.node.metadata.labels.get(wellknown.LABEL_ZONE, "")
+            zid = zones.get(z)
+            if zid is None:
+                zid = len(zones)
+                zones[z] = zid
+            zone_ids[row] = zid
+            if any(t.effect == "PreferNoSchedule" for t in ni.taints):
+                any_taints = True
+            if prios.PREFER_AVOID_PODS_ANNOTATION in ni.node.metadata.annotations:
+                any_avoid = True
+            if ni.image_sizes:
+                any_images = True
+        self._zone_ids = zone_ids
+        self._n_zones = len(zones)
+        self._any_prefer_taints = any_taints
+        self._any_avoid_annotations = any_avoid
+        self._any_images = any_images
+
+    def _vec(self, key: Tuple, fn) -> np.ndarray:
+        hit = self._vec_cache.get(key)
+        if hit is not None:
+            return hit
+        cap = self.mirror.t.capacity
+        vec = np.zeros((cap,), np.float32)
+        for row, ni in enumerate(self.mirror.infos):
+            if ni is not None and ni.node is not None:
+                vec[row] = fn(ni)
+        self._vec_cache[key] = vec
+        return vec
+
+    def _node_affinity_raw(self, pod: Pod, meta: prios.PriorityMetadata
+                           ) -> Optional[np.ndarray]:
+        key = ("nodeaff", _canon_preferred_node_affinity(pod))
+        if not key[1]:
+            return None
+        return self._vec(key, lambda ni: prios.node_affinity_map(pod, meta, ni))
+
+    def _taint_raw(self, pod: Pod, meta: prios.PriorityMetadata
+                   ) -> Optional[np.ndarray]:
+        if not self._any_prefer_taints:
+            return None  # all counts 0 -> reversed reduce gives constant 10
+        key = ("tainttol", _canon_tolerations(pod))
+        return self._vec(key, lambda ni: prios.taint_toleration_map(pod, meta, ni))
+
+    def _image_raw(self, pod: Pod, meta: prios.PriorityMetadata
+                   ) -> Optional[np.ndarray]:
+        if not self._any_images:
+            return None  # no node reports images -> all zeros
+        images = tuple(sorted({c.image for c in pod.spec.containers if c.image}))
+        if not images:
+            return None
+        key = ("img", images)
+        return self._vec(key, lambda ni: prios.image_locality_map(pod, meta, ni))
+
+    def _avoid_raw(self, pod: Pod, meta: prios.PriorityMetadata
+                   ) -> Optional[np.ndarray]:
+        if not self._any_avoid_annotations:
+            return None  # constant 10 everywhere
+        ref = controller_ref(pod.metadata)
+        if ref is None or ref.kind not in ("ReplicationController", "ReplicaSet"):
+            return None
+        key = ("avoid", ref.kind, ref.name)
+        return self._vec(key, lambda ni: prios.node_prefer_avoid_map(pod, meta, ni))
+
+    def _spread_counts(self, pod: Pod, meta: prios.PriorityMetadata
+                       ) -> Optional[np.ndarray]:
+        if not meta.pod_selectors:
+            return None
+        # selectors derive from the pod's owning service/controller; key by
+        # namespace + its labels (pods of one controller share both)
+        key = ("spread", pod.metadata.namespace,
+               tuple(sorted(pod.metadata.labels.items())))
+        return self._vec(key, lambda ni: prios.selector_spread_map(pod, meta, ni))
+
+    # ------------------------------------------------------------- compile
+
+    def static_scores(self, pods: List[Pod], fits_provider
+                      ) -> Optional[np.ndarray]:
+        """[P, N] weighted static score (None = all-constant, skip upload).
+        fits_provider() lazily yields the batch-start feasibility mask the
+        reduces normalize over (the reference normalizes over filtered
+        nodes); it is only computed if some priority actually contributes."""
+        self._refresh_epoch()
+        w = self.weights
+        P = len(pods)
+        N = self.mirror.t.capacity
+        total: Optional[np.ndarray] = None
+        _fits: List[Optional[np.ndarray]] = [None]
+
+        def fits_mat() -> np.ndarray:
+            if _fits[0] is None:
+                _fits[0] = fits_provider()
+            return _fits[0]
+
+        def acc(i: int, vec: np.ndarray, weight: float):
+            nonlocal total
+            if total is None:
+                total = np.zeros((P, N), np.float32)
+            total[i] += weight * vec
+
+        metas = [prios.PriorityMetadata(pod, self.listers) for pod in pods]
+
+        def feas_max(i: int, raw: np.ndarray) -> float:
+            vals = raw[fits_mat()[i]]
+            return float(vals.max()) if vals.size else 0.0
+
+        for i, pod in enumerate(pods):
+            meta = metas[i]
+            if w.get("NodeAffinityPriority"):
+                raw = self._node_affinity_raw(pod, meta)
+                if raw is not None:
+                    mx = feas_max(i, raw)
+                    if mx > 0:
+                        acc(i, np.floor(MAXP * raw / mx),
+                            w["NodeAffinityPriority"])
+            if w.get("TaintTolerationPriority"):
+                raw = self._taint_raw(pod, meta)
+                if raw is not None:
+                    mx = feas_max(i, raw)
+                    if mx > 0:  # reversed NormalizeReduce
+                        acc(i, MAXP - np.floor(MAXP * raw / mx),
+                            w["TaintTolerationPriority"])
+            if w.get("ImageLocalityPriority"):
+                raw = self._image_raw(pod, meta)
+                if raw is not None and raw.any():
+                    acc(i, raw, w["ImageLocalityPriority"])  # no reduce
+            if w.get("NodePreferAvoidPodsPriority"):
+                raw = self._avoid_raw(pod, meta)
+                if raw is not None:
+                    acc(i, raw, w["NodePreferAvoidPodsPriority"])
+            if w.get("SelectorSpreadPriority"):
+                counts = self._spread_counts(pod, meta)
+                if counts is not None and counts.any():
+                    acc(i, self._spread_reduce(i, counts, fits_mat()),
+                        w["SelectorSpreadPriority"])
+            if w.get("InterPodAffinityPriority"):
+                raw = self._interpod_raw(pod)
+                if raw is not None:
+                    frow = fits_mat()[i]
+                    mn = float(raw[frow].min()) if frow.any() else 0.0
+                    mx = float(raw[frow].max()) if frow.any() else 0.0
+                    if mx > mn:
+                        acc(i, np.floor(MAXP * (raw - mn) / (mx - mn)),
+                            w["InterPodAffinityPriority"])
+        return total
+
+    def _spread_reduce(self, i: int, counts: np.ndarray, fits: np.ndarray
+                       ) -> np.ndarray:
+        """CalculateSpreadPriorityReduce with zone blending
+        (selector_spreading.go zoneWeighting=2/3)."""
+        feas = fits[i]
+        max_count = float(counts[feas].max()) if feas.any() else 0.0
+        if max_count > 0:
+            node_score = MAXP * (max_count - counts) / max_count
+        else:
+            node_score = np.full_like(counts, MAXP)
+        zid = self._zone_ids
+        have_zones = (zid[feas] > 0).any() if feas.any() else False
+        if not have_zones:
+            return np.floor(node_score)
+        zcounts = np.bincount(zid, weights=counts * feas,
+                              minlength=self._n_zones)
+        max_zone = float(zcounts[1:].max()) if self._n_zones > 1 else 0.0
+        zone_of_node = zcounts[zid]
+        # zone-less nodes keep the default MaxPriority zone score
+        # (selector_spreading.go: zoneScore initialized to MaxPriority and
+        # only recomputed for nodes with a zone id)
+        zone_score = np.where((zid > 0) & (max_zone > 0),
+                              MAXP * (max_zone - zone_of_node) /
+                              max(max_zone, 1.0),
+                              MAXP)
+        blended = node_score * (1 - prios.ZONE_WEIGHTING) + \
+            prios.ZONE_WEIGHTING * zone_score
+        return np.floor(blended)
+
+    def _interpod_raw(self, pod: Pod) -> Optional[np.ndarray]:
+        """Preferred inter-pod (anti-)affinity + symmetric hard credit.
+        Host python over the snapshot (O(existing pods)); only runs when the
+        pod or the cluster carries (anti-)affinity terms."""
+        cluster_has = getattr(self, "_cluster_has_affinity_pods", False)
+        if not _has_preferred_pod_affinity(pod) and not cluster_has:
+            return None
+        node_infos = {name: self.mirror.infos[row]
+                      for name, row in self.mirror.row_of.items()
+                      if self.mirror.infos[row] is not None}
+        raw_by_name = prios.interpod_affinity_scores(
+            pod, self.hard_pod_affinity_weight, node_infos)
+        if not any(raw_by_name.values()):
+            return None
+        raw = np.zeros((self.mirror.t.capacity,), np.float32)
+        for name, v in raw_by_name.items():
+            raw[self.mirror.row_of[name]] = v
+        return raw
+
+    def set_cluster_has_affinity_pods(self, flag: bool) -> None:
+        self._cluster_has_affinity_pods = flag

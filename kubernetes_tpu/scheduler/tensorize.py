@@ -367,6 +367,10 @@ class PodBatchTensors:
         # lastNodeIndex (generic_scheduler.go:286-296)
         self.seq = (seq_base + np.arange(P, dtype=np.int64)) \
             .astype(np.int32) & 0x7FFFFFFF
+        # batch-invariant priority scores, filled by ScoreCompiler (zeros =
+        # only the on-device resource priorities contribute)
+        self.static_score = np.zeros((P, N), np.float32)
+        self._mirror = mirror
         for i, pod in enumerate(pods):
             reqs = pod_reqs[i]
             for rname, v in reqs.items():
@@ -399,6 +403,21 @@ class PodBatchTensors:
                 mask = mask & extra_mask[i]
             self.static_mask[i] = mask
 
+    def static_fits(self) -> np.ndarray:
+        """Batch-start feasibility [P_real, N] on host numpy — the node set
+        the score reduces normalize over (the reference normalizes over
+        filtered nodes, generic_scheduler.go PrioritizeNodes)."""
+        t = self._mirror.t
+        P_real = len(self.pods)
+        base = t.node_ok & t.valid & (t.pod_count + 1.0 <= t.max_pods)
+        fits = self.static_mask[:P_real] & base[None, :]
+        blocked = self.mem_pressure_blocked[:P_real]
+        fits &= ~(blocked[:, None] & t.mem_pressure[None, :])
+        free = t.alloc - t.used
+        for r in range(t.n_cols):
+            fits &= self.req[:P_real, r:r + 1] <= free[None, :, r]
+        return fits
+
     def device(self) -> dict:
         import jax.numpy as jnp
         return {"req": jnp.asarray(self.req),
@@ -406,4 +425,5 @@ class PodBatchTensors:
                 "mem_pressure_blocked": jnp.asarray(self.mem_pressure_blocked),
                 "active": jnp.asarray(self.active),
                 "static_mask": jnp.asarray(self.static_mask),
+                "static_score": jnp.asarray(self.static_score),
                 "seq": jnp.asarray(self.seq)}

@@ -351,6 +351,91 @@ class TestKernelParity:
         assert results[0].node_name == "n2"
 
 
+class TestFullPriorityParity:
+    """M3: all 8 default priorities — kernel+ScoreCompiler choice must land on
+    an oracle-max node (prioritize_nodes over the feasible set)."""
+
+    def _cluster(self):
+        nodes, existing, services = [], [], []
+        rng = np.random.RandomState(42)
+        for i in range(12):
+            labels = {"kubernetes.io/hostname": f"n{i}",
+                      api.wellknown.LABEL_ZONE: f"zone-{i % 3}",
+                      "tier": "gold" if i % 2 == 0 else "silver"}
+            taints = []
+            if i % 4 == 0:
+                taints.append(api.Taint(key="soft", value="x",
+                                        effect="PreferNoSchedule"))
+            n = make_node(f"n{i}", cpu=str(int(rng.choice([4, 8]))),
+                          mem=f"{int(rng.choice([16, 32]))}Gi",
+                          labels=labels, taints=taints)
+            if i % 3 == 0:
+                n.status.images = [api.ContainerImage(
+                    names=["img"], size_bytes=500 * 1024 * 1024)]
+            nodes.append(n)
+        for i in range(30):
+            existing.append(make_pod(
+                f"e{i}", cpu=f"{int(rng.randint(100, 1500))}m",
+                mem=f"{int(rng.randint(128, 2048))}Mi",
+                node=f"n{int(rng.randint(0, 12))}",
+                labels={"app": "web" if i % 2 == 0 else "db"}))
+        svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"}))
+        services.append(svc)
+        return nodes, existing, services
+
+    def _make_test_pods(self):
+        pods = []
+        p = make_pod("plain", cpu="300m", mem="256Mi")
+        pods.append(p)
+        p = make_pod("spread", cpu="200m", mem="256Mi", labels={"app": "web"})
+        pods.append(p)
+        p = make_pod("nodeaff", cpu="200m", mem="256Mi")
+        p.spec.affinity = api.Affinity(node_affinity=api.NodeAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                api.PreferredSchedulingTerm(
+                    weight=80,
+                    preference=api.NodeSelectorTerm(match_expressions=[
+                        api.NodeSelectorRequirement(
+                            key="tier", operator="In", values=["gold"])]))]))
+        pods.append(p)
+        p = make_pod("podaff", cpu="200m", mem="256Mi")
+        p.spec.affinity = api.Affinity(pod_affinity=api.PodAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                api.WeightedPodAffinityTerm(
+                    weight=50,
+                    pod_affinity_term=api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(match_labels={"app": "db"}),
+                        topology_key=api.wellknown.LABEL_ZONE))]))
+        pods.append(p)
+        p = make_pod("imgpod", cpu="200m", mem="256Mi")
+        p.spec.containers[0].image = "img"
+        pods.append(p)
+        return pods
+
+    def test_choice_matches_oracle(self):
+        nodes, existing, services = self._cluster()
+        for pod in self._make_test_pods():
+            cache = build_scheduler_state(nodes, existing)
+            listers = prios.SpreadListers(services=lambda ns: services)
+            sched = BatchScheduler(cache, listers=listers)
+            (res,) = sched.schedule([pod])
+            assert res.node_name is not None, pod.metadata.name
+            # oracle: feasible set, then full default prioritization over it
+            snap = Snapshot()
+            cache.update_snapshot(snap)
+            meta = preds.PredicateMetadata(pod, snap.node_infos)
+            feasible = {n: ni for n, ni in snap.node_infos.items()
+                        if preds.pod_fits_on_node(pod, meta, ni)[0]}
+            assert res.node_name in feasible, pod.metadata.name
+            pmeta = prios.PriorityMetadata(pod, listers)
+            scores = prios.prioritize_nodes(pod, pmeta, feasible,
+                                            all_node_infos=snap.node_infos)
+            best = max(scores.values())
+            assert scores[res.node_name] == best, (
+                pod.metadata.name, res.node_name, scores)
+
+
 class TestResidualPredicates:
     """MatchInterPodAffinity / NoDiskConflict / host-port conflicts run on the
     host (pre-kernel mask + in-batch repair) and must hold through the real
