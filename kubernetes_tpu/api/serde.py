@@ -145,24 +145,134 @@ def from_json_str(cls: Type[T], s: str) -> T:
 
 def deepcopy_obj(obj: T) -> T:
     """Semantic deep copy (mirrors generated DeepCopy) — structural, without
-    the wire round trip; hot path for every store read/write."""
+    the wire round trip; hot path for every store write.
+
+    Per-class copiers are compiled once from the dataclass's resolved field
+    hints (the analog of the reference's generated zz_generated.deepcopy.go):
+    fields whose declared type is immutable (str/int/float/bool/enum/value
+    objects like Quantity) are reference-shared; everything else recurses.
+    """
     return _copy_value(obj)
 
 
-def _copy_value(v):
-    if v is None or isinstance(v, (str, int, float, bool)):
-        return v
-    if dataclasses.is_dataclass(v) and not isinstance(v, type):
-        out = object.__new__(type(v))
-        for f in dataclasses.fields(v):
-            setattr(out, f.name, _copy_value(getattr(v, f.name)))
-        return out
-    if isinstance(v, dict):
-        return {k: _copy_value(x) for k, x in v.items()}
-    if isinstance(v, list):
-        return [_copy_value(x) for x in v]
-    if isinstance(v, tuple):
-        return tuple(_copy_value(x) for x in v)
-    if hasattr(v, "to_json"):  # Quantity: immutable value object
-        return v
+def _copy_dict(v):
+    return {k: _copy_value(x) for k, x in v.items()}
+
+
+def _copy_list(v):
+    return [_copy_value(x) for x in v]
+
+
+def _identity(v):
     return v
+
+
+_COPIERS: dict = {
+    str: _identity, int: _identity, float: _identity, bool: _identity,
+    type(None): _identity, dict: _copy_dict, list: _copy_list,
+    tuple: lambda v: tuple(_copy_value(x) for x in v),
+}
+
+
+def _copy_value(v):
+    h = _COPIERS.get(v.__class__)
+    if h is None:
+        h = _build_copier(v.__class__)
+    return h(v)
+
+
+def _immutable_hint(tp) -> bool:
+    """True when every runtime value of this declared type is safe to share."""
+    tp = _strip_optional(tp)
+    if tp in (str, int, float, bool):
+        return True
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return True
+    # value objects (Quantity): immutable by contract, marked by to_json
+    if isinstance(tp, type) and hasattr(tp, "to_json"):
+        return True
+    return False
+
+
+def _dataclass_hint(tp):
+    """The field's dataclass when tp is (Optional) SomeDataclass, else None."""
+    tp = _strip_optional(tp)
+    if isinstance(tp, type) and dataclasses.is_dataclass(tp) \
+            and not hasattr(tp, "to_json"):
+        return tp
+    return None
+
+
+def _copier_for(cls):
+    h = _COPIERS.get(cls)
+    if h is None:
+        h = _build_copier(cls)
+    return h
+
+
+def _build_copier(cls):
+    if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)):
+        _COPIERS[cls] = _identity  # unknown leaf: share by reference
+        return _identity
+    if hasattr(cls, "to_json"):  # value object (Quantity)
+        _COPIERS[cls] = _identity
+        return _identity
+    # register a fallback first so self-referential types don't recurse
+    # during build; replaced with the compiled copier below
+    _COPIERS[cls] = lambda v: _generic_dataclass_copy(v)
+    hints = _hints_of(cls)
+    src = ["def _copy(v):",
+           "    d = v.__dict__",
+           "    out = _object_new(_cls)",
+           "    od = out.__dict__"]
+    ns = {"_object_new": object.__new__, "_cls": cls, "_cp": _copy_value}
+    for f in dataclasses.fields(cls):
+        n = f.name
+        tp = hints[n]
+        if _immutable_hint(tp):
+            src.append(f"    od[{n!r}] = d[{n!r}]")
+            continue
+        elem = _dataclass_hint(tp)
+        if elem is not None and elem is not cls:
+            sub = f"_sub_{n}"
+            ns[sub] = _copier_for(elem)
+            src.append(f"    x = d[{n!r}]")
+            src.append(f"    od[{n!r}] = {sub}(x) if x is not None else None")
+            continue
+        stripped = _strip_optional(tp)
+        origin = get_origin(stripped)
+        if origin is list:
+            args = get_args(stripped)
+            el = args[0] if args else Any
+            if _immutable_hint(el):
+                src.append(f"    x = d[{n!r}]")
+                src.append(f"    od[{n!r}] = x[:] if x is not None else None")
+                continue
+            el_dc = _dataclass_hint(el)
+            if el_dc is not None and el_dc is not cls:
+                sub = f"_sub_{n}"
+                ns[sub] = _copier_for(el_dc)
+                src.append(f"    x = d[{n!r}]")
+                src.append(f"    od[{n!r}] = [{sub}(e) for e in x] "
+                           f"if x is not None else None")
+                continue
+        elif origin is dict:
+            args = get_args(stripped)
+            if len(args) == 2 and _immutable_hint(args[1]):
+                src.append(f"    x = d[{n!r}]")
+                src.append(f"    od[{n!r}] = dict(x) if x is not None else None")
+                continue
+        src.append(f"    x = d[{n!r}]")
+        src.append(f"    od[{n!r}] = _cp(x) if x is not None else None")
+    src.append("    return out")
+    exec(compile("\n".join(src), f"<copier {cls.__name__}>", "exec"), ns)
+    h = ns["_copy"]
+    _COPIERS[cls] = h
+    return h
+
+
+def _generic_dataclass_copy(v):
+    out = object.__new__(type(v))
+    for f in dataclasses.fields(v):
+        setattr(out, f.name, _copy_value(getattr(v, f.name)))
+    return out

@@ -175,11 +175,16 @@ class SchedulingQueue:
         pods = self.pop_batch(1, timeout=timeout)
         return pods[0] if pods else None
 
-    def pop_batch(self, max_pods: int, timeout: Optional[float] = None
-                  ) -> List[Pod]:
+    def pop_batch(self, max_pods: int, timeout: Optional[float] = None,
+                  on_pop=None) -> List[Pod]:
         """Drain up to max_pods from activeQ in priority-then-FIFO order.
         Blocks until at least one pod is available (or timeout/close). Each
-        call is one scheduling cycle (the whole batch shares it)."""
+        call is one scheduling cycle (the whole batch shares it).
+
+        on_pop(n) runs under the queue lock before the pods are returned, so
+        a caller can record them as in-flight atomically with their removal
+        from the pending set (idle detection would otherwise see a window
+        where popped pods are neither pending nor in-flight)."""
         deadline = None if timeout is None else self._clock.now() + timeout
         with self._cond:
             while True:
@@ -208,6 +213,8 @@ class SchedulingQueue:
                 info = self._pod_info.pop(key, None)
                 if info is not None:
                     out.append(info.pod)
+            if on_pop is not None and out:
+                on_pop(len(out))
             return out
 
     # ------------------------------------------------- failure / requeue

@@ -6,9 +6,10 @@ assume :382, bind :411) and eventhandlers.go:319-469 AddAllEventHandlers.
 Differences from the reference, by design:
   - scheduleOne becomes schedule_batch: the queue drains up to `batch_size`
     pods per cycle and the TPU kernel decides the whole batch.
-  - binds are issued synchronously against the in-process store (the
-    reference's async bind goroutine exists to overlap a ~100ms apiserver
-    round trip; the shape is preserved behind `_bind`).
+  - binds are issued synchronously against the in-process store as ONE bulk
+    transaction per batch (`_assume_and_bind_all` -> PodClient.bind_bulk);
+    the reference's async bind goroutine exists to overlap a ~100ms apiserver
+    round trip that does not exist in-process.
   - assume/finish_binding/forget semantics are identical: assumed pods count
     against nodes immediately, are confirmed by the informer's add event, and
     expire on TTL if a bind is lost (internal/cache/interface.go:40-120).
@@ -154,10 +155,12 @@ class Scheduler:
         """One scheduling cycle: drain a batch and decide it. Returns the
         results (callers: run loop, tests, benchmarks)."""
         cycle = self.queue.scheduling_cycle
-        pods = self.queue.pop_batch(max_pods or self.batch_size, timeout=timeout)
+        def _mark_in_flight(n: int) -> None:
+            self._in_flight = n
+        pods = self.queue.pop_batch(max_pods or self.batch_size, timeout=timeout,
+                                    on_pop=_mark_in_flight)
         if not pods:
             return []
-        self._in_flight = len(pods)
         try:
             results = self._schedule_batch_locked(pods, cycle)
         finally:
@@ -167,6 +170,7 @@ class Scheduler:
     def _schedule_batch_locked(self, pods: List[Pod], cycle: int
                                ) -> List[ScheduleResult]:
         results = self.algorithm.schedule(pods)
+        bound: List[ScheduleResult] = []
         for res in results:
             if res.node_name is None:
                 if res.retry:
@@ -175,32 +179,53 @@ class Scheduler:
                 else:
                     self._handle_unschedulable(res.pod, cycle + 1)
             else:
-                self._assume_and_bind(res)
+                bound.append(res)
+        if bound:
+            self._assume_and_bind_all(bound)
         return results
 
-    def _assume_and_bind(self, res: ScheduleResult) -> None:
-        """Ref: scheduler.go assume :382 + bind :411."""
-        assumed = serde.deepcopy_obj(res.pod)
-        assumed.spec.node_name = res.node_name
-        try:
-            self.cache.assume_pod(assumed)
-        except ValueError:
-            return  # already known (duplicate event); nothing to do
-        try:
-            self._bind(res.pod, res.node_name)
-            self.cache.finish_binding(assumed)
-            self.scheduled_count += 1
-        except Exception:
-            self.cache.forget_pod(assumed)
+    def _assume_and_bind_all(self, bound: List[ScheduleResult]) -> None:
+        """Ref: scheduler.go assume :382 + bind :411 — batched: assume the
+        whole batch into the cache, then issue every bind as ONE store
+        transaction (bind_bulk) instead of a POST per pod."""
+        from ..state.store import NotFoundError
+        assumed_by_slot: List[Optional[Pod]] = []
+        bindings: List[Binding] = []
+        for res in bound:
+            assumed = serde.deepcopy_obj(res.pod)
+            assumed.spec.node_name = res.node_name
+            try:
+                self.cache.assume_pod(assumed)
+            except ValueError:
+                assumed_by_slot.append(None)  # duplicate event; skip bind
+                continue
+            assumed_by_slot.append(assumed)
+            bindings.append(Binding(
+                metadata=ObjectMeta(name=res.pod.metadata.name,
+                                    namespace=res.pod.metadata.namespace),
+                target=ObjectReference(kind="Node", name=res.node_name)))
+        outs = iter(self.client.pods().bind_bulk(bindings)) if bindings else iter(())
+        for res, assumed in zip(bound, assumed_by_slot):
+            if assumed is None:
+                continue
+            out = next(outs)
+            if not isinstance(out, Exception):
+                self.cache.finish_binding(assumed)
+                self.scheduled_count += 1
+                continue
+            try:
+                self.cache.forget_pod(assumed)
+            except ValueError:
+                # the informer already confirmed/fixed-up this pod (bind
+                # events publish before this loop runs); nothing to undo
+                continue
+            if isinstance(out, NotFoundError):
+                continue  # deleted while in flight: drop, don't requeue forever
+            pod = res.pod
+            if pod.metadata.deletion_timestamp is not None:
+                continue
             self.queue.add_unschedulable_if_not_present(
-                res.pod, self.queue.scheduling_cycle)
-
-    def _bind(self, pod: Pod, node_name: str) -> None:
-        binding = Binding(
-            metadata=ObjectMeta(name=pod.metadata.name,
-                                namespace=pod.metadata.namespace),
-            target=ObjectReference(kind="Node", name=node_name))
-        self.client.pods(pod.metadata.namespace).bind(binding)
+                pod, self.queue.scheduling_cycle)
 
     def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
         self.unschedulable_count += 1
@@ -251,12 +276,23 @@ class Scheduler:
             self._thread.join(timeout=5)
         self.informers.stop()
 
-    def wait_for_idle(self, timeout: float = 30.0) -> bool:
-        """Test helper: wait until no pod is pending OR in flight."""
+    def wait_for_idle(self, timeout: float = 30.0,
+                      settle: float = 0.25) -> bool:
+        """Test helper: wait until no pod is pending OR in flight, and that
+        stays true for `settle` seconds (creations reach the queue through
+        the async informer, so a single instantaneous check can observe
+        "idle" before deliveries land)."""
         import time
         deadline = time.time() + timeout
+        idle_since: Optional[float] = None
         while time.time() < deadline:
             if self.queue.num_pending() == 0 and self._in_flight == 0:
-                return True
+                now = time.time()
+                if idle_since is None:
+                    idle_since = now
+                elif now - idle_since >= settle:
+                    return True
+            else:
+                idle_since = None
             time.sleep(0.01)
         return self.queue.num_pending() == 0 and self._in_flight == 0

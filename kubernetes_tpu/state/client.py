@@ -104,20 +104,34 @@ class ResourceClient:
         return self._store.list(self._resource, ns if self._namespaced else None)
 
 
+def _bind_mutator(binding: corev1.Binding):
+    def mutate(pod):
+        if pod.spec.node_name and pod.spec.node_name != binding.target.name:
+            from .store import ConflictError
+            raise ConflictError(
+                f"pod {pod.metadata.name} is already bound to {pod.spec.node_name}")
+        pod.spec.node_name = binding.target.name
+        _set_pod_condition(pod, "PodScheduled", "True", "")
+        return pod
+    return mutate
+
+
 class PodClient(ResourceClient):
     def bind(self, binding: corev1.Binding):
         """The scheduler's bind subresource: sets spec.nodeName
         (ref: pkg/registry/core/pod/rest BindingREST.Create)."""
-        def mutate(pod):
-            if pod.spec.node_name and pod.spec.node_name != binding.target.name:
-                from .store import ConflictError
-                raise ConflictError(
-                    f"pod {pod.metadata.name} is already bound to {pod.spec.node_name}")
-            pod.spec.node_name = binding.target.name
-            _set_pod_condition(pod, "PodScheduled", "True", "")
-            return pod
         ns = binding.metadata.namespace or self._effective_ns()
-        return self._store.guaranteed_update("pods", ns, binding.metadata.name, mutate)
+        return self._store.guaranteed_update("pods", ns, binding.metadata.name,
+                                             _bind_mutator(binding))
+
+    def bind_bulk(self, bindings: List[corev1.Binding]) -> List[Any]:
+        """N binds in one store transaction (the batch scheduler's bind
+        phase). Result slots are bound Pods or the Exception that rejected
+        that slot (NotFound for deleted-in-flight, Conflict for double
+        bind)."""
+        items = [(b.metadata.namespace or self._effective_ns(),
+                  b.metadata.name, _bind_mutator(b)) for b in bindings]
+        return self._store.bulk_apply("pods", items)
 
 
 def _set_pod_condition(pod, ctype: str, status: str, reason: str) -> None:

@@ -13,6 +13,13 @@ Thread-safe; watchers receive events on their own unbounded queues so a slow
 consumer never blocks writers (the reference's buffered watch channels +
 terminate-slow-watcher policy is unnecessary in-process).
 
+Copy discipline (the client-go contract, shared_informer.go doc: "objects
+returned from the store MUST be treated as read-only"): the store keeps one
+canonical frozen object per key. Writes deep-copy IN (the caller keeps
+ownership of what it passed); reads, watch events, and returns share the
+canonical object WITHOUT copying. Mutating anything the store handed out is
+a bug — mutate a deepcopy_obj() and write it back.
+
 A C++ MVCC backend (native/) can replace the dict storage behind the same
 interface; this python implementation is the semantic reference.
 """
@@ -98,7 +105,10 @@ class Store:
 
     def create(self, resource: str, obj: Any) -> Any:
         with self._lock:
-            meta = obj.metadata
+            # copy BEFORE any stamping: the caller may be holding a canonical
+            # object from get()/list(), which must never be written through
+            stored = serde.deepcopy_obj(obj)
+            meta = stored.metadata
             if meta.generate_name and not meta.name:
                 self._uid_counter += 1
                 meta.name = f"{meta.generate_name}{self._uid_counter:x}"
@@ -113,10 +123,9 @@ class Store:
                 self._uid_counter += 1
                 meta.uid = f"uid-{self._uid_counter:08x}"
             meta.resource_version = str(self._rv)
-            stored = serde.deepcopy_obj(obj)
             bucket[key] = (stored, self._rv)
             self._publish(resource, WatchEvent(ADDED, stored, self._rv))
-            return serde.deepcopy_obj(stored)
+            return stored
 
     def update(self, resource: str, obj: Any, *, enforce_rv: bool = True) -> Any:
         with self._lock:
@@ -131,20 +140,21 @@ class Store:
                 raise ConflictError(
                     f"{resource} {key}: resourceVersion {meta.resource_version} != {cur_rv}")
             self._rv += 1
-            meta.resource_version = str(self._rv)
-            if not meta.uid:
-                meta.uid = cur_obj.metadata.uid
+            # copy BEFORE stamping (the caller may pass a canonical object)
             stored = serde.deepcopy_obj(obj)
+            stored.metadata.resource_version = str(self._rv)
+            if not stored.metadata.uid:
+                stored.metadata.uid = cur_obj.metadata.uid
             # removing the last finalizer completes a pending deletion
             # (ref: registry/generic Store.Update deleteCollection path)
             if stored.metadata.deletion_timestamp is not None and \
                     not stored.metadata.finalizers:
                 del bucket[key]
                 self._publish(resource, WatchEvent(DELETED, stored, self._rv))
-                return serde.deepcopy_obj(stored)
+                return stored
             bucket[key] = (stored, self._rv)
             self._publish(resource, WatchEvent(MODIFIED, stored, self._rv))
-            return serde.deepcopy_obj(stored)
+            return stored
 
     def delete(self, resource: str, namespace: str, name: str,
                *, resource_version: Optional[str] = None) -> Any:
@@ -167,20 +177,62 @@ class Store:
                 marked.metadata.resource_version = str(self._rv)
                 bucket[key] = (marked, self._rv)
                 self._publish(resource, WatchEvent(MODIFIED, marked, self._rv))
-                return serde.deepcopy_obj(marked)
+                return marked
             del bucket[key]
             self._rv += 1
             final = serde.deepcopy_obj(cur_obj)
             final.metadata.resource_version = str(self._rv)
             self._publish(resource, WatchEvent(DELETED, final, self._rv))
-            return serde.deepcopy_obj(final)
+            return final
+
+    def bulk_apply(self, resource: str,
+                   items: List[Tuple[str, str, Callable[[Any], Any]]],
+                   ) -> List[Any]:
+        """Apply N read-modify-write mutations under ONE lock acquisition.
+
+        The batched analog of N guaranteed_update calls: the scheduler's bind
+        phase turns one-bind-POST-per-pod (ref: scheduler.go:549 -> pod/rest
+        BindingREST) into a single store transaction. Each (namespace, name,
+        mutate) gets a fresh copy of the live object; a mutate may raise to
+        skip its item (the error is recorded in the result slot).
+        """
+        out: List[Any] = []
+        events: List[Tuple[str, WatchEvent]] = []
+        with self._lock:
+            bucket = self._data.setdefault(resource, {})
+            for namespace, name, mutate in items:
+                key = (namespace, name)
+                existing = bucket.get(key)
+                if existing is None:
+                    out.append(NotFoundError(f"{resource} {key} not found"))
+                    continue
+                try:
+                    updated = mutate(serde.deepcopy_obj(existing[0]))
+                except Exception as e:  # mutate rejected the object
+                    out.append(e)
+                    continue
+                self._rv += 1
+                updated.metadata.resource_version = str(self._rv)
+                if updated.metadata.deletion_timestamp is not None and \
+                        not updated.metadata.finalizers:
+                    del bucket[key]
+                    events.append((resource,
+                                   WatchEvent(DELETED, updated, self._rv)))
+                else:
+                    bucket[key] = (updated, self._rv)
+                    events.append((resource,
+                                   WatchEvent(MODIFIED, updated, self._rv)))
+                out.append(updated)
+            for res, ev in events:
+                self._publish(res, ev)
+        return out
 
     def guaranteed_update(self, resource: str, namespace: str, name: str,
                           mutate: Callable[[Any], Any], retries: int = 16) -> Any:
         """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238)."""
         for _ in range(retries):
-            # get() already returns an isolated deep copy; mutate it in place
-            updated = mutate(self.get(resource, namespace, name))
+            # get() returns the frozen canonical object; mutate a copy
+            updated = mutate(serde.deepcopy_obj(self.get(resource, namespace, name)))
             try:
                 return self.update(resource, updated)
             except ConflictError:
@@ -194,7 +246,7 @@ class Store:
             existing = self._data.get(resource, {}).get((namespace, name))
             if existing is None:
                 raise NotFoundError(f"{resource} {namespace}/{name} not found")
-            return serde.deepcopy_obj(existing[0])
+            return existing[0]  # frozen canonical object: read-only
 
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector: Optional[Callable[[Any], bool]] = None
@@ -207,7 +259,7 @@ class Store:
                     continue
                 if label_selector is not None and not label_selector(obj):
                     continue
-                out.append(serde.deepcopy_obj(obj))
+                out.append(obj)  # frozen canonical objects: read-only
             return out, self._rv
 
     @property
@@ -241,12 +293,8 @@ class Store:
             return w
 
     def _publish(self, resource: str, ev: WatchEvent) -> None:
-        # one copy per event, shared by history and every watcher: consumers
-        # must not mutate delivered objects (the client-go informer contract),
-        # but even a misbehaving consumer can't corrupt the store's canonical
-        # copy through the watch path
-        ev = WatchEvent(ev.type, serde.deepcopy_obj(ev.object),
-                        ev.resource_version)
+        # the event shares the canonical frozen object: consumers must not
+        # mutate delivered objects (the client-go informer contract)
         self._history.append((ev.resource_version, resource, ev))
         if len(self._history) > self.HISTORY_WINDOW:
             self._history = self._history[-self.HISTORY_WINDOW:]

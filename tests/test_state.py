@@ -38,10 +38,12 @@ class TestStore:
             s.create("pods", make_pod("a"))
 
     def test_update_cas(self):
+        # store objects are read-only (client-go contract); mutate copies
+        from kubernetes_tpu.api import serde
         s = Store()
         s.create("pods", make_pod("a"))
-        p1 = s.get("pods", "default", "a")
-        p2 = s.get("pods", "default", "a")
+        p1 = serde.deepcopy_obj(s.get("pods", "default", "a"))
+        p2 = serde.deepcopy_obj(s.get("pods", "default", "a"))
         p1.spec.node_name = "n1"
         s.update("pods", p1)
         p2.spec.node_name = "n2"
@@ -74,9 +76,11 @@ class TestStore:
         # a tombstoned key cannot be re-created (409 until finalization)
         with pytest.raises(AlreadyExistsError):
             s.create("pods", make_pod("a"))
-        # removing the last finalizer completes the deletion
+        # removing the last finalizer completes the deletion (mutate a copy:
+        # get() returns the canonical read-only object)
+        from kubernetes_tpu.api import serde
         w = s.watch("pods")
-        cur = s.get("pods", "default", "a")
+        cur = serde.deepcopy_obj(s.get("pods", "default", "a"))
         cur.metadata.finalizers = []
         s.update("pods", cur)
         ev = w.events.get(timeout=1)
@@ -152,9 +156,10 @@ class TestClient:
             c.pods().bind(binding2)
 
     def test_update_status_does_not_touch_spec(self):
+        from kubernetes_tpu.api import serde
         c = Client()
         c.pods().create(make_pod("a"))
-        stale = c.pods().get("a")
+        stale = serde.deepcopy_obj(c.pods().get("a"))
         stale.spec.node_name = "sneaky"
         stale.status.phase = "Running"
         c.pods().update_status(stale)
