@@ -78,3 +78,12 @@ def requirements_of(selector: LabelSelector) -> List[LabelSelectorRequirement]:
             for k, v in sorted(selector.match_labels.items())]
     reqs.extend(selector.match_expressions)
     return reqs
+
+
+def canonical_selector(selector: Optional[LabelSelector]):
+    """Hashable canonical form of a selector (cache/dedupe keys)."""
+    if selector is None:
+        return None
+    return (tuple(sorted(selector.match_labels.items())),
+            tuple(sorted((r.key, r.operator, tuple(sorted(r.values)))
+                         for r in selector.match_expressions)))

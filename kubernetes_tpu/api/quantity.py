@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import re
 from fractions import Fraction
+from functools import lru_cache
 from typing import Union
 
 _BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
@@ -30,10 +31,15 @@ _RE = re.compile(
 
 
 class Quantity:
-    __slots__ = ("_value", "_format")
+    # _iv/_mv lazily cache value()/milli_value(): copiers reference-share
+    # Quantity instances (immutable by contract), so the scheduler's repeated
+    # per-pod request accounting pays the Fraction arithmetic once
+    __slots__ = ("_value", "_format", "_iv", "_mv")
 
     def __init__(self, value: Union[str, int, float, Fraction, "Quantity"] = 0):
         self._format = ""
+        self._iv = None
+        self._mv = None
         if isinstance(value, Quantity):
             self._value = value._value
             self._format = value._format
@@ -47,6 +53,7 @@ class Quantity:
             raise TypeError(f"cannot build Quantity from {type(value)!r}")
 
     @staticmethod
+    @lru_cache(maxsize=4096)
     def _parse(s: str):
         m = _RE.match(s.strip())
         if not m:
@@ -68,11 +75,15 @@ class Quantity:
     # --- accessors (semantics of quantity.go Value()/MilliValue()) ---
     def value(self) -> int:
         """Value rounded up to the nearest integer (ref Value())."""
-        return -((-self._value.numerator) // self._value.denominator)
+        if self._iv is None:
+            self._iv = -((-self._value.numerator) // self._value.denominator)
+        return self._iv
 
     def milli_value(self) -> int:
-        v = self._value * 1000
-        return -((-v.numerator) // v.denominator)
+        if self._mv is None:
+            v = self._value * 1000
+            self._mv = -((-v.numerator) // v.denominator)
+        return self._mv
 
     def as_fraction(self) -> Fraction:
         return self._value

@@ -244,29 +244,40 @@ class BatchScheduler:
     def schedule(self, pods: List[Pod]) -> List[ScheduleResult]:
         """Schedule a batch; results preserve input order (which is the
         queue's priority-then-FIFO order, so the scan's serial semantics
-        match the reference's one-at-a-time loop)."""
+        match the reference's one-at-a-time loop).
+
+        Device discipline (the TPU sits behind a high-latency tunnel): one
+        dirty-row scatter + one scan dispatch + one packed fetch per batch.
+        When the batch needed no host-side repair, the kernel's post-batch
+        usage is adopted on device (TensorMirror.adopt_usage), so the next
+        batch's scatter only rewrites rows the host actually disagrees on."""
         if not pods:
             return []
-        from .kernels import schedule_batch
+        from .kernels.batch import (pack_results, schedule_batch,
+                                    unpack_results)
         self.refresh()
         extra_mask, metas = self._residual_mask(pods)
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
-        static = self.scorer.static_scores(pods, batch.static_fits)
+        static = self.scorer.static_scores(pods, batch)
         if static is not None:
-            batch.static_score[:len(pods)] = static
-        node_state = self.mirror.device_state()
-        assign, scores, _usage = schedule_batch(node_state, batch.device())
-        assign = np.asarray(assign)
-        scores = np.asarray(scores)
+            batch.set_static_scores(*static)
+        node_cfg, usage = self.mirror.device_cfg_usage()
+        assign_d, scores_d, new_usage = schedule_batch(node_cfg, usage,
+                                                       batch.device())
+        assign, scores = unpack_results(pack_results(assign_d, scores_d))
         out: List[ScheduleResult] = []
         for i, pod in enumerate(pods):
             row = int(assign[i])
             name = self.mirror.name_of.get(row) if row >= 0 else None
             out.append(ScheduleResult(pod, name, float(scores[i])))
         self._repair_batch(out, metas)
+        if not any(r.retry for r in out):
+            # every surviving assignment flows through cache.assume_pod, so
+            # the chained usage matches host truth (or gets scatter-repaired)
+            self.mirror.adopt_usage(new_usage)
         return out
 
     def explain(self, pod: Pod) -> FitError:

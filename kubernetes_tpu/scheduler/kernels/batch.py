@@ -2,14 +2,15 @@
 
 Replaces the reference's per-pod hot loop (pkg/scheduler/core/
 generic_scheduler.go — findNodesThatFit :457 with 16 goroutines,
-PrioritizeNodes :672, selectHost :286) with two kernels:
+PrioritizeNodes :672, selectHost :286) with device kernels over a frozen
+node snapshot:
 
-  filter_score(node_state, pod_batch) -> (fits[P,N] bool, score[P,N] f32)
-    the full pods x nodes feasibility mask and score matrix against a frozen
-    snapshot — one fused XLA computation, no sampling
-    (vs numFeasibleNodesToFind's 50% shortcut, :434-453).
+  filter_score(node_cfg, usage, pod_batch) -> (fits[P,N] bool, score[P,N])
+    the full pods x nodes feasibility mask and score matrix — one fused XLA
+    computation, no sampling (vs numFeasibleNodesToFind's 50% shortcut,
+    generic_scheduler.go:434-453).
 
-  schedule_batch(node_state, pod_batch) -> (assign[P] i32, new node usage)
+  schedule_batch(node_cfg, usage, pod_batch) -> (assign[P], score[P], usage')
     a lax.scan over the pod axis that reproduces the reference's SERIAL
     semantics exactly — each pod sees node usage updated by every earlier
     bind (the reference achieves this with cache.AssumePod between
@@ -18,17 +19,30 @@ PrioritizeNodes :672, selectHost :286) with two kernels:
     usage, combines the batch-invariant mask/score terms, argmaxes, and
     scatter-adds the winner's requests onto the usage tensors.
 
+State layout (host mirror: tensorize.TensorMirror):
+  node_cfg — bind-invariant per-node config: alloc [N,R], max_pods [N],
+    node_ok/mem_pressure/valid [N] bool. Only informer events change it.
+  usage    — bind-varying per-node accounting: used [N,R],
+    nonzero_used [N,2], pod_count [N]. schedule_batch returns the
+    post-batch value so consecutive batches can chain ON DEVICE without a
+    host round trip (core.BatchScheduler's drain fast path).
+
+Transfer discipline (the TPU is reached over a high-latency tunnel): the
+pod batch never ships [P, N] matrices. The batch-invariant mask and score
+terms are deduplicated host-side — pods sharing constraint terms (one
+Deployment's pods share selectors/tolerations) share a row:
+    unique_masks  [U, N] bool   +  mask_idx  [P] int32
+    unique_scores [S, N] f32    +  score_idx [P] int32
+U and S are typically 1-8 where P is thousands, so per-batch upload is
+O(P*R + U*N), a few hundred KB instead of the dense O(P*N) hundreds of MB.
+
 Scores follow the reference's integer arithmetic (LeastRequested
 least_requested.go:53, BalancedAllocation balanced_resource_allocation.go:77)
 via f32 floor; priorities.py is the parity oracle.
 
-Tie-break: jnp.argmax takes the lowest max-score row, where the reference
-round-robins among ties (selectHost :286-296); parity fixtures compare score
-classes, not tie order.
-
-All shapes are static (padded buckets); int/bool tensors stay in VMEM-friendly
-dtypes; the P-step scan compiles to a single device program so a 50k-pod batch
-costs zero host round-trips.
+Tie-break: a sub-integer pseudo-random penalty keyed on (node row, pod seq)
+rotates uniformly among max-score ties, mirroring selectHost's round-robin
+intent (:286-296); parity fixtures compare score classes, not tie order.
 """
 
 from __future__ import annotations
@@ -74,69 +88,83 @@ def _balanced_allocation(nz_used: jnp.ndarray, nz_req: jnp.ndarray,
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
 
 
-def _pod_feasible(node_state: dict, used, nz_used, pod_count, pod: dict
-                  ) -> jnp.ndarray:
+def _pod_feasible(node_cfg: dict, used, pod_count, pod: dict,
+                  mask: jnp.ndarray) -> jnp.ndarray:
     """One pod's [N] feasibility against running usage."""
-    fits_res = jnp.all(pod["req"][None, :] + used <= node_state["alloc"], axis=1)
-    fits_count = pod_count + 1.0 <= node_state["max_pods"]
-    blocked = pod["mem_pressure_blocked"] & node_state["mem_pressure"]
-    return (fits_res & fits_count & node_state["node_ok"] &
-            node_state["valid"] & pod["static_mask"] & ~blocked)
+    fits_res = jnp.all(pod["req"][None, :] + used <= node_cfg["alloc"], axis=1)
+    fits_count = pod_count + 1.0 <= node_cfg["max_pods"]
+    blocked = pod["mem_pressure_blocked"] & node_cfg["mem_pressure"]
+    return (fits_res & fits_count & node_cfg["node_ok"] &
+            node_cfg["valid"] & mask & ~blocked)
 
 
-def _pod_score(node_state: dict, nz_used, pod: dict) -> jnp.ndarray:
+def _pod_score(node_cfg: dict, nz_used, pod: dict,
+               static_score: jnp.ndarray) -> jnp.ndarray:
     """One pod's [N] batch-varying score (resource priorities) plus the
-    host-precomputed batch-invariant terms (static_score)."""
-    cap_cpu = node_state["alloc"][:, COL_CPU]
-    cap_mem = node_state["alloc"][:, COL_MEM]
+    host-precomputed batch-invariant terms (its unique_scores row)."""
+    cap_cpu = node_cfg["alloc"][:, COL_CPU]
+    cap_mem = node_cfg["alloc"][:, COL_MEM]
     score = _least_requested(nz_used, pod["nonzero_req"], cap_cpu, cap_mem)
     score = score + _balanced_allocation(nz_used, pod["nonzero_req"],
                                          cap_cpu, cap_mem)
-    return score + pod["static_score"]
+    return score + static_score
+
+
+def _split_batch(pod_batch: dict) -> Tuple[dict, jnp.ndarray, jnp.ndarray]:
+    """(per-pod scanned arrays, unique_masks, unique_scores)."""
+    per_pod = {k: v for k, v in pod_batch.items()
+               if k not in ("unique_masks", "unique_scores")}
+    return per_pod, pod_batch["unique_masks"], pod_batch["unique_scores"]
 
 
 @jax.jit
-def filter_score(node_state: dict, pod_batch: dict
+def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full pods x nodes mask + score matrix against the frozen snapshot
     (no in-batch usage updates). vmap over the pod axis."""
+    per_pod, unique_masks, unique_scores = _split_batch(pod_batch)
+
     def one(pod):
-        fits = _pod_feasible(node_state, node_state["used"],
-                             node_state["nonzero_used"],
-                             node_state["pod_count"], pod)
-        score = _pod_score(node_state, node_state["nonzero_used"], pod)
+        mask = unique_masks[pod["mask_idx"]]
+        static = unique_scores[pod["score_idx"]]
+        fits = _pod_feasible(node_cfg, usage["used"], usage["pod_count"],
+                             pod, mask)
+        score = _pod_score(node_cfg, usage["nonzero_used"], pod, static)
         return fits, jnp.where(fits, score, NEG)
-    return jax.vmap(one)(pod_batch)
+    return jax.vmap(one)(per_pod)
 
 
 @jax.jit
-def schedule_batch(node_state: dict, pod_batch: dict):
+def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict):
     """Serial-semantics greedy assignment, fully on device.
 
     Returns (assign [P] int32 node row or -1, chosen_score [P] f32,
-    new_usage dict). The production path does NOT consume new_usage: binds
-    flow through cache.assume_pod, whose dirty rows refresh the mirror O(delta)
-    next cycle (single source of truth). It exists for tests and for a future
-    multi-batch pipelining mode that chains batches device-side.
+    new_usage dict). new_usage chains into the next batch's call during a
+    queue drain (core.BatchScheduler fast path) so N batches cost N device
+    dispatches and zero usage re-uploads; the cache remains the source of
+    truth between drains (assume/forget -> mirror dirty rows).
     """
-    N = node_state["alloc"].shape[0]
-    # selectHost rotates among max-score nodes across cycles (:286-296). Here:
-    # a sub-integer pseudo-random penalty keyed on (row, pod seq) — uniform
-    # choice within a tie class, robust to row gaps. Base scores are integers
-    # spaced >= 1, and the penalty is < 0.5, so cross-class ranking is intact.
+    per_pod, unique_masks, unique_scores = _split_batch(pod_batch)
+    N = node_cfg["alloc"].shape[0]
     rows = jnp.arange(N, dtype=jnp.int32)
 
     def step(carry, pod):
         used, nz_used, pod_count = carry
-        fits = _pod_feasible(node_state, used, nz_used, pod_count, pod)
-        score = _pod_score(node_state, nz_used, pod)
+        mask = unique_masks[pod["mask_idx"]]
+        static = unique_scores[pod["score_idx"]]
+        fits = _pod_feasible(node_cfg, used, pod_count, pod, mask)
+        score = _pod_score(node_cfg, nz_used, pod, static)
         masked = jnp.where(fits, score, NEG)
+        # selectHost rotates among max-score ties across cycles (:286-296):
+        # sub-integer hash penalty keyed on (row, pod seq). Base scores are
+        # integers spaced >= 1 and the penalty is < 0.5, so cross-class
+        # ranking is intact.
         h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
                             pod["seq"] * jnp.int32(40503), 0xFFFF)
         tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
         best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
         ok = fits[best] & pod["active"]
-        onehot = (jnp.arange(used.shape[0], dtype=jnp.int32) == best) & ok
+        onehot = (rows == best) & ok
         oh_f = onehot.astype(jnp.float32)
         used = used + oh_f[:, None] * pod["req"][None, :]
         nz_used = nz_used + oh_f[:, None] * pod["nonzero_req"][None, :]
@@ -144,9 +172,34 @@ def schedule_batch(node_state: dict, pod_batch: dict):
         assign = jnp.where(ok, best, jnp.int32(-1))
         return (used, nz_used, pod_count), (assign, masked[best])
 
-    carry0 = (node_state["used"], node_state["nonzero_used"],
-              node_state["pod_count"])
+    carry0 = (usage["used"], usage["nonzero_used"], usage["pod_count"])
     (used, nz_used, pod_count), (assign, scores) = lax.scan(
-        step, carry0, pod_batch)
+        step, carry0, per_pod)
     return assign, scores, {"used": used, "nonzero_used": nz_used,
                             "pod_count": pod_count}
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def apply_dirty(node_cfg: dict, usage: dict, idx: jnp.ndarray,
+                cfg_rows: dict, usage_rows: dict) -> Tuple[dict, dict]:
+    """Scatter O(delta) dirty rows (cache.go:210-246's generation scan,
+    shipped as one packed upload) into the device-resident state. Padded
+    slots carry idx = -1 and are dropped (out-of-bounds scatter mode)."""
+    new_cfg = {k: node_cfg[k].at[idx].set(cfg_rows[k], mode="drop")
+               for k in node_cfg}
+    new_usage = {k: usage[k].at[idx].set(usage_rows[k], mode="drop")
+                 for k in usage}
+    return new_cfg, new_usage
+
+
+@jax.jit
+def pack_results(assign: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+    """[2, P] int32 — assign and bitcast scores in ONE fetchable buffer so a
+    batch costs a single device->host round trip."""
+    return jnp.stack([assign, lax.bitcast_convert_type(scores, jnp.int32)])
+
+
+def unpack_results(packed) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    import numpy as np
+    arr = np.asarray(packed)
+    return arr[0], arr[1].view(np.float32)

@@ -192,12 +192,15 @@ class Scheduler:
         assumed_by_slot: List[Optional[Pod]] = []
         bindings: List[Binding] = []
         for res in bound:
-            assumed = serde.deepcopy_obj(res.pod)
+            assumed = serde.shallow_bind_clone(res.pod)
             assumed.spec.node_name = res.node_name
             try:
                 self.cache.assume_pod(assumed)
             except ValueError:
                 assumed_by_slot.append(None)  # duplicate event; skip bind
+                # the kernel counted this pod but no assume/forget will ever
+                # dirty the node row — adopted device usage is unrepairable
+                self.algorithm.mirror.invalidate_usage()
                 continue
             assumed_by_slot.append(assumed)
             bindings.append(Binding(

@@ -1,4 +1,5 @@
-"""Static score compilation: the non-resource priorities as [P, N] matrices.
+"""Static score compilation: the non-resource priorities as deduplicated
+per-node score rows.
 
 Ref: pkg/scheduler/algorithm/priorities/ and PrioritizeNodes
 (generic_scheduler.go:672-812). The reference runs Map per (priority, node)
@@ -6,12 +7,14 @@ then Reduce per priority over the FILTERED node list. Here:
 
   - raw per-node vectors are compiled on the host through the same term
     cache as the filter terms (pods sharing tolerations/affinity/images hit
-    the cache), stacked into [P, N] raw matrices,
+    the cache),
   - Reduce (NormalizeReduce / reversed / min-max / spread's zone blend) is
     vectorized numpy over the pod's statically-feasible node set,
-  - the weighted sum ships to the kernel as pod_batch["static_score"] and is
-    added to the on-device resource scores (LeastRequested/Balanced, which
-    the scan recomputes per step because they vary with in-batch usage).
+  - the weighted sum is computed ONCE per unique score key (pods of one
+    controller share terms, labels, and requests) and ships to the kernel as
+    pod_batch["unique_scores"] [S, N] + ["score_idx"] [P], added on device to
+    the resource scores (LeastRequested/Balanced, which the scan recomputes
+    per step because they vary with in-batch usage).
 
 Priorities whose contribution is CONSTANT over a pod's feasible nodes (e.g.
 TaintToleration when no node has PreferNoSchedule taints: all 10) are
@@ -53,6 +56,33 @@ def _canon_preferred_node_affinity(pod: Pod) -> Tuple:
         for t in aff.node_affinity.preferred_during_scheduling_ignored_during_execution)
 
 
+def _canon_pod_affinity(pod: Pod) -> Tuple:
+    """Canonical form of the pod's preferred (anti-)affinity terms — part of
+    the static-score dedupe key (scorer rows are shared across pods whose
+    affinity terms, labels, and namespace coincide)."""
+    aff = pod.spec.affinity
+    if not aff:
+        return ()
+
+    def canon_weighted(terms):
+        out = []
+        for wt in terms or []:
+            t = wt.pod_affinity_term
+            sel = labelsmod.canonical_selector(t.label_selector) \
+                if t.label_selector is not None else None
+            out.append((wt.weight, sel, t.topology_key,
+                        tuple(sorted(t.namespaces))))
+        return tuple(out)
+
+    pa = canon_weighted(
+        aff.pod_affinity.preferred_during_scheduling_ignored_during_execution
+        if aff.pod_affinity else None)
+    paa = canon_weighted(
+        aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution
+        if aff.pod_anti_affinity else None)
+    return (pa, paa)
+
+
 def _has_preferred_pod_affinity(pod: Pod) -> bool:
     aff = pod.spec.affinity
     return bool(aff and (
@@ -80,6 +110,7 @@ class ScoreCompiler:
         self._zone_ids: Optional[np.ndarray] = None
         self._any_prefer_taints = False
         self._any_avoid_annotations = False
+        self._cluster_has_affinity_pods = False
 
     # ------------------------------------------------------- cached vectors
 
@@ -173,81 +204,149 @@ class ScoreCompiler:
 
     # ------------------------------------------------------------- compile
 
-    def static_scores(self, pods: List[Pod], fits_provider
-                      ) -> Optional[np.ndarray]:
-        """[P, N] weighted static score (None = all-constant, skip upload).
-        fits_provider() lazily yields the batch-start feasibility mask the
-        reduces normalize over (the reference normalizes over filtered
-        nodes); it is only computed if some priority actually contributes."""
-        self._refresh_epoch()
+    def _pod_score_key(self, pod: Pod) -> Optional[Tuple]:
+        """Canonical key of everything that can make this pod's static score
+        row differ from another pod's — None when no priority can contribute
+        (the common resource-only case). Pods from one controller share the
+        key, so rows are computed once per controller, not once per pod."""
         w = self.weights
+        parts = []
+        contributes = False
+        if w.get("NodeAffinityPriority"):
+            k = _canon_preferred_node_affinity(pod)
+            parts.append(k)
+            contributes = contributes or bool(k)
+        if w.get("TaintTolerationPriority") and self._any_prefer_taints:
+            parts.append(_canon_tolerations(pod))
+            contributes = True
+        if w.get("ImageLocalityPriority") and self._any_images:
+            images = tuple(sorted({c.image for c in pod.spec.containers
+                                   if c.image}))
+            parts.append(images)
+            contributes = contributes or bool(images)
+        if w.get("NodePreferAvoidPodsPriority") and self._any_avoid_annotations:
+            ref = controller_ref(pod.metadata)
+            if ref is not None and ref.kind in ("ReplicationController",
+                                                "ReplicaSet"):
+                parts.append((ref.kind, ref.name))
+                contributes = True
+            else:
+                parts.append(None)
+        spread_or_interpod = False
+        if w.get("SelectorSpreadPriority") and self.listers is not None:
+            spread_or_interpod = True
+        if w.get("InterPodAffinityPriority") and (
+                _has_preferred_pod_affinity(pod) or
+                getattr(self, "_cluster_has_affinity_pods", False)):
+            spread_or_interpod = True
+        if spread_or_interpod:
+            parts.append((pod.metadata.namespace,
+                          tuple(sorted(pod.metadata.labels.items())),
+                          _canon_pod_affinity(pod)))
+            contributes = True
+        if not contributes:
+            return None
+        return tuple(parts)
+
+    def static_scores(self, pods: List[Pod], batch
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Deduplicated static scores: (score_idx [P], unique_rows [S, N]),
+        or None when no priority contributes for any pod (resource-only
+        batch — the device kernel needs no static term at all).
+
+        Each unique (score key, feasibility row) computes ONE weighted row;
+        reduces normalize over the representative pod's batch-start feasible
+        set (the reference normalizes over filtered nodes,
+        generic_scheduler.go PrioritizeNodes). `batch` is the
+        PodBatchTensors (for mask_idx/req identity and fits_row)."""
+        self._refresh_epoch()
         P = len(pods)
-        N = self.mirror.t.capacity
+        score_idx = np.zeros((P,), np.int32)
+        rows: List[np.ndarray] = [np.zeros((self.mirror.t.capacity,),
+                                           np.float32)]
+        row_of: Dict[Tuple, int] = {}
+        any_contrib = False
+        for i, pod in enumerate(pods):
+            skey = self._pod_score_key(pod)
+            if skey is None:
+                continue
+            # the feasible set (normalization domain) depends on the mask
+            # row, the request columns, and the pressure flag
+            key = (skey, int(batch.mask_idx[i]), batch.req[i].tobytes(),
+                   bool(batch.mem_pressure_blocked[i]))
+            u = row_of.get(key)
+            if u is None:
+                row = self._compute_row(pod, batch.fits_row(i))
+                if row is None:
+                    u = 0
+                else:
+                    rows.append(row)
+                    u = len(rows) - 1
+                row_of[key] = u
+            if u:
+                any_contrib = True
+            score_idx[i] = u
+        if not any_contrib:
+            return None
+        return score_idx, np.stack(rows)
+
+    def _compute_row(self, pod: Pod, fits: np.ndarray
+                     ) -> Optional[np.ndarray]:
+        """One pod's weighted static score row [N] (None = all-constant)."""
+        w = self.weights
+        meta = prios.PriorityMetadata(pod, self.listers)
         total: Optional[np.ndarray] = None
-        _fits: List[Optional[np.ndarray]] = [None]
 
-        def fits_mat() -> np.ndarray:
-            if _fits[0] is None:
-                _fits[0] = fits_provider()
-            return _fits[0]
-
-        def acc(i: int, vec: np.ndarray, weight: float):
+        def acc(vec: np.ndarray, weight: float):
             nonlocal total
             if total is None:
-                total = np.zeros((P, N), np.float32)
-            total[i] += weight * vec
+                total = np.zeros((self.mirror.t.capacity,), np.float32)
+            total += weight * vec
 
-        metas = [prios.PriorityMetadata(pod, self.listers) for pod in pods]
-
-        def feas_max(i: int, raw: np.ndarray) -> float:
-            vals = raw[fits_mat()[i]]
+        def feas_max(raw: np.ndarray) -> float:
+            vals = raw[fits]
             return float(vals.max()) if vals.size else 0.0
 
-        for i, pod in enumerate(pods):
-            meta = metas[i]
-            if w.get("NodeAffinityPriority"):
-                raw = self._node_affinity_raw(pod, meta)
-                if raw is not None:
-                    mx = feas_max(i, raw)
-                    if mx > 0:
-                        acc(i, np.floor(MAXP * raw / mx),
-                            w["NodeAffinityPriority"])
-            if w.get("TaintTolerationPriority"):
-                raw = self._taint_raw(pod, meta)
-                if raw is not None:
-                    mx = feas_max(i, raw)
-                    if mx > 0:  # reversed NormalizeReduce
-                        acc(i, MAXP - np.floor(MAXP * raw / mx),
-                            w["TaintTolerationPriority"])
-            if w.get("ImageLocalityPriority"):
-                raw = self._image_raw(pod, meta)
-                if raw is not None and raw.any():
-                    acc(i, raw, w["ImageLocalityPriority"])  # no reduce
-            if w.get("NodePreferAvoidPodsPriority"):
-                raw = self._avoid_raw(pod, meta)
-                if raw is not None:
-                    acc(i, raw, w["NodePreferAvoidPodsPriority"])
-            if w.get("SelectorSpreadPriority"):
-                counts = self._spread_counts(pod, meta)
-                if counts is not None and counts.any():
-                    acc(i, self._spread_reduce(i, counts, fits_mat()),
-                        w["SelectorSpreadPriority"])
-            if w.get("InterPodAffinityPriority"):
-                raw = self._interpod_raw(pod)
-                if raw is not None:
-                    frow = fits_mat()[i]
-                    mn = float(raw[frow].min()) if frow.any() else 0.0
-                    mx = float(raw[frow].max()) if frow.any() else 0.0
-                    if mx > mn:
-                        acc(i, np.floor(MAXP * (raw - mn) / (mx - mn)),
-                            w["InterPodAffinityPriority"])
+        if w.get("NodeAffinityPriority"):
+            raw = self._node_affinity_raw(pod, meta)
+            if raw is not None:
+                mx = feas_max(raw)
+                if mx > 0:
+                    acc(np.floor(MAXP * raw / mx), w["NodeAffinityPriority"])
+        if w.get("TaintTolerationPriority"):
+            raw = self._taint_raw(pod, meta)
+            if raw is not None:
+                mx = feas_max(raw)
+                if mx > 0:  # reversed NormalizeReduce
+                    acc(MAXP - np.floor(MAXP * raw / mx),
+                        w["TaintTolerationPriority"])
+        if w.get("ImageLocalityPriority"):
+            raw = self._image_raw(pod, meta)
+            if raw is not None and raw.any():
+                acc(raw, w["ImageLocalityPriority"])  # no reduce
+        if w.get("NodePreferAvoidPodsPriority"):
+            raw = self._avoid_raw(pod, meta)
+            if raw is not None:
+                acc(raw, w["NodePreferAvoidPodsPriority"])
+        if w.get("SelectorSpreadPriority"):
+            counts = self._spread_counts(pod, meta)
+            if counts is not None and counts.any():
+                acc(self._spread_reduce(counts, fits),
+                    w["SelectorSpreadPriority"])
+        if w.get("InterPodAffinityPriority"):
+            raw = self._interpod_raw(pod)
+            if raw is not None:
+                mn = float(raw[fits].min()) if fits.any() else 0.0
+                mx = float(raw[fits].max()) if fits.any() else 0.0
+                if mx > mn:
+                    acc(np.floor(MAXP * (raw - mn) / (mx - mn)),
+                        w["InterPodAffinityPriority"])
         return total
 
-    def _spread_reduce(self, i: int, counts: np.ndarray, fits: np.ndarray
+    def _spread_reduce(self, counts: np.ndarray, feas: np.ndarray
                        ) -> np.ndarray:
         """CalculateSpreadPriorityReduce with zone blending
         (selector_spreading.go zoneWeighting=2/3)."""
-        feas = fits[i]
         max_count = float(counts[feas].max()) if feas.any() else 0.0
         if max_count > 0:
             node_score = MAXP * (max_count - counts) / max_count
@@ -276,8 +375,8 @@ class ScoreCompiler:
         """Preferred inter-pod (anti-)affinity + symmetric hard credit.
         Host python over the snapshot (O(existing pods)); only runs when the
         pod or the cluster carries (anti-)affinity terms."""
-        cluster_has = getattr(self, "_cluster_has_affinity_pods", False)
-        if not _has_preferred_pod_affinity(pod) and not cluster_has:
+        if not _has_preferred_pod_affinity(pod) and \
+                not self._cluster_has_affinity_pods:
             return None
         node_infos = {name: self.mirror.infos[row]
                       for name, row in self.mirror.row_of.items()

@@ -8,20 +8,26 @@ interface calls of findNodesThatFit/PrioritizeNodes
      NodeInfo snapshot (column schema from nodeinfo.Resource, ref:
      pkg/scheduler/nodeinfo/node_info.go:139-148). Updated incrementally from
      the cache's generation-ordered dirty list (ref: cache.go:210-246), so a
-     steady-state cycle ships O(delta) rows to HBM, not O(nodes).
+     steady-state cycle ships O(delta) rows to HBM, not O(nodes). Device
+     state is split into `cfg` (bind-invariant: alloc, flags) and `usage`
+     (bind-varying: used, counts) so a queue drain can chain usage on device
+     across batches while cfg stays put.
 
   2. TermCompiler — label selectors, taints/tolerations, host ports and
      hostname constraints compiled into cached per-node boolean vectors.
      String matching never reaches the device: every unique term is evaluated
      once per node-epoch against the snapshot (pods in one Deployment share
-     selectors, so the cache hit rate is ~1), and kernels consume the stacked
-     [P, N] static mask.
+     selectors, so the cache hit rate is ~1).
 
   3. PodBatchTensors — the pod-axis arrays for one scheduling batch:
-     requests, non-zero requests, flags, and the static feasibility mask.
+     requests, non-zero requests, flags, and the DEDUPLICATED static
+     feasibility mask: unique_masks [U, N] + mask_idx [P]. Pods sharing
+     constraint terms share a row, so per-batch host->device traffic is
+     O(P*R + U*N) instead of O(P*N) — critical when the TPU sits behind a
+     high-latency tunnel.
 
-Padding: node and pod axes are padded to bucketed sizes (powers of two) so
-XLA compiles one kernel per bucket instead of one per cluster size.
+Padding: node, pod, and unique-row axes are padded to bucketed sizes (powers
+of two) so XLA compiles one kernel per bucket instead of one per cluster size.
 """
 
 from __future__ import annotations
@@ -42,6 +48,9 @@ COL_CPU = 0      # milliCPU
 COL_MEM = 1      # bytes
 COL_EPH = 2      # bytes
 N_FIXED_COLS = 3
+
+CFG_KEYS = ("alloc", "max_pods", "node_ok", "mem_pressure", "valid")
+USAGE_KEYS = ("used", "nonzero_used", "pod_count")
 
 
 def _bucket(n: int, minimum: int = 128) -> int:
@@ -71,7 +80,7 @@ class ResourceVocab:
 
 
 class NodeTensors:
-    """Host-side numpy mirror; `device()` returns the jnp pytree."""
+    """Host-side numpy mirror of per-node state."""
 
     def __init__(self, capacity: int, n_cols: int):
         self.capacity = capacity
@@ -92,6 +101,12 @@ class NodeTensors:
                 "node_ok": self.node_ok, "mem_pressure": self.mem_pressure,
                 "valid": self.valid}
 
+    def cfg_arrays(self) -> Dict[str, np.ndarray]:
+        return {k: getattr(self, k) for k in CFG_KEYS}
+
+    def usage_arrays(self) -> Dict[str, np.ndarray]:
+        return {k: getattr(self, k) for k in USAGE_KEYS}
+
 
 class TensorMirror:
     """Name <-> row mapping plus incremental row updates from cache dirties."""
@@ -108,7 +123,8 @@ class TensorMirror:
         #: bumped on any node change; TermCompiler cache epoch
         self.epoch = 0
         self._dirty_rows: set = set()
-        self._device_state: Optional[dict] = None
+        self._device_cfg: Optional[dict] = None
+        self._device_usage: Optional[dict] = None
 
     # ------------------------------------------------------------ updates
 
@@ -142,7 +158,8 @@ class TensorMirror:
         self.t = t
         self._free.extend(range(n, new_capacity))
         self.infos.extend([None] * (new_capacity - n))
-        self._device_state = None  # shapes changed; full re-upload
+        self._device_cfg = None  # shapes changed; full re-upload
+        self._device_usage = None
         self._dirty_rows.clear()
 
     def ensure_cols(self) -> None:
@@ -157,7 +174,8 @@ class TensorMirror:
                 else:
                     arr[...] = src
             self.t = t
-            self._device_state = None
+            self._device_cfg = None
+            self._device_usage = None
             self._dirty_rows.clear()
 
     def _write_row(self, name: str, ni: NodeInfo) -> None:
@@ -223,27 +241,61 @@ class TensorMirror:
 
     # ------------------------------------------------------------- device
 
-    def device_state(self) -> dict:
-        """The node-state pytree on device; incremental row scatter for small
-        deltas, full upload otherwise."""
+    def device_cfg_usage(self) -> Tuple[dict, dict]:
+        """The (node_cfg, usage) pytrees on device. Dirty rows ship as ONE
+        packed scatter (kernels.apply_dirty); full upload only after a
+        capacity/column resize."""
         import jax.numpy as jnp
-        host = self.t.arrays()
-        if self._device_state is None or \
-                len(self._dirty_rows) > self.t.capacity // 4:
-            self._device_state = {k: jnp.asarray(v) for k, v in host.items()}
+        t = self.t
+        if self._device_cfg is None or self._device_usage is None:
+            # resize or invalidate_usage: both re-uploaded from host truth
+            self._device_cfg = {k: jnp.asarray(v)
+                                for k, v in t.cfg_arrays().items()}
+            self._device_usage = {k: jnp.asarray(v)
+                                  for k, v in t.usage_arrays().items()}
         elif self._dirty_rows:
-            idx = jnp.asarray(sorted(self._dirty_rows), dtype=jnp.int32)
-            rows = {k: jnp.asarray(v[np.array(sorted(self._dirty_rows))])
-                    for k, v in host.items()}
-            self._device_state = {
-                k: self._device_state[k].at[idx].set(rows[k])
-                for k in self._device_state}
+            from .kernels.batch import apply_dirty
+            idx = np.fromiter(self._dirty_rows, dtype=np.int32,
+                              count=len(self._dirty_rows))
+            D = _bucket(len(idx), minimum=8)
+            # pad with an out-of-range row; apply_dirty drops it
+            pad = np.full((D,), t.capacity, np.int32)
+            pad[:len(idx)] = idx
+            cfg_rows = {k: _padded_rows(v, idx, D)
+                        for k, v in t.cfg_arrays().items()}
+            usage_rows = {k: _padded_rows(v, idx, D)
+                          for k, v in t.usage_arrays().items()}
+            self._device_cfg, self._device_usage = apply_dirty(
+                self._device_cfg, self._device_usage,
+                jnp.asarray(pad), cfg_rows, usage_rows)
         self._dirty_rows.clear()
-        return self._device_state
+        return self._device_cfg, self._device_usage
+
+    def adopt_usage(self, usage: dict) -> None:
+        """Adopt the kernel's post-batch usage (device-side chaining). Safe
+        whenever every assignment in the batch was committed via assume_pod:
+        the cache bumps those nodes' generations, so the next dirty scatter
+        rewrites the same rows with identical host-truth values (idempotent);
+        rows the host disagrees on (forgotten binds, node churn) are repaired
+        by that same scatter. An assignment that never reaches assume_pod
+        leaves no dirty row — callers must invalidate_usage() instead."""
+        self._device_usage = usage
+
+    def invalidate_usage(self) -> None:
+        """Drop adopted device usage; the next device_cfg_usage() re-uploads
+        from host truth. Called when an assumed bind was dropped without a
+        cache forget (no dirty row would repair the adopted tensors)."""
+        self._device_usage = None
 
     @property
     def n_rows(self) -> int:
         return len(self.row_of)
+
+
+def _padded_rows(arr: np.ndarray, idx: np.ndarray, D: int) -> np.ndarray:
+    out = np.zeros((D,) + arr.shape[1:], arr.dtype)
+    out[:len(idx)] = arr[idx]
+    return out
 
 
 # --------------------------------------------------------------- terms
@@ -307,11 +359,12 @@ class TermCompiler:
             ("sel", _canon_node_selector(pod)),
             lambda ni: helpers.pod_matches_node_selector_and_affinity(pod, ni.node))
 
-    def host_ports_vector(self, pod: Pod) -> np.ndarray:
-        """True where the pod's host ports are free (PodFitsHostPorts)."""
+    def host_ports_vector(self, pod: Pod) -> Optional[np.ndarray]:
+        """True where the pod's host ports are free (PodFitsHostPorts).
+        None when the pod wants no host ports (no constraint)."""
         wanted = helpers.pod_host_ports(pod)
         if not wanted:
-            return np.ones((self.mirror.t.capacity,), bool)
+            return None
 
         def free(ni: NodeInfo) -> bool:
             for proto, ip, port in wanted:
@@ -336,7 +389,16 @@ class TermCompiler:
 # --------------------------------------------------------------- pod batch
 
 class PodBatchTensors:
-    """Pod-axis arrays for one batch, padded to a pod bucket."""
+    """Pod-axis arrays for one batch, padded to a pod bucket.
+
+    The static feasibility mask is deduplicated: `unique_masks [U, N]` holds
+    one row per distinct constraint-term set, `mask_idx [P]` points each pod
+    at its row. Pods from one controller share every term, so U stays O(few)
+    while P is thousands — the device upload shrinks accordingly. Static
+    priority scores use the same scheme (`unique_scores [S, N]`, `score_idx
+    [P]`, filled by core.BatchScheduler from ScoreCompiler output; default is
+    a single all-zeros row meaning "only on-device resource priorities").
+    """
 
     def __init__(self, pods: List[Pod], mirror: TensorMirror,
                  terms: TermCompiler, extra_mask: Optional[np.ndarray] = None,
@@ -362,15 +424,15 @@ class PodBatchTensors:
         self.nonzero_req = np.zeros((P, 2), np.float32)
         self.mem_pressure_blocked = np.zeros((P,), bool)
         self.active = np.zeros((P,), bool)
-        self.static_mask = np.zeros((P, N), bool)
         # tie-break rotation, persistent across batches like the reference's
         # lastNodeIndex (generic_scheduler.go:286-296)
         self.seq = (seq_base + np.arange(P, dtype=np.int64)) \
             .astype(np.int32) & 0x7FFFFFFF
-        # batch-invariant priority scores, filled by ScoreCompiler (zeros =
-        # only the on-device resource priorities contribute)
-        self.static_score = np.zeros((P, N), np.float32)
+        self.mask_idx = np.zeros((P,), np.int32)
         self._mirror = mirror
+
+        uniq: Dict[Tuple, int] = {}
+        rows: List[np.ndarray] = []
         for i, pod in enumerate(pods):
             reqs = pod_reqs[i]
             for rname, v in reqs.items():
@@ -393,29 +455,58 @@ class PodBatchTensors:
                     [_pressure_taint(wellknown.TAINT_NODE_MEMORY_PRESSURE)],
                     effects=["NoSchedule"]))
             self.active[i] = True
-            mask = terms.tolerations_vector(pod) & \
-                terms.node_selector_vector(pod) & \
-                terms.host_ports_vector(pod)
-            hv = terms.hostname_vector(pod)
-            if hv is not None:
-                mask = mask & hv
-            if extra_mask is not None:
-                mask = mask & extra_mask[i]
-            self.static_mask[i] = mask
 
-    def static_fits(self) -> np.ndarray:
-        """Batch-start feasibility [P_real, N] on host numpy — the node set
-        the score reduces normalize over (the reference normalizes over
-        filtered nodes, generic_scheduler.go PrioritizeNodes)."""
+            has_extra = extra_mask is not None and not extra_mask[i].all()
+            key: Tuple = (_canon_tolerations(pod), _canon_node_selector(pod),
+                          tuple(sorted(helpers.pod_host_ports(pod))),
+                          pod.spec.node_name or "",
+                          extra_mask[i].tobytes() if has_extra else None)
+            u = uniq.get(key)
+            if u is None:
+                mask = terms.tolerations_vector(pod) & \
+                    terms.node_selector_vector(pod)
+                pv = terms.host_ports_vector(pod)
+                if pv is not None:
+                    mask = mask & pv
+                hv = terms.hostname_vector(pod)
+                if hv is not None:
+                    mask = mask & hv
+                if has_extra:
+                    mask = mask & extra_mask[i]
+                u = len(rows)
+                uniq[key] = u
+                rows.append(mask)
+            self.mask_idx[i] = u
+        U = _bucket(len(rows), minimum=1)
+        self.unique_masks = np.zeros((U, N), bool)
+        if rows:
+            self.unique_masks[:len(rows)] = np.stack(rows)
+        self.n_unique_masks = len(rows)
+        # score dedupe table; default single zero row (resource-only scoring)
+        self.score_idx = np.zeros((P,), np.int32)
+        self.unique_scores = np.zeros((1, N), np.float32)
+
+    def set_static_scores(self, score_idx: np.ndarray,
+                          unique_scores: np.ndarray) -> None:
+        """Install ScoreCompiler output (S-bucketed unique score rows)."""
+        S = _bucket(unique_scores.shape[0], minimum=1)
+        padded = np.zeros((S, self.unique_scores.shape[1]), np.float32)
+        padded[:unique_scores.shape[0]] = unique_scores
+        self.unique_scores = padded
+        self.score_idx[:len(score_idx)] = score_idx
+
+    def _base_ok(self) -> np.ndarray:
         t = self._mirror.t
-        P_real = len(self.pods)
-        base = t.node_ok & t.valid & (t.pod_count + 1.0 <= t.max_pods)
-        fits = self.static_mask[:P_real] & base[None, :]
-        blocked = self.mem_pressure_blocked[:P_real]
-        fits &= ~(blocked[:, None] & t.mem_pressure[None, :])
+        return t.node_ok & t.valid & (t.pod_count + 1.0 <= t.max_pods)
+
+    def fits_row(self, i: int) -> np.ndarray:
+        """One pod's batch-start feasibility [N] on host numpy."""
+        t = self._mirror.t
+        fits = self.unique_masks[self.mask_idx[i]] & self._base_ok()
+        if self.mem_pressure_blocked[i]:
+            fits = fits & ~t.mem_pressure
         free = t.alloc - t.used
-        for r in range(t.n_cols):
-            fits &= self.req[:P_real, r:r + 1] <= free[None, :, r]
+        fits = fits & (self.req[i][None, :] <= free).all(axis=1)
         return fits
 
     def device(self) -> dict:
@@ -424,6 +515,8 @@ class PodBatchTensors:
                 "nonzero_req": jnp.asarray(self.nonzero_req),
                 "mem_pressure_blocked": jnp.asarray(self.mem_pressure_blocked),
                 "active": jnp.asarray(self.active),
-                "static_mask": jnp.asarray(self.static_mask),
-                "static_score": jnp.asarray(self.static_score),
-                "seq": jnp.asarray(self.seq)}
+                "seq": jnp.asarray(self.seq),
+                "mask_idx": jnp.asarray(self.mask_idx),
+                "score_idx": jnp.asarray(self.score_idx),
+                "unique_masks": jnp.asarray(self.unique_masks),
+                "unique_scores": jnp.asarray(self.unique_scores)}

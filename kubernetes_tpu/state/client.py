@@ -131,7 +131,8 @@ class PodClient(ResourceClient):
         bind)."""
         items = [(b.metadata.namespace or self._effective_ns(),
                   b.metadata.name, _bind_mutator(b)) for b in bindings]
-        return self._store.bulk_apply("pods", items)
+        return self._store.bulk_apply("pods", items,
+                                      copy_fn=serde.shallow_bind_clone)
 
 
 def _set_pod_condition(pod, ctype: str, status: str, reason: str) -> None:

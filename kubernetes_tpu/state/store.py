@@ -28,8 +28,9 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from ..api import serde
 
@@ -95,8 +96,9 @@ class Store:
         self._rv = 0
         # resource -> {(namespace, name) -> (obj, rv)}
         self._data: Dict[str, Dict[Tuple[str, str], Tuple[Any, int]]] = {}
-        # ring of (rv, resource, WatchEvent)
-        self._history: List[Tuple[int, str, WatchEvent]] = []
+        # ring of (rv, resource, WatchEvent); trimmed to HISTORY_WINDOW at
+        # publish (O(1) popleft, honors runtime window changes)
+        self._history: Deque[Tuple[int, str, WatchEvent]] = deque()
         self._watches: Dict[int, Tuple[str, Optional[str], Watch]] = {}
         self._next_watch_id = 0
         self._uid_counter = 0
@@ -187,6 +189,7 @@ class Store:
 
     def bulk_apply(self, resource: str,
                    items: List[Tuple[str, str, Callable[[Any], Any]]],
+                   copy_fn: Callable[[Any], Any] = serde.deepcopy_obj,
                    ) -> List[Any]:
         """Apply N read-modify-write mutations under ONE lock acquisition.
 
@@ -194,7 +197,9 @@ class Store:
         phase turns one-bind-POST-per-pod (ref: scheduler.go:549 -> pod/rest
         BindingREST) into a single store transaction. Each (namespace, name,
         mutate) gets a fresh copy of the live object; a mutate may raise to
-        skip its item (the error is recorded in the result slot).
+        skip its item (the error is recorded in the result slot). A caller
+        whose mutate only touches known layers may pass a cheaper copy_fn
+        (e.g. serde.shallow_bind_clone for the bind subresource).
         """
         out: List[Any] = []
         events: List[Tuple[str, WatchEvent]] = []
@@ -207,7 +212,7 @@ class Store:
                     out.append(NotFoundError(f"{resource} {key} not found"))
                     continue
                 try:
-                    updated = mutate(serde.deepcopy_obj(existing[0]))
+                    updated = mutate(copy_fn(existing[0]))
                 except Exception as e:  # mutate rejected the object
                     out.append(e)
                     continue
@@ -296,11 +301,13 @@ class Store:
         # the event shares the canonical frozen object: consumers must not
         # mutate delivered objects (the client-go informer contract)
         self._history.append((ev.resource_version, resource, ev))
-        if len(self._history) > self.HISTORY_WINDOW:
-            self._history = self._history[-self.HISTORY_WINDOW:]
-        for res, ns, w in list(self._watches.values()):
-            if res == resource and (ns is None or ev.object.metadata.namespace == ns):
-                w.events.put(ev)
+        while len(self._history) > self.HISTORY_WINDOW:
+            self._history.popleft()
+        if self._watches:
+            for res, ns, w in list(self._watches.values()):
+                if res == resource and (ns is None or
+                                        ev.object.metadata.namespace == ns):
+                    w.events.put(ev)
 
     def _remove_watch(self, wid: int) -> None:
         with self._lock:

@@ -36,17 +36,21 @@ def test_sharded_matches_single_device():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from kubernetes_tpu.scheduler.kernels.batch import schedule_batch
 
-    node_state, pod_batch = __graft_entry__._example_state(P=32, N=512)
-    single_assign, _, _ = schedule_batch(node_state, pod_batch)
+    node_cfg, usage, pod_batch = __graft_entry__._example_state(P=32, N=512)
+    single_assign, _, _ = schedule_batch(node_cfg, usage, pod_batch)
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
     def shard(arr, spec):
         return jax.device_put(jax.numpy.asarray(arr), NamedSharding(mesh, spec))
-    st = {k: shard(v, P("nodes") if np.asarray(v).ndim == 1 else P("nodes", None))
-          for k, v in node_state.items()}
-    pb = {k: shard(v, P(None, "nodes") if k == "static_mask" else P())
+    def node_sharded(d):
+        return {k: shard(v, P("nodes") if np.asarray(v).ndim == 1
+                         else P("nodes", None)) for k, v in d.items()}
+    cfg_s = node_sharded(node_cfg)
+    usage_s = node_sharded(usage)
+    pb = {k: shard(v, P(None, "nodes")
+                   if k in ("unique_masks", "unique_scores") else P())
           for k, v in pod_batch.items()}
     with mesh:
-        sharded_assign, _, _ = schedule_batch(st, pb)
+        sharded_assign, _, _ = schedule_batch(cfg_s, usage_s, pb)
     np.testing.assert_array_equal(np.asarray(single_assign),
                                   np.asarray(sharded_assign))

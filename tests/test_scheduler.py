@@ -249,7 +249,8 @@ class TestKernelParity:
         from kubernetes_tpu.scheduler.kernels import filter_score
         from kubernetes_tpu.scheduler.tensorize import PodBatchTensors
         batch = PodBatchTensors(pods, sched.mirror, sched.terms)
-        fits, score = filter_score(sched.mirror.device_state(), batch.device())
+        node_cfg, usage = sched.mirror.device_cfg_usage()
+        fits, score = filter_score(node_cfg, usage, batch.device())
         fits = np.asarray(fits)
         score = np.asarray(score)
         weights = {"LeastRequestedPriority": 1, "BalancedResourceAllocation": 1}
