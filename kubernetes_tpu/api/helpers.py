@@ -33,7 +33,15 @@ def pod_requests(pod: Pod) -> Dict[str, int]:
 
     sum(containers) elementwise-max max(initContainers), plus overhead.
     Ref: nodeinfo.CalculateResource (node_info.go:443-470).
+
+    Memoized per PodSpec (requests are immutable once created; the memo
+    rides along on shallow bind clones, which share containers). Callers
+    must treat the returned dict as read-only.
     """
+    spec = pod.spec
+    cached = spec.__dict__.get("_req_cache")
+    if cached is not None:
+        return cached
     totals: Dict[str, int] = {}
     for c in pod.spec.containers:
         for name, q in c.resources.requests.items():
@@ -45,6 +53,7 @@ def pod_requests(pod: Pod) -> Dict[str, int]:
                 totals[name] = v
     for name, q in pod.spec.overhead.items():
         totals[name] = totals.get(name, 0) + _scheduler_units(name, q)
+    spec.__dict__["_req_cache"] = totals
     return totals
 
 
@@ -85,12 +94,18 @@ def node_allocatable(node: Node) -> Dict[str, int]:
 
 
 def pod_host_ports(pod: Pod) -> List[tuple]:
-    """(protocol, hostIP, hostPort) triples (ref: host_ports.go)."""
+    """(protocol, hostIP, hostPort) triples (ref: host_ports.go).
+    Memoized per PodSpec; treat the returned list as read-only."""
+    spec = pod.spec
+    cached = spec.__dict__.get("_ports_cache")
+    if cached is not None:
+        return cached
     out = []
-    for c in pod.spec.containers:
+    for c in spec.containers:
         for p in c.ports:
             if p.host_port > 0:
                 out.append((p.protocol or "TCP", p.host_ip or "0.0.0.0", p.host_port))
+    spec.__dict__["_ports_cache"] = out
     return out
 
 

@@ -164,13 +164,22 @@ def shallow_bind_clone(pod: T) -> T:
     sizes; the reference pays one API round trip per bind instead
     (scheduler.go:549). Sharing is safe under the store's read-only
     discipline: both the old and new canonical objects are frozen.
+
+    Uses raw __dict__ copies instead of copy.copy: these are plain
+    dataclasses (no __slots__), and skipping the __reduce_ex__ protocol is
+    ~4x faster on the 50k-pod bench.
     """
-    import copy as _copy
-    new = _copy.copy(pod)
-    new.metadata = _copy.copy(pod.metadata)
-    new.spec = _copy.copy(pod.spec)
-    new.status = _copy.copy(pod.status)
-    new.status.conditions = [_copy.copy(c) for c in pod.status.conditions]
+    new = _dict_copy(pod)
+    new.metadata = _dict_copy(pod.metadata)
+    new.spec = _dict_copy(pod.spec)
+    new.status = _dict_copy(pod.status)
+    new.status.conditions = [_dict_copy(c) for c in pod.status.conditions]
+    return new
+
+
+def _dict_copy(obj):
+    new = object.__new__(obj.__class__)
+    new.__dict__ = obj.__dict__.copy()
     return new
 
 

@@ -48,6 +48,7 @@ class Cache:
         self._clock = clock
         self._ttl = ttl
         self._lock = threading.RLock()
+        self.mutation_seq = 0
         self._generation = itertools.count(1)
         self._nodes: Dict[str, NodeInfo] = {}
         # pod key -> (pod, node_name); membership in _assumed marks in-flight
@@ -58,6 +59,10 @@ class Cache:
 
     def _bump(self, ni: NodeInfo) -> None:
         ni.generation = next(self._generation)
+        # monotonic mutation counter: the pipelined drain chains device usage
+        # only while every mutation since its last launch came from its own
+        # assume_pod calls (scheduler.drain_pipelined's chain_seq check)
+        self.mutation_seq += 1
 
     def _node_info(self, name: str) -> NodeInfo:
         ni = self._nodes.get(name)
@@ -78,6 +83,14 @@ class Cache:
             self._bump(ni)
             self._pod_states[key] = pod
             self._assumed.add(key)
+
+    def assigned_node(self, key: str) -> Optional[str]:
+        """Node the cache currently holds this pod on (None if absent) —
+        the bind path uses it to tell its own racing confirm event apart
+        from a genuine duplicate."""
+        with self._lock:
+            pod = self._pod_states.get(key)
+            return pod.spec.node_name if pod is not None else None
 
     def finish_binding(self, pod: Pod) -> None:
         with self._lock:

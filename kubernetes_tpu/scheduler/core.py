@@ -70,6 +70,19 @@ class ScheduleResult:
     retry: bool = False               # lost an in-batch conflict; requeue
 
 
+@dataclass
+class PendingBatch:
+    """A dispatched-but-unfetched batch (schedule_launch output): the device
+    scan runs while the host commits the previous batch."""
+    pods: List[Pod]
+    metas: Dict[int, "preds.PredicateMetadata"]
+    batch: PodBatchTensors
+    packed: object                    # [2, P] device handle (assign+scores)
+    new_usage: dict                   # device usage after this batch
+    residual_free: bool               # no repair possible -> usage chainable
+    usage_epoch: int = 0              # mirror.usage_epoch at launch
+
+
 def _pod_has_conflict_volumes(pod: Pod) -> bool:
     for v in pod.spec.volumes:
         if v.gce_persistent_disk or v.aws_elastic_block_store or v.rbd or v.iscsi:
@@ -107,6 +120,8 @@ class BatchScheduler:
                 else prios_mod.HARD_POD_AFFINITY_WEIGHT))
         self._seq_base = 0  # selectHost round-robin state across batches
         self._has_affinity_pods = False
+        # True while host-computed static scores contribute (chain pre-check)
+        self._static_likely = False
 
     def refresh(self) -> None:
         dirty = self.cache.update_snapshot(self.snapshot)
@@ -251,33 +266,110 @@ class BatchScheduler:
         When the batch needed no host-side repair, the kernel's post-batch
         usage is adopted on device (TensorMirror.adopt_usage), so the next
         batch's scatter only rewrites rows the host actually disagrees on."""
-        if not pods:
+        pending = self.schedule_launch(pods)
+        if pending is None:
             return []
-        from .kernels.batch import (pack_results, schedule_batch,
-                                    unpack_results)
-        self.refresh()
+        return self.schedule_finish(pending)
+
+    def schedule_launch(self, pods: List[Pod],
+                        chain: Optional["PendingBatch"] = None,
+                        chain_seq: Optional[int] = None
+                        ) -> Optional["PendingBatch"]:
+        """Front half of a batch: refresh + tensorize + device dispatch.
+        Returns a PendingBatch whose results are fetched by schedule_finish —
+        the device scan runs while the caller does host work (the pipelined
+        drain overlaps batch N+1's kernel with batch N's bind/assume).
+
+        `chain` pipelines this launch on the previous one *before its results
+        are committed*: the kernel's usage input is the chain's post-batch
+        device handle instead of the mirror's. Honored only when that handle
+        is provably host truth + the chain's own assignments:
+          - the chain batch is residual-free (no repair can demote a winner),
+          - every cache mutation since the drain's bookkeeping point came
+            from the drain's own assumes (cache.mutation_seq == chain_seq),
+          - device state survived (no capacity/column resize), and
+          - this batch carries no host-computed static scores (they would be
+            one batch staler than the sequential path).
+        Otherwise returns None and the caller must flush the pipeline and
+        relaunch unchained."""
+        if not pods:
+            return None
+        from .kernels.batch import pack_results, schedule_batch
+        dirty = self.cache.update_snapshot(self.snapshot)
+        chaining = (chain is not None and chain.residual_free
+                    and chain_seq is not None
+                    and self.cache.mutation_seq == chain_seq
+                    and not self._static_likely
+                    and self.mirror.device_ready()
+                    # the NEW batch's residual predicates (anti-affinity /
+                    # disk / PVC) would be evaluated against a snapshot that
+                    # excludes the chain's uncommitted winners — sequential
+                    # path only for such batches
+                    and not any(self._needs_residual(p) for p in pods))
+        if chaining:
+            self.mirror.apply_chained(self.snapshot, dirty)
+        else:
+            # the dirty list is consumed either way — a chain refusal must
+            # still apply it, or the mirror would never see these updates
+            # (update_snapshot won't return them again)
+            self.mirror.apply(self.snapshot, dirty)
+            if dirty:
+                self._has_affinity_pods = any(
+                    ni.pods_with_affinity
+                    for ni in self.snapshot.node_infos.values())
+                self.scorer.set_cluster_has_affinity_pods(self._has_affinity_pods)
+            if chain is not None:
+                return None
         extra_mask, metas = self._residual_mask(pods)
+        if chaining and extra_mask is not None:
+            return None  # unreachable given the _needs_residual guard; belt
+        residual_free = extra_mask is None and not any(
+            helpers.pod_host_ports(p) or _pod_has_conflict_volumes(p)
+            for p in pods)
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
         static = self.scorer.static_scores(pods, batch)
+        # hysteresis: while static scores are in play, later launches refuse
+        # the chain up front (before tensorize) instead of discarding work
+        self._static_likely = static is not None
         if static is not None:
+            if chaining:
+                return None  # host scores would lag the uncommitted chain
             batch.set_static_scores(*static)
-        node_cfg, usage = self.mirror.device_cfg_usage()
+        if chaining and not self.mirror.device_ready():
+            return None  # tensorize grew the column axis; chain handle stale
+        if chaining:
+            node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
+        else:
+            node_cfg, usage = self.mirror.device_cfg_usage()
         assign_d, scores_d, new_usage = schedule_batch(node_cfg, usage,
                                                        batch.device())
-        assign, scores = unpack_results(pack_results(assign_d, scores_d))
+        return PendingBatch(pods=pods, metas=metas, batch=batch,
+                            packed=pack_results(assign_d, scores_d),
+                            new_usage=new_usage,
+                            residual_free=residual_free,
+                            usage_epoch=self.mirror.usage_epoch)
+
+    def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
+        """Back half: fetch results, host repair, adopt chained usage."""
+        from .kernels.batch import unpack_results
+        assign, scores = unpack_results(pending.packed)
         out: List[ScheduleResult] = []
-        for i, pod in enumerate(pods):
+        for i, pod in enumerate(pending.pods):
             row = int(assign[i])
             name = self.mirror.name_of.get(row) if row >= 0 else None
             out.append(ScheduleResult(pod, name, float(scores[i])))
-        self._repair_batch(out, metas)
-        if not any(r.retry for r in out):
+        self._repair_batch(out, pending.metas)
+        if not any(r.retry for r in out) and \
+                pending.usage_epoch == self.mirror.usage_epoch:
             # every surviving assignment flows through cache.assume_pod, so
-            # the chained usage matches host truth (or gets scatter-repaired)
-            self.mirror.adopt_usage(new_usage)
+            # the chained usage matches host truth (or gets scatter-repaired).
+            # An epoch mismatch means invalidate_usage fired after this
+            # batch launched: its usage input carries the phantom state that
+            # invalidation dropped — re-adopting would resurrect it.
+            self.mirror.adopt_usage(pending.new_usage)
         return out
 
     def explain(self, pod: Pod) -> FitError:

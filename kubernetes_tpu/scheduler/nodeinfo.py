@@ -66,13 +66,25 @@ class Resource:
 
 
 def pod_resource(pod: Pod) -> Resource:
-    return Resource.from_request_map(helpers.pod_requests(pod))
+    """Memoized per PodSpec — callers treat the Resource as read-only."""
+    spec = pod.spec
+    cached = spec.__dict__.get("_res_cache")
+    if cached is None:
+        cached = Resource.from_request_map(helpers.pod_requests(pod))
+        spec.__dict__["_res_cache"] = cached
+    return cached
 
 
 def pod_resource_nonzero(pod: Pod) -> Tuple[int, int]:
     """(milliCPU, memory) with non-zero defaults (ref: non_zero.go)."""
-    r = helpers.pod_requests_nonzero(pod)
-    return r.get(wellknown.RESOURCE_CPU, 0), r.get(wellknown.RESOURCE_MEMORY, 0)
+    spec = pod.spec
+    cached = spec.__dict__.get("_nz_cache")
+    if cached is None:
+        r = helpers.pod_requests_nonzero(pod)
+        cached = (r.get(wellknown.RESOURCE_CPU, 0),
+                  r.get(wellknown.RESOURCE_MEMORY, 0))
+        spec.__dict__["_nz_cache"] = cached
+    return cached
 
 
 def pod_has_affinity_constraints(pod: Pod) -> bool:

@@ -170,6 +170,13 @@ class Scheduler:
     def _schedule_batch_locked(self, pods: List[Pod], cycle: int
                                ) -> List[ScheduleResult]:
         results = self.algorithm.schedule(pods)
+        self._commit_results(results, cycle)
+        return results
+
+    def _commit_results(self, results: List[ScheduleResult], cycle: int) -> int:
+        """Requeue retries, park unschedulables, bind+assume winners.
+        Returns the number of successful assumes (one cache mutation each —
+        the pipelined drain's chain_seq bookkeeping)."""
         bound: List[ScheduleResult] = []
         for res in results:
             if res.node_name is None:
@@ -181,54 +188,123 @@ class Scheduler:
             else:
                 bound.append(res)
         if bound:
-            self._assume_and_bind_all(bound)
-        return results
+            return self._assume_and_bind_all(bound)
+        return 0
 
-    def _assume_and_bind_all(self, bound: List[ScheduleResult]) -> None:
-        """Ref: scheduler.go assume :382 + bind :411 — batched: assume the
-        whole batch into the cache, then issue every bind as ONE store
-        transaction (bind_bulk) instead of a POST per pod."""
-        from ..state.store import NotFoundError
-        assumed_by_slot: List[Optional[Pod]] = []
-        bindings: List[Binding] = []
+    def drain_pipelined(self) -> int:
+        """Drain the queue with device/host overlap: batch N+1's kernel runs
+        on device (usage chained from batch N's dispatch, ahead of its host
+        commit) while batch N's results are repaired, bound, and assumed on
+        host. Chaining is refused — and the pipeline falls back to the
+        sequential path — whenever any cache mutation did not come from this
+        drain's own assumes (cache.mutation_seq bookkeeping), the previous
+        batch could be repaired on host, static scores are in play, or
+        device state was resized. Returns the number of pods bound."""
+        start = self.scheduled_count
+        prev: Optional[tuple] = None        # (PendingBatch, cycle)
+        expected_seq: Optional[int] = None
+        def _mark(n: int) -> None:
+            self._in_flight += n
+        try:
+            while True:
+                cycle = self.queue.scheduling_cycle
+                pods = self.queue.pop_batch(self.batch_size, timeout=0,
+                                            on_pop=_mark)
+                if not pods and prev is None:
+                    break
+                pending = None
+                if pods:
+                    if prev is not None and expected_seq is not None:
+                        pending = self.algorithm.schedule_launch(
+                            pods, chain=prev[0], chain_seq=expected_seq)
+                    if pending is None:
+                        if prev is not None:
+                            expected_seq = self._finish_and_commit(
+                                prev[0], prev[1], expected_seq)
+                            prev = None
+                        pre_seq = self.cache.mutation_seq
+                        pending = self.algorithm.schedule_launch(pods)
+                        expected_seq = pre_seq
+                if prev is not None:
+                    expected_seq = self._finish_and_commit(
+                        prev[0], prev[1], expected_seq)
+                prev = (pending, cycle) if pending is not None else None
+        finally:
+            self._in_flight = 0
+        return self.scheduled_count - start
+
+    def _finish_and_commit(self, pending, cycle: int,
+                           expected_seq: Optional[int]) -> Optional[int]:
+        results = self.algorithm.schedule_finish(pending)
+        n_assumed = self._commit_results(results, cycle)
+        self._in_flight -= len(results)
+        if expected_seq is None:
+            return None
+        return expected_seq + n_assumed
+
+    def _assume_and_bind_all(self, bound: List[ScheduleResult]) -> int:
+        """Ref: scheduler.go assume :382 + bind :411 — batched and inverted:
+        the whole batch is bound as ONE store transaction (bind_bulk), then
+        each successfully bound pod is assumed into the cache using the
+        store's own bound object — one clone per pod instead of two, and no
+        forget path (a pod whose bind failed was never assumed).
+
+        The reference assumes *before* its async bind goroutine so the next
+        scheduleOne sees the pod; here bind is synchronous within the same
+        cycle, so assume-after-bind exposes the same states to observers."""
+        from ..state.store import ConflictError, NotFoundError
+        fresh: List[ScheduleResult] = []
         for res in bound:
-            assumed = serde.shallow_bind_clone(res.pod)
-            assumed.spec.node_name = res.node_name
-            try:
-                self.cache.assume_pod(assumed)
-            except ValueError:
-                assumed_by_slot.append(None)  # duplicate event; skip bind
-                # the kernel counted this pod but no assume/forget will ever
-                # dirty the node row — adopted device usage is unrepairable
+            if self.cache.assigned_node(res.pod.metadata.key()) is not None:
+                # duplicate event: the pod is already in the cache (assumed
+                # or confirmed) from an earlier cycle — never re-bind; the
+                # kernel double-counted it and no forget will repair that
                 self.algorithm.mirror.invalidate_usage()
                 continue
-            assumed_by_slot.append(assumed)
-            bindings.append(Binding(
-                metadata=ObjectMeta(name=res.pod.metadata.name,
-                                    namespace=res.pod.metadata.namespace),
-                target=ObjectReference(kind="Node", name=res.node_name)))
-        outs = iter(self.client.pods().bind_bulk(bindings)) if bindings else iter(())
-        for res, assumed in zip(bound, assumed_by_slot):
-            if assumed is None:
-                continue
-            out = next(outs)
+            fresh.append(res)
+        bound = fresh
+        bindings = [Binding(
+            metadata=ObjectMeta(name=res.pod.metadata.name,
+                                namespace=res.pod.metadata.namespace),
+            target=ObjectReference(kind="Node", name=res.node_name))
+            for res in bound]
+        outs = self.client.pods().bind_bulk(bindings)
+        n_assumed = 0
+        for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
-                self.cache.finish_binding(assumed)
+                try:
+                    self.cache.assume_pod(out)
+                    n_assumed += 1
+                except ValueError:
+                    if self.cache.assigned_node(
+                            out.metadata.key()) == res.node_name:
+                        # our own bind's MODIFIED event raced ahead through
+                        # the informer thread: the cache already counts this
+                        # pod exactly once on the right node — nothing to fix
+                        pass
+                    else:
+                        # a true duplicate: the kernel counted this pod once
+                        # more than assume/forget ever will — adopted device
+                        # usage is unrepairable
+                        self.algorithm.mirror.invalidate_usage()
+                else:
+                    self.cache.finish_binding(out)
                 self.scheduled_count += 1
                 continue
-            try:
-                self.cache.forget_pod(assumed)
-            except ValueError:
-                # the informer already confirmed/fixed-up this pod (bind
-                # events publish before this loop runs); nothing to undo
+            # any failed bind is a kernel winner that will never be assumed:
+            # no dirty row can repair its phantom usage on device
+            # (tensorize.adopt_usage contract) — drop the adopted tensors
+            self.algorithm.mirror.invalidate_usage()
+            if isinstance(out, (NotFoundError, ConflictError)):
+                # deleted while in flight, or a racing duplicate already
+                # bound it elsewhere: drop, don't requeue forever
                 continue
-            if isinstance(out, NotFoundError):
-                continue  # deleted while in flight: drop, don't requeue forever
             pod = res.pod
             if pod.metadata.deletion_timestamp is not None:
                 continue
             self.queue.add_unschedulable_if_not_present(
                 pod, self.queue.scheduling_cycle)
+        return n_assumed
 
     def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
         self.unschedulable_count += 1

@@ -237,7 +237,7 @@ class ScoreCompiler:
             spread_or_interpod = True
         if w.get("InterPodAffinityPriority") and (
                 _has_preferred_pod_affinity(pod) or
-                getattr(self, "_cluster_has_affinity_pods", False)):
+                self._cluster_has_affinity_pods):
             spread_or_interpod = True
         if spread_or_interpod:
             parts.append((pod.metadata.namespace,

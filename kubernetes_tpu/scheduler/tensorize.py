@@ -125,6 +125,9 @@ class TensorMirror:
         self._dirty_rows: set = set()
         self._device_cfg: Optional[dict] = None
         self._device_usage: Optional[dict] = None
+        #: bumped by invalidate_usage; pending batches launched before an
+        #: invalidation must not adopt_usage their (phantom-carrying) output
+        self.usage_epoch = 0
 
     # ------------------------------------------------------------ updates
 
@@ -142,6 +145,32 @@ class TensorMirror:
                 self._remove_row(name)
             else:
                 self._write_row(name, ni)
+
+    def apply_chained(self, snapshot: Snapshot, dirty_names: Sequence[str]) -> None:
+        """Host-row updates whose device effect already rides in a chained
+        usage handle (the dirt is the pipelined drain's own assumes of
+        residual-free pods: usage columns only — no label/taint/port/cfg
+        changes, so the term-cache epoch survives). Rows stay queued in
+        _dirty_rows: the next non-chained device_cfg_usage scatter rewrites
+        them with identical host-truth values (idempotent) or corrects any
+        foreign mutation that slipped past the chain_seq guard."""
+        for name in dirty_names:
+            ni = snapshot.node_infos.get(name)
+            if ni is None or ni.node is None:
+                self._remove_row(name)
+            else:
+                self._write_row(name, ni)
+
+    def device_ready(self) -> bool:
+        """False after a capacity/column resize or invalidate_usage dropped
+        device state (chaining callers must fall back to a full upload)."""
+        return self._device_cfg is not None and self._device_usage is not None
+
+    def device_cfg(self) -> dict:
+        """The device cfg handle for a chained dispatch (device_ready() must
+        be True; usage comes from the chain, not the mirror)."""
+        assert self._device_cfg is not None
+        return self._device_cfg
 
     def _grow(self, new_capacity: int) -> None:
         old = self.t
@@ -284,8 +313,11 @@ class TensorMirror:
     def invalidate_usage(self) -> None:
         """Drop adopted device usage; the next device_cfg_usage() re-uploads
         from host truth. Called when an assumed bind was dropped without a
-        cache forget (no dirty row would repair the adopted tensors)."""
+        cache forget (no dirty row would repair the adopted tensors).
+        Bumps usage_epoch so an in-flight PendingBatch whose usage input
+        predates the invalidation cannot re-adopt phantom state."""
         self._device_usage = None
+        self.usage_epoch += 1
 
     @property
     def n_rows(self) -> int:
@@ -403,6 +435,7 @@ class PodBatchTensors:
     def __init__(self, pods: List[Pod], mirror: TensorMirror,
                  terms: TermCompiler, extra_mask: Optional[np.ndarray] = None,
                  min_bucket: int = 8, seq_base: int = 0):
+        from .nodeinfo import pod_resource, pod_resource_nonzero
         self.pods = pods
         P = _bucket(len(pods), min_bucket)
         vocab = mirror.vocab
@@ -417,6 +450,12 @@ class PodBatchTensors:
                                  wellknown.RESOURCE_PODS):
                     vocab.col(rname)
             pod_reqs.append(reqs)
+            # warm the per-spec Resource/nonzero/ports memos on the canonical
+            # pod here, off the assume path: the bind clone copies spec's
+            # __dict__, so cache.assume_pod's NodeInfo.add_pod re-uses them
+            pod_resource(pod)
+            pod_resource_nonzero(pod)
+            helpers.pod_host_ports(pod)
         mirror.ensure_cols()
         R = mirror.t.n_cols
         N = mirror.t.capacity
@@ -431,52 +470,81 @@ class PodBatchTensors:
         self.mask_idx = np.zeros((P,), np.int32)
         self._mirror = mirror
 
+        # Pods stamped from one controller template share requests, QoS,
+        # tolerations, and constraint terms; dedupe the per-pod numeric work
+        # by template signature and fill rows with one gather per array.
         uniq: Dict[Tuple, int] = {}
         rows: List[np.ndarray] = []
+        tmpl: Dict[Tuple, int] = {}
+        tmpl_req: List[np.ndarray] = []
+        tmpl_nz: List[Tuple[float, float]] = []
+        tmpl_blocked: List[bool] = []
+        tmpl_mask: List[int] = []
+        tmpl_idx = np.zeros((P,), np.int32)
         for i, pod in enumerate(pods):
             reqs = pod_reqs[i]
-            for rname, v in reqs.items():
-                if rname == wellknown.RESOURCE_CPU:
-                    self.req[i, COL_CPU] = v
-                elif rname == wellknown.RESOURCE_MEMORY:
-                    self.req[i, COL_MEM] = v
-                elif rname == wellknown.RESOURCE_EPHEMERAL_STORAGE:
-                    self.req[i, COL_EPH] = v
-                elif rname == wellknown.RESOURCE_PODS:
-                    pass
-                else:
-                    self.req[i, vocab.col(rname)] = v
-            nz = helpers.pod_requests_nonzero(pod)
-            self.nonzero_req[i, 0] = nz.get(wellknown.RESOURCE_CPU, 0)
-            self.nonzero_req[i, 1] = nz.get(wellknown.RESOURCE_MEMORY, 0)
-            self.mem_pressure_blocked[i] = (
-                _pod_qos(pod) == "BestEffort" and not helpers.tolerates_taints(
-                    pod.spec.tolerations,
-                    [_pressure_taint(wellknown.TAINT_NODE_MEMORY_PRESSURE)],
-                    effects=["NoSchedule"]))
-            self.active[i] = True
-
             has_extra = extra_mask is not None and not extra_mask[i].all()
-            key: Tuple = (_canon_tolerations(pod), _canon_node_selector(pod),
-                          tuple(sorted(helpers.pod_host_ports(pod))),
-                          pod.spec.node_name or "",
-                          extra_mask[i].tobytes() if has_extra else None)
-            u = uniq.get(key)
-            if u is None:
-                mask = terms.tolerations_vector(pod) & \
-                    terms.node_selector_vector(pod)
-                pv = terms.host_ports_vector(pod)
-                if pv is not None:
-                    mask = mask & pv
-                hv = terms.hostname_vector(pod)
-                if hv is not None:
-                    mask = mask & hv
-                if has_extra:
-                    mask = mask & extra_mask[i]
-                u = len(rows)
-                uniq[key] = u
-                rows.append(mask)
-            self.mask_idx[i] = u
+            ckey = (_canon_tolerations(pod), _canon_node_selector(pod),
+                    tuple(sorted(helpers.pod_host_ports(pod))),
+                    pod.spec.node_name or "",
+                    extra_mask[i].tobytes() if has_extra else None)
+            # _pod_qos inspects per-container requests/limits (aggregate maps
+            # can't distinguish init-container-only BestEffort pods), so the
+            # QoS class itself is the template key component
+            tkey = (tuple(sorted(reqs.items())),
+                    _pod_qos(pod) == "BestEffort", ckey)
+            t_i = tmpl.get(tkey)
+            if t_i is None:
+                req_row = np.zeros((R,), np.float32)
+                for rname, v in reqs.items():
+                    if rname == wellknown.RESOURCE_CPU:
+                        req_row[COL_CPU] = v
+                    elif rname == wellknown.RESOURCE_MEMORY:
+                        req_row[COL_MEM] = v
+                    elif rname == wellknown.RESOURCE_EPHEMERAL_STORAGE:
+                        req_row[COL_EPH] = v
+                    elif rname == wellknown.RESOURCE_PODS:
+                        pass
+                    else:
+                        req_row[vocab.col(rname)] = v
+                nz = helpers.pod_requests_nonzero(pod)
+                blocked = (
+                    _pod_qos(pod) == "BestEffort" and not helpers.tolerates_taints(
+                        pod.spec.tolerations,
+                        [_pressure_taint(wellknown.TAINT_NODE_MEMORY_PRESSURE)],
+                        effects=["NoSchedule"]))
+                u = uniq.get(ckey)
+                if u is None:
+                    mask = terms.tolerations_vector(pod) & \
+                        terms.node_selector_vector(pod)
+                    pv = terms.host_ports_vector(pod)
+                    if pv is not None:
+                        mask = mask & pv
+                    hv = terms.hostname_vector(pod)
+                    if hv is not None:
+                        mask = mask & hv
+                    if has_extra:
+                        mask = mask & extra_mask[i]
+                    u = len(rows)
+                    uniq[ckey] = u
+                    rows.append(mask)
+                t_i = len(tmpl_req)
+                tmpl[tkey] = t_i
+                tmpl_req.append(req_row)
+                tmpl_nz.append((nz.get(wellknown.RESOURCE_CPU, 0),
+                                nz.get(wellknown.RESOURCE_MEMORY, 0)))
+                tmpl_blocked.append(blocked)
+                tmpl_mask.append(u)
+            tmpl_idx[i] = t_i
+        n = len(pods)
+        if tmpl_req:
+            idx = tmpl_idx[:n]
+            self.req[:n] = np.stack(tmpl_req)[idx]
+            self.nonzero_req[:n] = np.asarray(tmpl_nz, np.float32)[idx]
+            self.mem_pressure_blocked[:n] = \
+                np.asarray(tmpl_blocked, bool)[idx]
+            self.mask_idx[:n] = np.asarray(tmpl_mask, np.int32)[idx]
+        self.active[:n] = True
         U = _bucket(len(rows), minimum=1)
         self.unique_masks = np.zeros((U, N), bool)
         if rows:

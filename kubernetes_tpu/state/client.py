@@ -104,14 +104,14 @@ class ResourceClient:
         return self._store.list(self._resource, ns if self._namespaced else None)
 
 
-def _bind_mutator(binding: corev1.Binding):
+def _bind_mutator(binding: corev1.Binding, now: Optional[str] = None):
     def mutate(pod):
         if pod.spec.node_name and pod.spec.node_name != binding.target.name:
             from .store import ConflictError
             raise ConflictError(
                 f"pod {pod.metadata.name} is already bound to {pod.spec.node_name}")
         pod.spec.node_name = binding.target.name
-        _set_pod_condition(pod, "PodScheduled", "True", "")
+        _set_pod_condition(pod, "PodScheduled", "True", "", now=now)
         return pod
     return mutate
 
@@ -129,23 +129,27 @@ class PodClient(ResourceClient):
         phase). Result slots are bound Pods or the Exception that rejected
         that slot (NotFound for deleted-in-flight, Conflict for double
         bind)."""
+        from ..utils.clock import now_iso
+        now = now_iso()  # one timestamp per transaction, not one per pod
         items = [(b.metadata.namespace or self._effective_ns(),
-                  b.metadata.name, _bind_mutator(b)) for b in bindings]
+                  b.metadata.name, _bind_mutator(b, now=now)) for b in bindings]
         return self._store.bulk_apply("pods", items,
                                       copy_fn=serde.shallow_bind_clone)
 
 
-def _set_pod_condition(pod, ctype: str, status: str, reason: str) -> None:
+def _set_pod_condition(pod, ctype: str, status: str, reason: str,
+                       now: Optional[str] = None) -> None:
     from ..utils.clock import now_iso
     for cond in pod.status.conditions:
         if cond.type == ctype:
             if cond.status != status:
                 cond.status = status
                 cond.reason = reason
-                cond.last_transition_time = now_iso()
+                cond.last_transition_time = now or now_iso()
             return
     pod.status.conditions.append(corev1.PodCondition(
-        type=ctype, status=status, reason=reason, last_transition_time=now_iso()))
+        type=ctype, status=status, reason=reason,
+        last_transition_time=now or now_iso()))
 
 
 class Client:

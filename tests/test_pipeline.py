@@ -1,0 +1,137 @@
+"""Pipelined drain (scheduler.drain_pipelined): device/host overlap with
+usage chained on device ahead of the host commit.
+
+Parity property: for residual-free batches the chained usage handle equals
+the usage a sequential drain would upload, so the pipelined drain must make
+IDENTICAL bind decisions to schedule_pending run to exhaustion. Chain-refusal
+paths (foreign cache mutations, static scores, repairable batches) must fall
+back to the sequential semantics, never drop pods.
+"""
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Client
+
+
+def make_pod(i, cpu="100m", mem="128Mi"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"pod-{i}", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu), "memory": Quantity(mem)}))]))
+
+
+def make_node(i, cpu="2", mem="4Gi", pods=16):
+    alloc = {"cpu": Quantity(cpu), "memory": Quantity(mem),
+             "pods": Quantity(pods)}
+    return api.Node(
+        metadata=api.ObjectMeta(
+            name=f"node-{i}",
+            labels={api.wellknown.LABEL_HOSTNAME: f"node-{i}"}),
+        status=api.NodeStatus(capacity=dict(alloc), allocatable=dict(alloc),
+                              conditions=[api.NodeCondition(type="Ready",
+                                                            status="True")]))
+
+
+def build(n_nodes, n_pods, batch_size, shapes=(("100m", "128Mi"),
+                                               ("250m", "512Mi"),
+                                               ("500m", "1Gi"))):
+    client = Client(validate=False)
+    sched = Scheduler(client, batch_size=batch_size)
+    for i in range(n_nodes):
+        node = make_node(i)
+        client.nodes().create(node)
+        sched.cache.add_node(node)
+    for i in range(n_pods):
+        cpu, mem = shapes[i % len(shapes)]
+        pod = client.pods().create(make_pod(i, cpu, mem))
+        sched.queue.add(pod)
+    return client, sched
+
+
+def bind_map(client):
+    pods, _ = client.pods().list_rv(namespace=None)
+    return {p.metadata.name: p.spec.node_name for p in pods}
+
+
+def test_pipelined_drain_matches_sequential():
+    """Multi-batch drain: pipelined decisions == sequential decisions."""
+    client_a, sched_a = build(16, 96, batch_size=16)
+    while sched_a.schedule_pending(timeout=0):
+        pass
+    client_b, sched_b = build(16, 96, batch_size=16)
+    n = sched_b.drain_pipelined()
+    assert n == 96
+    assert bind_map(client_a) == bind_map(client_b)
+
+
+def test_pipelined_drain_respects_capacity():
+    """More pods than capacity: winners fill every slot, losers park."""
+    # 4 nodes x 4 pod slots = 16 slots, 40 pods
+    client = Client(validate=False)
+    sched = Scheduler(client, batch_size=8)
+    for i in range(4):
+        node = make_node(i, pods=4)
+        client.nodes().create(node)
+        sched.cache.add_node(node)
+    shapes = (("100m", "128Mi"), ("250m", "512Mi"), ("500m", "1Gi"))
+    for i in range(40):
+        cpu, mem = shapes[i % 3]
+        pod = client.pods().create(make_pod(i, cpu, mem))
+        sched.queue.add(pod)
+    n = sched.drain_pipelined()
+    assert n == 16
+    bound = [v for v in bind_map(client).values() if v]
+    assert len(bound) == 16
+    per_node = {}
+    for node in bound:
+        per_node[node] = per_node.get(node, 0) + 1
+    assert all(c == 4 for c in per_node.values())
+    assert sched.queue.num_pending() == 40 - 16
+
+
+def test_pipelined_drain_chain_broken_by_foreign_mutation():
+    """A cache mutation from outside the drain must not poison decisions:
+    run a drain, mutate, drain again — final state honors the mutation."""
+    client, sched = build(8, 24, batch_size=8)
+    assert sched.drain_pipelined() == 24
+    # foreign mutation: a new empty node joins
+    node = make_node(100)
+    client.nodes().create(node)
+    sched.cache.add_node(node)
+    for i in range(200, 208):
+        pod = client.pods().create(make_pod(i, "500m", "1Gi"))
+        sched.queue.add(pod)
+    assert sched.drain_pipelined() == 8
+    # the fresh node is emptiest: LeastRequested must put pods there
+    assert any(v == "node-100" for v in bind_map(client).values())
+
+
+def test_pipelined_drain_with_host_port_pods_falls_back():
+    """Port-carrying pods make batches non-chainable (repair may demote);
+    the drain must still schedule correctly via the sequential fallback."""
+    client = Client(validate=False)
+    sched = Scheduler(client, batch_size=4)
+    for i in range(3):
+        node = make_node(i, pods=32)
+        client.nodes().create(node)
+        sched.cache.add_node(node)
+    for i in range(6):
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name=f"port-{i}", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                ports=[api.ContainerPort(container_port=80, host_port=8080)],
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("100m")}))]))
+        pod = client.pods().create(pod)
+        sched.queue.add(pod)
+    n = sched.drain_pipelined()
+    # only 3 nodes -> only 3 pods can hold hostPort 8080
+    assert n == 3
+    holders = [v for v in bind_map(client).values() if v]
+    assert sorted(holders) == ["node-0", "node-1", "node-2"]
