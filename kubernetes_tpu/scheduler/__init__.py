@@ -6,12 +6,12 @@ per iteration: scheduleOne -> findNodesThatFit -> PrioritizeNodes -> bind, with
 
 This package replaces that with a batched TPU design:
   - the scheduler cache mirrors cluster state into dense host tensors with
-    generation-based O(delta) incremental updates (cache.py, snapshot.py)
+    generation-based O(delta) incremental updates (cache.py, tensorize.py)
   - Filter becomes a pods x nodes feasibility mask and Score a pods x nodes
-    score matrix, computed by jax kernels in one shot (kernels/)
+    score matrix, computed by jax kernels in one shot (kernels/batch.py)
   - host-side assignment binds a whole batch while preserving the reference's
     serial decision semantics (core.py); an on-device lax.scan assignment
-    kernel removes the host loop entirely (kernels/assign.py)
+    kernel removes the host loop entirely (kernels/batch.py)
 
 Python implementations of every predicate/priority (predicates.py,
 priorities.py) are the semantic source of truth the kernels are parity-tested

@@ -14,7 +14,7 @@ Pod state machine (interface.go:40-120):
 Snapshots are O(delta): every NodeInfo mutation bumps a global monotonic
 generation; `update_snapshot` copies only nodes whose generation exceeds the
 snapshot's (ref: cache.go:210-246 UpdateNodeInfoSnapshot). The same dirty feed
-drives the incremental tensor mirror (snapshot.py).
+drives the incremental tensor mirror (tensorize.py).
 """
 
 from __future__ import annotations

@@ -6,7 +6,8 @@ metadata.go, csi_volume_predicate.go}. The default provider registers 14
 predicates.Ordering() (predicates.go:143-149).
 
 On TPU the same semantics run as a pods x nodes mask kernel
-(kernels/filter.py); these functions are the parity oracle and the host path
+(tensorize.py + kernels/batch.py); these functions are the parity oracle
+and the host path
 for preemption's AddPod/RemovePod incremental re-evaluation.
 
 Each predicate: (pod, meta, node_info) -> (fits: bool, reasons: list[str]).

@@ -7,7 +7,7 @@ node) in Map/Reduce form (priorities/types.go), then weight-summed
 (generic_scheduler.go:767-772).
 
 The TPU path computes the same arithmetic as a pods x nodes f32 matrix
-(kernels/score.py); these functions are the parity oracle.
+(scorer.py + kernels/batch.py); these functions are the parity oracle.
 """
 
 from __future__ import annotations

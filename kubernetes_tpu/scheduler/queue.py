@@ -112,6 +112,10 @@ class SchedulingQueue:
         self._unschedulable: Dict[str, _PodInfo] = {}
         self._pod_info: Dict[str, _PodInfo] = {}
         self._in_active: set = set()
+        # key -> the one live heap entry; a priority update re-pushes and
+        # repoints this, turning the old tuple into a skipped stale entry
+        # (ref: activeQ.Update reorders the heap, scheduling_queue.go:268)
+        self._active_entry: Dict[str, Tuple[int, float, int, str]] = {}
         self._in_backoff: set = set()
         self.backoff_map = PodBackoffMap(clock)
         self.nominated = NominatedPodMap()
@@ -137,11 +141,19 @@ class SchedulingQueue:
             key = new.metadata.key()
             info = self._pod_info.get(key)
             if info is not None:
+                old_prio = helpers.pod_priority(info.pod)
                 info.pod = new
                 self.nominated.add(new)
                 if key in self._unschedulable and _spec_changed(old, new):
                     # updated pods get another chance immediately (:268-292)
                     del self._unschedulable[key]
+                    self._push_active(key, info)
+                    self._cond.notify_all()
+                elif key in self._in_active and \
+                        helpers.pod_priority(new) != old_prio:
+                    # re-heapify: stale entry is invalidated by repointing
+                    # _active_entry (ref: activeQ.Update reorders the heap)
+                    self._in_active.discard(key)
                     self._push_active(key, info)
                     self._cond.notify_all()
             else:
@@ -153,6 +165,7 @@ class SchedulingQueue:
             self._pod_info.pop(key, None)
             self._unschedulable.pop(key, None)
             self._in_active.discard(key)
+            self._active_entry.pop(key, None)
             self._in_backoff.discard(key)
             self.nominated.delete(pod)
             self.backoff_map.clear(key)
@@ -161,7 +174,9 @@ class SchedulingQueue:
         if key in self._in_active:
             return
         prio = helpers.pod_priority(info.pod)
-        heapq.heappush(self._active, (-prio, info.timestamp, next(self._seq), key))
+        entry = (-prio, info.timestamp, next(self._seq), key)
+        heapq.heappush(self._active, entry)
+        self._active_entry[key] = entry
         self._in_active.add(key)
 
     # ----------------------------------------------------------- popping
@@ -203,16 +218,26 @@ class SchedulingQueue:
             self._scheduling_cycle += 1
             out: List[Pod] = []
             while self._active and len(out) < max_pods:
-                _, _, _, key = heapq.heappop(self._active)
-                if key not in self._in_active:
-                    continue  # stale heap entry (pod was deleted)
+                entry = heapq.heappop(self._active)
+                key = entry[3]
+                if key not in self._in_active or \
+                        self._active_entry.get(key) is not entry:
+                    continue  # stale entry (pod deleted or re-prioritized)
                 self._in_active.discard(key)
+                del self._active_entry[key]
                 # popped pods leave the pending set; a failed attempt re-adds
                 # them via add_unschedulable_if_not_present (ref: Pop removes
                 # from activeQ; in-flight pods live only in the cycle)
                 info = self._pod_info.pop(key, None)
-                if info is not None:
-                    out.append(info.pod)
+                if info is None:
+                    continue
+                if info.pod.metadata.deletion_timestamp is not None:
+                    # deleting pods never schedule (ref: scheduleOne skips
+                    # pods with a DeletionTimestamp, scheduler.go:445-455)
+                    self.backoff_map.clear(key)
+                    self.nominated.delete(info.pod)
+                    continue
+                out.append(info.pod)
             if on_pop is not None and out:
                 on_pop(len(out))
             return out

@@ -125,6 +125,8 @@ class Scheduler:
                 self.cache.add_pod(pod)
                 self.queue.assigned_pod_updated(pod)
         elif self._responsible(pod):
+            if pod.metadata.deletion_timestamp is not None:
+                return  # deleting pods never enter the queue (scheduleOne skip)
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
@@ -139,6 +141,9 @@ class Scheduler:
                 self.queue.delete(new)
                 self.queue.assigned_pod_updated(new)
         elif self._responsible(new):
+            if new.metadata.deletion_timestamp is not None:
+                self.queue.delete(new)
+                return
             self.queue.update(old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:

@@ -58,6 +58,27 @@ def _bucket(n: int, minimum: int = 128) -> int:
     return max(minimum, 1 << max(0, math.ceil(math.log2(max(1, n)))))
 
 
+def _f32_floor(v) -> np.float32:
+    """Largest float32 <= v. Applied to allocatable so the f32 tensor can
+    only UNDER-state capacity: quantities beyond the 24-bit mantissa (memory
+    > 16 GiB at byte granularity) round conservatively instead of allowing
+    overcommit (the parity oracle in predicates.py stays exact int64).
+    Residual: used-sums accumulate at most n_pods ulps of over-statement,
+    also in the safe direction (requests are _f32_ceil'd)."""
+    f = np.float32(v)
+    if f > v:
+        f = np.nextafter(f, np.float32(-np.inf))
+    return f
+
+
+def _f32_ceil(v) -> np.float32:
+    """Smallest float32 >= v (pod requests round up — see _f32_floor)."""
+    f = np.float32(v)
+    if f < v:
+        f = np.nextafter(f, np.float32(np.inf))
+    return f
+
+
 class ResourceVocab:
     """Interned scalar-resource names -> tensor columns."""
 
@@ -220,17 +241,17 @@ class TensorMirror:
         self.ensure_cols()
         t = self.t
         t.alloc[row, :] = 0.0
-        t.alloc[row, COL_CPU] = ni.allocatable.milli_cpu
-        t.alloc[row, COL_MEM] = ni.allocatable.memory
-        t.alloc[row, COL_EPH] = ni.allocatable.ephemeral_storage
+        t.alloc[row, COL_CPU] = _f32_floor(ni.allocatable.milli_cpu)
+        t.alloc[row, COL_MEM] = _f32_floor(ni.allocatable.memory)
+        t.alloc[row, COL_EPH] = _f32_floor(ni.allocatable.ephemeral_storage)
         for rname, v in ni.allocatable.scalar_resources.items():
-            t.alloc[row, self.vocab.col(rname)] = v
+            t.alloc[row, self.vocab.col(rname)] = _f32_floor(v)
         t.used[row, :] = 0.0
-        t.used[row, COL_CPU] = ni.requested.milli_cpu
-        t.used[row, COL_MEM] = ni.requested.memory
-        t.used[row, COL_EPH] = ni.requested.ephemeral_storage
+        t.used[row, COL_CPU] = _f32_ceil(ni.requested.milli_cpu)
+        t.used[row, COL_MEM] = _f32_ceil(ni.requested.memory)
+        t.used[row, COL_EPH] = _f32_ceil(ni.requested.ephemeral_storage)
         for rname, v in ni.requested.scalar_resources.items():
-            t.used[row, self.vocab.col(rname)] = v
+            t.used[row, self.vocab.col(rname)] = _f32_ceil(v)
         t.nonzero_used[row, 0] = ni.non_zero_requested.milli_cpu
         t.nonzero_used[row, 1] = ni.non_zero_requested.memory
         t.pod_count[row] = len(ni.pods)
@@ -498,15 +519,15 @@ class PodBatchTensors:
                 req_row = np.zeros((R,), np.float32)
                 for rname, v in reqs.items():
                     if rname == wellknown.RESOURCE_CPU:
-                        req_row[COL_CPU] = v
+                        req_row[COL_CPU] = _f32_ceil(v)
                     elif rname == wellknown.RESOURCE_MEMORY:
-                        req_row[COL_MEM] = v
+                        req_row[COL_MEM] = _f32_ceil(v)
                     elif rname == wellknown.RESOURCE_EPHEMERAL_STORAGE:
-                        req_row[COL_EPH] = v
+                        req_row[COL_EPH] = _f32_ceil(v)
                     elif rname == wellknown.RESOURCE_PODS:
                         pass
                     else:
-                        req_row[vocab.col(rname)] = v
+                        req_row[vocab.col(rname)] = _f32_ceil(v)
                 nz = helpers.pod_requests_nonzero(pod)
                 blocked = (
                     _pod_qos(pod) == "BestEffort" and not helpers.tolerates_taints(

@@ -207,6 +207,29 @@ class TestSchedulingQueue:
         q.delete(pod)
         assert q.pop_batch(1, timeout=0) == []
 
+    def test_update_reheapifies_on_priority_change(self):
+        """activeQ.Update must reorder the heap when priority changes
+        (scheduling_queue.go:268; advisor round-1 low finding)."""
+        q = SchedulingQueue(clock=FakeClock())
+        q.add(make_pod("a", priority=1))
+        q.add(make_pod("b", priority=5))
+        raised = make_pod("a", priority=50)
+        q.update(make_pod("a", priority=1), raised)
+        batch = q.pop_batch(2, timeout=0)
+        assert [p.metadata.name for p in batch] == ["a", "b"]
+        assert batch[0].spec.priority == 50
+
+    def test_deleting_pod_never_pops(self):
+        """Pods with a deletion timestamp are dropped at pop time
+        (ref: scheduleOne skips DeletionTimestamp pods)."""
+        q = SchedulingQueue(clock=FakeClock())
+        doomed = make_pod("doomed")
+        doomed.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+        q.add(doomed)
+        q.add(make_pod("ok"))
+        batch = q.pop_batch(5, timeout=0)
+        assert [p.metadata.name for p in batch] == ["ok"]
+
 
 def build_scheduler_state(nodes, existing_pods):
     cache = Cache()
