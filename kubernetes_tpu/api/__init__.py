@@ -7,7 +7,9 @@ from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
                    Endpoints, Event, Namespace, Node, NodeAffinity,
                    NodeCondition, NodeSelector, NodeSelectorRequirement,
                    NodeSelectorTerm, NodeSpec, NodeStatus, ObjectReference,
-                   PersistentVolume, PersistentVolumeClaim, Pod, PodAffinity,
+                   PersistentVolume, PersistentVolumeClaim,
+                   PersistentVolumeClaimSpec, PersistentVolumeClaimVolumeSource,
+                   PersistentVolumeSpec, Pod, PodAffinity,
                    PodAffinityTerm, PodAntiAffinity, PodCondition, PodSpec,
                    PodStatus, PodTemplateSpec, PreferredSchedulingTerm,
                    ReplicationController, ResourceRequirements, Service,

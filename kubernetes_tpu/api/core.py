@@ -169,6 +169,9 @@ class Volume:
     aws_elastic_block_store: Optional[dict] = None
     rbd: Optional[dict] = None
     iscsi: Optional[dict] = None
+    # attach-limited sources counted by Max*VolumeCount predicates
+    azure_disk: Optional[dict] = None
+    csi: Optional[dict] = None
 
 
 @dataclass
@@ -420,6 +423,11 @@ class PersistentVolumeSpec:
     storage_class_name: str = ""
     claim_ref: Optional[dict] = None
     node_affinity: Optional[dict] = None  # VolumeNodeAffinity{required: NodeSelector}
+    # volume sources resolved by Max*VolumeCount / NoDiskConflict through PVCs
+    gce_persistent_disk: Optional[dict] = None
+    aws_elastic_block_store: Optional[dict] = None
+    azure_disk: Optional[dict] = None
+    csi: Optional[dict] = None  # {driver, volumeHandle}
 
 
 @dataclass

@@ -90,6 +90,15 @@ def _pod_has_conflict_volumes(pod: Pod) -> bool:
     return False
 
 
+def _pod_has_attach_volumes(pod: Pod) -> bool:
+    """Direct attach-limited sources (CSI reaches pods only via PVCs, which
+    _pod_has_pvc covers)."""
+    for v in pod.spec.volumes:
+        if v.gce_persistent_disk or v.aws_elastic_block_store or v.azure_disk:
+            return True
+    return False
+
+
 def _pod_has_pvc(pod: Pod) -> bool:
     return any(v.persistent_volume_claim for v in pod.spec.volumes)
 
@@ -109,6 +118,10 @@ class BatchScheduler:
         self._zone_conflict = preds.no_volume_zone_conflict_factory(
             pvc_lister or (lambda ns, name: None),
             pv_lister or (lambda name: None))
+        # Max{EBS,GCEPD,AzureDisk,CSI}VolumeCount — default-set members
+        # (defaults.go:40-56), host-evaluated on the residual path
+        self._volume_count_preds = preds.default_max_volume_count_predicates(
+            pvc_lister, pv_lister)
         self.cache = cache
         self.snapshot = Snapshot()
         self.mirror = TensorMirror()
@@ -137,7 +150,8 @@ class BatchScheduler:
         """MatchInterPodAffinity / NoDiskConflict / volume predicates need
         the host path."""
         return (self._has_affinity_pods or pod_has_affinity_constraints(pod)
-                or _pod_has_conflict_volumes(pod) or _pod_has_pvc(pod))
+                or _pod_has_conflict_volumes(pod) or _pod_has_pvc(pod)
+                or _pod_has_attach_volumes(pod))
 
     def _passes_basic_checks(self, pod: Pod) -> bool:
         """Ref: podPassesBasicChecks (generic_scheduler.go:188) — referenced
@@ -169,6 +183,7 @@ class BatchScheduler:
             metas[i] = meta
             has_disk = _pod_has_conflict_volumes(pod)
             has_pvc = _pod_has_pvc(pod)
+            has_attach = has_pvc or _pod_has_attach_volumes(pod)
             for name, ni in self.snapshot.node_infos.items():
                 row = self.mirror.row_of.get(name)
                 if row is None:
@@ -176,6 +191,11 @@ class BatchScheduler:
                 ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
                 if ok and has_disk:
                     ok, _ = preds.no_disk_conflict(pod, meta, ni)
+                if ok and has_attach:
+                    for fn in self._volume_count_preds.values():
+                        ok, _ = fn(pod, meta, ni)
+                        if not ok:
+                            break
                 if ok and has_pvc:
                     ok, _ = self._zone_conflict(pod, meta, ni)
                     if ok and ni.node is not None:
@@ -190,11 +210,16 @@ class BatchScheduler:
         batch carries ports/affinity/disk constraints."""
         needs_any = bool(metas) or any(
             helpers.pod_host_ports(r.pod) or _pod_has_conflict_volumes(r.pod)
+            or _pod_has_pvc(r.pod)
             for r in results)
         if not needs_any:
             return
         overlay: Dict[str, NodeInfo] = {}
         winners: List[Pod] = []
+        # PV names earlier winners will reserve: two winners in one batch
+        # must not both claim the single matching PV (the serial reference
+        # reserves via AssumePodVolumes between scheduleOne iterations)
+        taken_pvs: set = set()
         # a winner with required anti-affinity constrains EVERY later pod in
         # the batch, constrained or not
         winners_have_anti = False
@@ -217,13 +242,34 @@ class BatchScheduler:
             has_aff = (pod_has_affinity_constraints(pod) or i in metas
                        or winners_have_anti)
             has_disk = _pod_has_conflict_volumes(pod)
-            if winners and (has_ports or has_aff or has_disk):
+            pvs: List[str] = []
+            if _pod_has_pvc(pod):
+                ni = overlay_node(res.node_name)
+                found = None
+                if ni is not None and ni.node is not None:
+                    found = self.volume_binder.preview_bindings(
+                        pod, ni.node, exclude=taken_pvs)
+                if found is None:
+                    res.node_name = None
+                    res.retry = True
+                    continue
+                # not committed to taken_pvs yet: a later demotion of THIS
+                # pod must not block these PVs for the rest of the batch
+                pvs = found
+            has_attach = _pod_has_attach_volumes(pod) or _pod_has_pvc(pod)
+            if winners and (has_ports or has_aff or has_disk or has_attach):
                 ni = overlay_node(res.node_name)
                 ok = ni is not None
                 if ok and has_ports:
                     ok, _ = preds.pod_fits_host_ports(pod, None, ni)
                 if ok and has_disk:
                     ok, _ = preds.no_disk_conflict(pod, None, ni)
+                if ok and has_attach:
+                    # earlier winners on this node count against attach limits
+                    for fn in self._volume_count_preds.values():
+                        ok, _ = fn(pod, None, ni)
+                        if not ok:
+                            break
                 if ok and has_aff:
                     meta = metas.get(i)
                     if meta is None:
@@ -242,7 +288,8 @@ class BatchScheduler:
                     res.node_name = None
                     res.retry = True
                     continue
-            # record the winner in the overlay
+            # record the winner in the overlay; its PVs now block later pods
+            taken_pvs.update(pvs)
             bound = deepcopy_obj(pod)
             bound.spec.node_name = res.node_name
             ni = overlay_node(res.node_name)
@@ -375,9 +422,15 @@ class BatchScheduler:
     def explain(self, pod: Pod) -> FitError:
         """Host-path per-node failure reasons for events/conditions."""
         meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+        all_preds = dict(preds.DEFAULT_PREDICATES)
+        if _pod_has_pvc(pod) or _pod_has_attach_volumes(pod):
+            all_preds.update(self._volume_count_preds)
+            all_preds["NoVolumeZoneConflict"] = self._zone_conflict
+            all_preds["CheckVolumeBinding"] = \
+                preds.check_volume_binding_factory(self.volume_binder)
         failed: Dict[str, List[str]] = {}
         for name, ni in self.snapshot.node_infos.items():
-            ok, reasons = preds.pod_fits_on_node(pod, meta, ni)
+            ok, reasons = preds.pod_fits_on_node(pod, meta, ni, all_preds)
             if not ok:
                 failed[name] = reasons
         return FitError(pod=pod, failed_predicates=failed)

@@ -48,6 +48,10 @@ class PredicateMetadata:
         self.pod = pod
         self.pod_request = pod_resource(pod)
         self.pod_ports = helpers.pod_host_ports(pod)
+        # scratch for predicates to stash pod-invariant precomputes that are
+        # reused across the node loop (e.g. Max*VolumeCount wanted-sets,
+        # which resolve PVC->PV through listers once per pod, not per node)
+        self.memo: Dict[object, object] = {}
         # topology pair -> set of existing pod keys whose anti-affinity terms
         # match this (incoming) pod, i.e. pairs forbidden for the pod
         # (ref: topologyPairsAntiAffinityPodsMap)
@@ -394,13 +398,21 @@ def max_volume_count_factory(filter_fn: Callable, max_volumes: int,
     """Ref: predicates.go MaxPDVolumeCountChecker — EBS/GCEPD/AzureDisk and
     csi_volume_predicate.go. filter_fn(volume, pod_namespace) returns a unique
     volume id or None."""
+    memo_key = object()  # unique per factory instance
+
     def predicate(pod: Pod, meta: PredicateMetadata, ni: NodeInfo
                   ) -> Tuple[bool, List[str]]:
-        wanted: Set[str] = set()
-        for vol in pod.spec.volumes:
-            vid = filter_fn(vol, pod.metadata.namespace)
-            if vid is not None:
-                wanted.add(vid)
+        memo = getattr(meta, "memo", None) if meta is not None else None
+        wanted: Optional[Set[str]] = \
+            memo.get(memo_key) if memo is not None else None
+        if wanted is None:
+            wanted = set()
+            for vol in pod.spec.volumes:
+                vid = filter_fn(vol, pod.metadata.namespace)
+                if vid is not None:
+                    wanted.add(vid)
+            if memo is not None:
+                memo[memo_key] = wanted
         if not wanted:
             return True, []
         existing: Set[str] = set()
@@ -413,6 +425,118 @@ def max_volume_count_factory(filter_fn: Callable, max_volumes: int,
             return False, ["node(s) exceed max volume count"]
         return True, []
     return predicate
+
+
+# Ref: predicates.go DefaultMaxEBSVolumes / DefaultMaxGCEPDVolumes /
+# getMaxAzureDiskVolumes (KUBE_MAX_PD_VOLS env override not carried over)
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+DEFAULT_MAX_AZURE_DISK_VOLUMES = 16
+
+
+def pd_volume_filter_factory(attr: str, id_keys: Tuple[str, ...],
+                             pvc_lister=None, pv_lister=None) -> Callable:
+    """A max_volume_count_factory filter for one PD flavor: matches direct
+    volume sources and PVC-referenced PVs of that flavor (ref: predicates.go
+    EBSVolumeFilter/GCEPDVolumeFilter/AzureDiskVolumeFilter — FilterVolume +
+    FilterPersistentVolume)."""
+    def _vid(src: Optional[dict]) -> Optional[str]:
+        if not src:
+            return None
+        for k in id_keys:
+            v = src.get(k)
+            if v:
+                return f"{attr}:{v}"
+        return None
+
+    def filter_fn(vol, ns: str) -> Optional[str]:
+        vid = _vid(getattr(vol, attr, None))
+        if vid is not None:
+            return vid
+        ref = vol.persistent_volume_claim
+        if ref and pvc_lister is not None and pv_lister is not None:
+            pvc = pvc_lister(ns, ref.claim_name)
+            if pvc is not None and pvc.spec.volume_name:
+                pv = pv_lister(pvc.spec.volume_name)
+                if pv is not None:
+                    return _vid(getattr(pv.spec, attr, None))
+        return None
+    return filter_fn
+
+
+def csi_max_volume_count_factory(pvc_lister=None, pv_lister=None) -> Callable:
+    """Ref: csi_volume_predicate.go CSIMaxVolumeLimitChecker — per-driver
+    attach limits read from node allocatable `attachable-volumes-csi-<driver>`
+    scalars; CSI volumes reach pods only through PVCs."""
+    def _driver_handle(vol, ns: str) -> Optional[Tuple[str, str]]:
+        ref = vol.persistent_volume_claim
+        if not ref or pvc_lister is None or pv_lister is None:
+            return None
+        pvc = pvc_lister(ns, ref.claim_name)
+        if pvc is None or not pvc.spec.volume_name:
+            return None
+        pv = pv_lister(pvc.spec.volume_name)
+        if pv is None or not pv.spec.csi:
+            return None
+        drv = pv.spec.csi.get("driver")
+        if not drv:
+            return None
+        return drv, pv.spec.csi.get("volumeHandle", pvc.spec.volume_name)
+
+    memo_key = object()
+
+    def predicate(pod: Pod, meta: PredicateMetadata, ni: NodeInfo
+                  ) -> Tuple[bool, List[str]]:
+        memo = getattr(meta, "memo", None) if meta is not None else None
+        wanted: Optional[Dict[str, Set[str]]] = \
+            memo.get(memo_key) if memo is not None else None
+        if wanted is None:
+            wanted = {}
+            for vol in pod.spec.volumes:
+                dh = _driver_handle(vol, pod.metadata.namespace)
+                if dh is not None:
+                    wanted.setdefault(dh[0], set()).add(dh[1])
+            if memo is not None:
+                memo[memo_key] = wanted
+        if not wanted:
+            return True, []
+        existing: Dict[str, Set[str]] = {}
+        for p in ni.pods:
+            for vol in p.spec.volumes:
+                dh = _driver_handle(vol, p.metadata.namespace)
+                if dh is not None:
+                    existing.setdefault(dh[0], set()).add(dh[1])
+        for drv, handles in wanted.items():
+            limit = ni.allocatable.scalar_resources.get(
+                f"attachable-volumes-csi-{drv}")
+            if limit is None:
+                continue  # node exposes no limit for this driver
+            if len(handles | existing.get(drv, set())) > limit:
+                return False, ["node(s) exceed max volume count"]
+        return True, []
+    return predicate
+
+
+def default_max_volume_count_predicates(pvc_lister=None, pv_lister=None
+                                        ) -> Dict[str, Callable]:
+    """The four attach-limit members of the default predicate set
+    (ref: algorithmprovider/defaults/defaults.go:40-56)."""
+    return {
+        "MaxEBSVolumeCount": max_volume_count_factory(
+            pd_volume_filter_factory("aws_elastic_block_store", ("volumeID",),
+                                     pvc_lister, pv_lister),
+            DEFAULT_MAX_EBS_VOLUMES),
+        "MaxGCEPDVolumeCount": max_volume_count_factory(
+            pd_volume_filter_factory("gce_persistent_disk", ("pdName",),
+                                     pvc_lister, pv_lister),
+            DEFAULT_MAX_GCE_PD_VOLUMES),
+        "MaxAzureDiskVolumeCount": max_volume_count_factory(
+            pd_volume_filter_factory("azure_disk", ("diskURI", "diskName"),
+                                     pvc_lister, pv_lister),
+            DEFAULT_MAX_AZURE_DISK_VOLUMES),
+        "MaxCSIVolumeCountPred": csi_max_volume_count_factory(
+            pvc_lister, pv_lister),
+    }
 
 
 def _pod_qos(pod: Pod) -> str:
@@ -456,6 +580,10 @@ ORDERING = [
     "PodFitsResources",
     "NoDiskConflict",
     "PodToleratesNodeTaints",
+    "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount",
+    "MaxCSIVolumeCountPred",
+    "MaxAzureDiskVolumeCount",
     "CheckNodeMemoryPressure",
     "CheckNodePIDPressure",
     "CheckNodeDiskPressure",

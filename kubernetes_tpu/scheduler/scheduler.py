@@ -85,6 +85,9 @@ class Scheduler:
         from ..api.core import PersistentVolume, PersistentVolumeClaim
         from ..api.policy import StorageClass
         inf = self.informers.informer_for
+        # create eagerly so factory.start() syncs them with everything else
+        for cls in (PersistentVolumeClaim, PersistentVolume, StorageClass):
+            inf(cls)
         pvc_lister = lambda ns, name: inf(PersistentVolumeClaim) \
             .indexer.get_by_key(f"{ns}/{name}")
         pv_by_name = lambda name: inf(PersistentVolume).indexer.get_by_key(name)
@@ -266,6 +269,24 @@ class Scheduler:
                 # kernel double-counted it and no forget will repair that
                 self.algorithm.mirror.invalidate_usage()
                 continue
+            if any(v.persistent_volume_claim for v in res.pod.spec.volumes):
+                # reserve PVs for unbound WaitForFirstConsumer claims before
+                # the pod is committed anywhere (ref: scheduler.go:499
+                # assumeVolumes before assume; bindVolumes :524 before bind)
+                ni = self.algorithm.snapshot.node_infos.get(res.node_name)
+                try:
+                    if ni is None or ni.node is None:
+                        raise ValueError(f"node {res.node_name} vanished")
+                    self.volume_binder.assume_pod_volumes(res.pod, ni.node)
+                    self.volume_binder.bind_pod_volumes(res.pod)
+                except Exception:
+                    # the kernel counted this pod as a winner; it will never
+                    # be assumed — adopted device usage is unrepairable
+                    self.volume_binder.forget_pod_volumes(res.pod)
+                    self.algorithm.mirror.invalidate_usage()
+                    self.queue.add_unschedulable_if_not_present(
+                        res.pod, self.queue.scheduling_cycle)
+                    continue
             fresh.append(res)
         bound = fresh
         bindings = [Binding(

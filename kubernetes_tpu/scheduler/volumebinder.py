@@ -109,38 +109,67 @@ class VolumeBinder:
         mode = getattr(sc, "volume_binding_mode", None) if sc else None
         return mode == "WaitForFirstConsumer"
 
+    def _select_unbound_locked(self, pod: Pod, node: Node,
+                               exclude: Optional[set] = None
+                               ) -> Optional[List[Tuple[PersistentVolumeClaim, str]]]:
+        """One (pvc, pv_name) per unbound claim, or None when any claim has
+        no candidate. The single source of PV-selection truth shared by
+        find/preview/assume so they can never diverge. An unbound claim whose
+        StorageClass is not WaitForFirstConsumer always fails here: Immediate
+        binding is the PV controller's job (ref: FindPodVolumes)."""
+        taken = set(exclude or ())
+        pvs = self.pv_lister()
+        chosen: List[Tuple[PersistentVolumeClaim, str]] = []
+        for pvc in self._pod_claims(pod):
+            if pvc.spec.volume_name:
+                continue
+            if not self._is_wait_for_first_consumer(pvc):
+                return None
+            found = None
+            for pv in pvs:
+                name = pv.metadata.name
+                if pv.spec.claim_ref is not None or \
+                        pv.status.phase != "Available":
+                    # the informer caught up with a completed bind: the
+                    # post-bind reservation (kept so the lagging lister
+                    # can't re-offer the PV) is no longer needed
+                    self._reserved.pop(name, None)
+                    continue
+                if name in taken:
+                    continue
+                holder = self._reserved.get(name)
+                if holder is not None and holder != pod.metadata.key():
+                    continue
+                if _pv_matches_claim(pv, pvc, node):
+                    found = name
+                    break
+            if found is None:
+                return None
+            chosen.append((pvc, found))
+            taken.add(found)
+        return chosen
+
     def find_pod_volumes(self, pod: Pod, node: Node) -> bool:
         """CheckVolumeBinding: every bound PV is compatible with the node and
         every unbound WaitForFirstConsumer claim has a candidate PV there
         (ref: scheduler_binder.go FindPodVolumes)."""
         with self._lock:
             pvs = {pv.metadata.name: pv for pv in self.pv_lister()}
-            taken = set()
             for pvc in self._pod_claims(pod):
                 if pvc.spec.volume_name:
                     pv = pvs.get(pvc.spec.volume_name)
                     if pv is None or not _pv_node_affinity_matches(pv, node):
                         return False
-                    continue
-                if not self._is_wait_for_first_consumer(pvc):
-                    # Immediate binding is the PV controller's job; an
-                    # unbound immediate claim fails the predicate
-                    # (ref: podPassesBasicChecks + FindPodVolumes)
-                    return False
-                found = False
-                for pv in pvs.values():
-                    if pv.metadata.name in taken:
-                        continue
-                    holder = self._reserved.get(pv.metadata.name)
-                    if holder is not None and holder != pod.metadata.key():
-                        continue
-                    if _pv_matches_claim(pv, pvc, node):
-                        taken.add(pv.metadata.name)
-                        found = True
-                        break
-                if not found:
-                    return False
-            return True
+            return self._select_unbound_locked(pod, node) is not None
+
+    def preview_bindings(self, pod: Pod, node: Node,
+                         exclude: Optional[set] = None) -> Optional[List[str]]:
+        """The PV names assume_pod_volumes would reserve, without reserving
+        (in-batch repair's cross-pod PV accounting: two winners in one batch
+        must not count the same PV). None = some claim has no candidate."""
+        with self._lock:
+            sel = self._select_unbound_locked(pod, node, exclude)
+            return None if sel is None else [name for _, name in sel]
 
     # ----------------------------------------------------- assume and bind
 
@@ -149,27 +178,12 @@ class VolumeBinder:
         (ref: AssumePodVolumes). Returns all_bound (True = nothing to do at
         bind time)."""
         with self._lock:
-            pvs = {pv.metadata.name: pv for pv in self.pv_lister()}
-            bindings: List[Tuple[PersistentVolumeClaim, str]] = []
-            for pvc in self._pod_claims(pod):
-                if pvc.spec.volume_name:
-                    continue
-                chosen = None
-                for pv in pvs.values():
-                    holder = self._reserved.get(pv.metadata.name)
-                    if holder is not None and holder != pod.metadata.key():
-                        continue
-                    if any(b[1] == pv.metadata.name for b in bindings):
-                        continue
-                    if _pv_matches_claim(pv, pvc, node):
-                        chosen = pv
-                        break
-                if chosen is None:
-                    self._release(pod.metadata.key(), bindings)
-                    raise ValueError(
-                        f"no matching PV for claim {pvc.metadata.key()}")
-                bindings.append((pvc, chosen.metadata.name))
-                self._reserved[chosen.metadata.name] = pod.metadata.key()
+            bindings = self._select_unbound_locked(pod, node)
+            if bindings is None:
+                raise ValueError(
+                    f"no matching PVs for pod {pod.metadata.key()}")
+            for _, pv_name in bindings:
+                self._reserved[pv_name] = pod.metadata.key()
             if not bindings:
                 return True
             self._assumed[pod.metadata.key()] = bindings
@@ -188,11 +202,16 @@ class VolumeBinder:
 
     def bind_pod_volumes(self, pod: Pod) -> None:
         """API writes: PV.claimRef + PVC.volumeName/Bound
-        (ref: BindPodVolumes -> bindAPIUpdate)."""
+        (ref: BindPodVolumes -> bindAPIUpdate). If the PVC patch fails after
+        its PV was claimed (e.g. the claim was deleted in flight), the PV
+        patch is rolled back best-effort so the volume is not leaked as
+        Bound-to-nothing — the reference leaves this to the PV controller's
+        reconcile, which has no equivalent here yet."""
         with self._lock:
             bindings = self._assumed.pop(pod.metadata.key(), [])
         if not bindings or self.client is None:
             return
+        claimed: List[str] = []
         try:
             for pvc, pv_name in bindings:
                 def set_claim(pv, _pvc=pvc):
@@ -204,6 +223,7 @@ class VolumeBinder:
                     pv.status.phase = "Bound"
                     return pv
                 self.client.persistent_volumes().patch(pv_name, set_claim)
+                claimed.append(pv_name)
 
                 def set_volume(cur, _pv=pv_name):
                     cur.spec.volume_name = _pv
@@ -211,9 +231,25 @@ class VolumeBinder:
                     return cur
                 self.client.persistent_volume_claims(
                     pvc.metadata.namespace).patch(pvc.metadata.name, set_volume)
-        finally:
+                claimed.pop()
+        except Exception:
+            for pv_name in claimed:
+                def unclaim(pv):
+                    pv.spec.claim_ref = None
+                    pv.status.phase = "Available"
+                    return pv
+                try:
+                    self.client.persistent_volumes().patch(pv_name, unclaim)
+                except Exception:
+                    pass
             with self._lock:
                 self._release(pod.metadata.key(), bindings)
+            raise
+        # success: reservations are NOT released here — the pv_lister reads
+        # the informer's (async) view, so an immediate release would let the
+        # next pod re-match a PV whose bind it can't see yet. The entries are
+        # dropped lazily in _select_unbound_locked once the informer-visible
+        # PV shows Bound.
 
 
 class FakeVolumeBinder:
@@ -221,6 +257,9 @@ class FakeVolumeBinder:
 
     def find_pod_volumes(self, pod, node) -> bool:
         return True
+
+    def preview_bindings(self, pod, node, exclude=None):
+        return []
 
     def assume_pod_volumes(self, pod, node) -> bool:
         return True

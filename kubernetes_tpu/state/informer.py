@@ -239,22 +239,35 @@ class SharedInformerFactory:
         self._client = client
         self._informers: Dict[Type, SharedInformer] = {}
         self._lock = threading.Lock()
+        self._started = False
 
     def informer_for(self, cls: Type) -> SharedInformer:
         with self._lock:
             inf = self._informers.get(cls)
-            if inf is None:
+            created = inf is None
+            if created:
                 index_funcs = {}
                 from ..api.core import Pod
                 if cls is Pod:
                     index_funcs["nodeName"] = pod_node_name_index
                 inf = SharedInformer(self._client.resource(cls), index_funcs)
                 self._informers[cls] = inf
-            return inf
+            started = self._started
+        if started:
+            # informers requested after start() join the running factory
+            # (the reference requires a second factory.Start; lazy-start
+            # removes that footgun for in-process wiring). Every caller —
+            # not just the creating one — waits for sync, so a concurrent
+            # lookup can't read an unsynced indexer; SharedInformer.start
+            # is idempotent under its own lock.
+            inf.start()
+            inf.wait_for_sync()
+        return inf
 
     def start(self) -> None:
         with self._lock:
             informers = list(self._informers.values())
+            self._started = True
         for inf in informers:
             inf.start()
 

@@ -592,6 +592,95 @@ class TestResidualPredicates:
         (res,) = sched.schedule([pod])
         assert res.node_name is None
 
+    def test_max_gce_pd_volume_count(self):
+        """MaxGCEPDVolumeCount (defaults.go:40-56): a node at the 16-disk
+        attach limit rejects another PD pod."""
+        n1 = make_node("n1")
+        existing = []
+        for i in range(16):
+            holder = make_pod(f"h{i}", cpu="10m", mem="8Mi", node="n1")
+            holder.spec.volumes = [api.Volume(
+                name="d", gce_persistent_disk={"pdName": f"disk-{i}",
+                                               "readOnly": True})]
+            existing.append(holder)
+        cache = build_scheduler_state([n1], existing)
+        sched = BatchScheduler(cache)
+        pod = make_pod("p", cpu="10m", mem="8Mi")
+        pod.spec.volumes = [api.Volume(
+            name="d", gce_persistent_disk={"pdName": "disk-new"})]
+        (res,) = sched.schedule([pod])
+        assert res.node_name is None
+        # a shared, already-attached disk does not add to the count
+        # (read-only on both sides, so NoDiskConflict permits the share)
+        pod2 = make_pod("p2", cpu="10m", mem="8Mi")
+        pod2.spec.volumes = [api.Volume(
+            name="d", gce_persistent_disk={"pdName": "disk-0",
+                                           "readOnly": True})]
+        (res2,) = sched.schedule([pod2])
+        assert res2.node_name == "n1"
+
+    def test_max_volume_count_in_batch(self):
+        """Attach limits count earlier winners in the SAME batch (the serial
+        reference sees them via assume between iterations)."""
+        n1 = make_node("n1")
+        existing = []
+        for i in range(15):
+            holder = make_pod(f"h{i}", cpu="10m", mem="8Mi", node="n1")
+            holder.spec.volumes = [api.Volume(
+                name="d", gce_persistent_disk={"pdName": f"disk-{i}"})]
+            existing.append(holder)
+        cache = build_scheduler_state([n1], existing)
+        sched = BatchScheduler(cache)
+        a = make_pod("a", cpu="10m", mem="8Mi")
+        a.spec.volumes = [api.Volume(
+            name="d", gce_persistent_disk={"pdName": "disk-a"})]
+        b = make_pod("b", cpu="10m", mem="8Mi")
+        b.spec.volumes = [api.Volume(
+            name="d", gce_persistent_disk={"pdName": "disk-b"})]
+        ra, rb = sched.schedule([a, b])
+        assert ra.node_name == "n1"          # 16th disk fits
+        assert rb.node_name is None and rb.retry  # 17th demoted
+
+    def test_csi_volume_count(self):
+        """MaxCSIVolumeCountPred: per-driver limit from node allocatable
+        attachable-volumes-csi-<driver> (csi_volume_predicate.go)."""
+        from kubernetes_tpu.scheduler.predicates import (
+            PredicateMetadata, csi_max_volume_count_factory)
+        n1 = make_node("n1")
+        n1.status.allocatable["attachable-volumes-csi-d1"] = Quantity(1)
+        pvs = {}
+        pvcs = {}
+        for i in range(2):
+            pvs[f"pv{i}"] = api.PersistentVolume(
+                metadata=api.ObjectMeta(name=f"pv{i}"),
+                spec=api.PersistentVolumeSpec(
+                    csi={"driver": "d1", "volumeHandle": f"h{i}"}))
+            pvcs[("default", f"c{i}")] = api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name=f"c{i}", namespace="default"),
+                spec=api.PersistentVolumeClaimSpec(volume_name=f"pv{i}"))
+        pred = csi_max_volume_count_factory(
+            lambda ns, name: pvcs.get((ns, name)),
+            lambda name: pvs.get(name))
+        holder = make_pod("holder", node="n1")
+        holder.spec.volumes = [api.Volume(
+            name="v", persistent_volume_claim=
+            api.PersistentVolumeClaimVolumeSource(claim_name="c0"))]
+        ni = NodeInfo(n1)
+        ni.add_pod(holder)
+        pod = make_pod("p")
+        pod.spec.volumes = [api.Volume(
+            name="v", persistent_volume_claim=
+            api.PersistentVolumeClaimVolumeSource(claim_name="c1"))]
+        ok, reasons = pred(pod, None, ni)
+        assert not ok and "max volume count" in reasons[0]
+        # same volume already attached -> fits
+        pod2 = make_pod("p2")
+        pod2.spec.volumes = [api.Volume(
+            name="v", persistent_volume_claim=
+            api.PersistentVolumeClaimVolumeSource(claim_name="c0"))]
+        ok2, _ = pred(pod2, None, ni)
+        assert ok2
+
 
 class TestEndToEnd:
     """The aha-slice: store -> informers -> queue -> TPU kernel -> bind."""
@@ -670,5 +759,54 @@ class TestEndToEnd:
                 time.sleep(0.05)
             assert client.pods().get("high").spec.node_name == "only"
             assert client.pods().get("low").spec.node_name == ""
+        finally:
+            sched.stop()
+
+    def test_wait_for_first_consumer_binds_pv(self):
+        """Delayed binding end-to-end (ref: scheduler.go:499 assumeVolumes,
+        :524 bindVolumes): scheduling a pod with an unbound WFC claim writes
+        PV.claimRef and PVC.volumeName; a second pod contending for the same
+        single PV stays pending."""
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        client.storage_classes().create(api.StorageClass(
+            metadata=api.ObjectMeta(name="wfc"),
+            volume_binding_mode="WaitForFirstConsumer"))
+        client.persistent_volumes().create(api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv1"),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": Quantity("10Gi")},
+                access_modes=["ReadWriteOnce"],
+                storage_class_name="wfc")))
+        for cname in ("c1", "c2"):
+            client.persistent_volume_claims("default").create(
+                api.PersistentVolumeClaim(
+                    metadata=api.ObjectMeta(name=cname, namespace="default"),
+                    spec=api.PersistentVolumeClaimSpec(
+                        access_modes=["ReadWriteOnce"],
+                        storage_class_name="wfc",
+                        resources=api.ResourceRequirements(
+                            requests={"storage": Quantity("5Gi")}))))
+        sched = Scheduler(client, batch_size=8)
+        sched.start()
+        try:
+            for pname, cname in (("pa", "c1"), ("pb", "c2")):
+                pod = make_pod(pname)
+                pod.spec.volumes = [api.Volume(
+                    name="data", persistent_volume_claim=
+                    api.PersistentVolumeClaimVolumeSource(claim_name=cname))]
+                client.pods().create(pod)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.persistent_volumes().get("pv1").spec.claim_ref:
+                    break
+                time.sleep(0.05)
+            pv = client.persistent_volumes().get("pv1")
+            assert pv.spec.claim_ref is not None
+            winner_claim = pv.spec.claim_ref["name"]
+            pvc = client.persistent_volume_claims("default").get(winner_claim)
+            assert pvc.spec.volume_name == "pv1"
+            bound = [p for p in client.pods().list() if p.spec.node_name]
+            assert len(bound) == 1  # the loser found no PV and stays pending
         finally:
             sched.stop()
