@@ -18,7 +18,8 @@ from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
 from .defaults import default
 from .meta import (LabelSelector, LabelSelectorRequirement, ObjectMeta,
                    OwnerReference, controller_ref, new_controller_ref)
-from .policy import Lease, PodDisruptionBudget, PriorityClass, StorageClass
+from .policy import (Lease, PodDisruptionBudget, PodDisruptionBudgetSpec,
+                     PodDisruptionBudgetStatus, PriorityClass, StorageClass)
 from .quantity import Quantity
 from .serde import decode, deepcopy_obj, encode, from_json_str, to_json_str
 from .validation import ValidationError, validate

@@ -108,10 +108,19 @@ class BatchScheduler:
                  weights: Optional[Dict[str, int]] = None,
                  hard_pod_affinity_weight: Optional[int] = None,
                  volume_binder=None,
-                 pvc_lister=None, pv_lister=None):
+                 pvc_lister=None, pv_lister=None,
+                 nominated=None, pdb_lister=None):
         from . import priorities as prios_mod
+        from .queue import NominatedPodMap
         from .scorer import ScoreCompiler
         from .volumebinder import FakeVolumeBinder
+        #: shared with the SchedulingQueue; feeds the kernel's reservation
+        #: tensors and preemption's nominated-to-clear list
+        self.nominated = nominated if nominated is not None else NominatedPodMap()
+        self.pdb_lister = pdb_lister or (lambda: [])
+        self._nom_key = None
+        self._nom_dev = None
+        self._nom_rows_by_key: Dict[str, int] = {}
         self.volume_binder = volume_binder or FakeVolumeBinder()
         self.pvc_lister = pvc_lister      # (namespace, name) -> PVC | None
         self.pv_lister = pv_lister        # (name) -> PV | None
@@ -377,6 +386,15 @@ class BatchScheduler:
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
+        nom_dev = self._nominated_device()
+        if nom_dev is not None:
+            # each pod's own nominated row, from the EXACT snapshot the
+            # reservation tensor was built from (pod.status and even the
+            # live map may lag) — subtraction and tensor can never desync
+            for i, pod in enumerate(pods):
+                row = self._nom_rows_by_key.get(pod.metadata.key())
+                if row is not None:
+                    batch.nom_row[i] = row
         static = self.scorer.static_scores(pods, batch)
         # hysteresis: while static scores are in play, later launches refuse
         # the chain up front (before tensorize) instead of discarding work
@@ -392,7 +410,8 @@ class BatchScheduler:
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
         assign_d, scores_d, new_usage = schedule_batch(node_cfg, usage,
-                                                       batch.device())
+                                                       batch.device(),
+                                                       self._nominated_device())
         return PendingBatch(pods=pods, metas=metas, batch=batch,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
@@ -419,15 +438,130 @@ class BatchScheduler:
             self.mirror.adopt_usage(pending.new_usage)
         return out
 
-    def explain(self, pod: Pod) -> FitError:
-        """Host-path per-node failure reasons for events/conditions."""
-        meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+    def _nominated_device(self) -> Optional[dict]:
+        """Aggregated nominated-pod reservations as device tensors
+        ({used [N,R], count [N]}), or None when nothing is nominated.
+        Cached by (nominated.version, mirror.epoch, tensor shape) — the
+        mirror epoch covers node-row reuse: a deleted node's row can be
+        handed to a new node, and a stale tensor would charge the old
+        reservation to the wrong node. Nominations are rare so the
+        rebuild+upload almost never runs. Nominees already assumed into
+        the cache are excluded — their usage is real, not phantom."""
+        ver = self.nominated.version
+        shape = (self.mirror.t.capacity, self.mirror.t.n_cols)
+        key = (ver, self.mirror.epoch, shape)
+        if key == self._nom_key:
+            return self._nom_dev
+        from .nodeinfo import pod_resource
+        from .tensorize import COL_CPU, COL_EPH, COL_MEM, _f32_ceil
+        used = None
+        count = None
+        rows_by_key: Dict[str, int] = {}
+        for node_name, pods in self.nominated.by_node().items():
+            row = self.mirror.row_of.get(node_name)
+            if row is None:
+                continue
+            for p in pods:
+                if self.cache.assigned_node(p.metadata.key()) is not None:
+                    continue
+                if used is None:
+                    used = np.zeros(shape, np.float32)
+                    count = np.zeros((shape[0],), np.float32)
+                r = pod_resource(p)
+                used[row, COL_CPU] += _f32_ceil(r.milli_cpu)
+                used[row, COL_MEM] += _f32_ceil(r.memory)
+                used[row, COL_EPH] += _f32_ceil(r.ephemeral_storage)
+                for rname, v in r.scalar_resources.items():
+                    used[row, self.mirror.vocab.col(rname)] += _f32_ceil(v)
+                count[row] += 1.0
+                rows_by_key[p.metadata.key()] = row
+        if used is None:
+            self._nom_dev = None
+        else:
+            import jax.numpy as jnp
+            self._nom_dev = {"used": jnp.asarray(used),
+                             "count": jnp.asarray(count)}
+        #: pod key -> reserved row, exactly as charged into _nom_dev
+        self._nom_rows_by_key = rows_by_key
+        self._nom_key = key
+        return self._nom_dev
+
+    # ------------------------------------------------------------ preempt
+
+    def _fits_predicates(self, pod: Pod) -> Dict[str, object]:
+        """The predicate set a victim-search fit check runs (same assembly
+        as explain())."""
         all_preds = dict(preds.DEFAULT_PREDICATES)
         if _pod_has_pvc(pod) or _pod_has_attach_volumes(pod):
             all_preds.update(self._volume_count_preds)
             all_preds["NoVolumeZoneConflict"] = self._zone_conflict
             all_preds["CheckVolumeBinding"] = \
                 preds.check_volume_binding_factory(self.volume_binder)
+        return all_preds
+
+    def preempt(self, pod: Pod):
+        """Ref: generic_scheduler.go Preempt (:310-369). Returns a
+        PreemptionPlan or None. Pure computation — the shell performs the
+        API writes (nominate, delete victims, clear lower nominations)."""
+        from . import preemption as pre
+        self.refresh()
+        infos = self.snapshot.node_infos
+        # A standing nomination on a still-viable node blocks re-preemption:
+        # the kernel's reservation tensors guarantee the freed space, so the
+        # pod only needs to wait for the victim deletions to reach the cache.
+        # (The reference gates on victims still carrying a DeletionTimestamp,
+        # :1130-1150 — useless here because the in-process store deletes
+        # instantly; without this guard a retry racing the delete events
+        # re-preempts a SECOND node.) A vanished/shrunk node drops the
+        # reservation and falls through to a fresh preemption.
+        nn = self.nominated.node_for(pod.metadata.key())
+        if nn:
+            ni = infos.get(nn)
+            if ni is not None and pre.node_could_ever_fit(pod, ni):
+                return None
+            self.nominated.delete(pod)
+        if not pre.pod_eligible_to_preempt_others(pod, infos):
+            return None
+        # candidate rows: pod-independent constraints must pass — failures
+        # preemption can't fix (ref: nodesWherePreemptionMightHelp
+        # unresolvable reasons); cached vectors, no per-node python
+        t = self.mirror.t
+        vec = (self.terms.tolerations_vector(pod)
+               & self.terms.node_selector_vector(pod)
+               & t.node_ok & t.valid)
+        hv = self.terms.hostname_vector(pod)
+        if hv is not None:
+            vec = vec & hv
+        all_preds = self._fits_predicates(pod)
+
+        def fits(p, meta, ni) -> bool:
+            ok, _ = preds.pod_fits_on_node(p, meta, ni, all_preds)
+            return ok
+        pdbs = list(self.pdb_lister())
+        base_meta = preds.PredicateMetadata(pod, infos)
+        victims_map: Dict[str, Tuple[List[Pod], int]] = {}
+        for row in np.nonzero(vec)[0]:
+            name = self.mirror.name_of.get(int(row))
+            ni = infos.get(name) if name else None
+            if ni is None or not pre.resource_screen(pod, ni):
+                continue
+            sel = pre.select_victims_on_node(pod, ni, infos, fits, pdbs,
+                                             base_meta=base_meta)
+            if sel is not None:
+                victims_map[name] = sel
+        node = pre.pick_one_node_for_preemption(victims_map)
+        if node is None:
+            return None
+        victims, nviol = victims_map[node]
+        return pre.PreemptionPlan(
+            node_name=node, victims=victims, num_pdb_violations=nviol,
+            nominated_to_clear=pre.nominated_pods_to_clear(
+                pod, node, self.nominated.pods_for_node(node)))
+
+    def explain(self, pod: Pod) -> FitError:
+        """Host-path per-node failure reasons for events/conditions."""
+        meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+        all_preds = self._fits_predicates(pod)
         failed: Dict[str, List[str]] = {}
         for name, ni in self.snapshot.node_infos.items():
             ok, reasons = preds.pod_fits_on_node(pod, meta, ni, all_preds)

@@ -135,7 +135,8 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
 
 
 @jax.jit
-def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict):
+def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
+                   nom: dict = None):
     """Serial-semantics greedy assignment, fully on device.
 
     Returns (assign [P] int32 node row or -1, chosen_score [P] f32,
@@ -143,16 +144,34 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict):
     queue drain (core.BatchScheduler fast path) so N batches cost N device
     dispatches and zero usage re-uploads; the cache remains the source of
     truth between drains (assume/forget -> mirror dirty rows).
-    """
+
+    `nom` carries aggregated nominated-pod reservations (preemption's
+    freed space, scheduler.go:292-380): used [N,R], nz [N,2], count [N].
+    Feasibility treats them as phantom usage so no pod steals a nominated
+    node's space, except the nominee itself — each pod's own contribution
+    is subtracted at its `nom_row` (its nominated node's row, -1 if none).
+    Deviation from the reference's two-pass nominated check
+    (generic_scheduler.go:598-664): the reservation shields against ALL
+    other pods, not just lower-priority ones — strictly more conservative;
+    a higher-priority pod pushed off a full nominated node preempts
+    instead. Scores stay on real usage (matching PrioritizeNodes, which
+    ranks against the snapshot)."""
     per_pod, unique_masks, unique_scores = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     rows = jnp.arange(N, dtype=jnp.int32)
+    if nom is None:
+        nom = {"used": jnp.zeros_like(usage["used"]),
+               "count": jnp.zeros_like(usage["pod_count"])}
 
     def step(carry, pod):
         used, nz_used, pod_count = carry
         mask = unique_masks[pod["mask_idx"]]
         static = unique_scores[pod["score_idx"]]
-        fits = _pod_feasible(node_cfg, used, pod_count, pod, mask)
+        self_oh = rows == pod.get("nom_row", jnp.int32(-1))
+        eff_used = used + nom["used"] - \
+            jnp.where(self_oh[:, None], pod["req"][None, :], 0.0)
+        eff_count = pod_count + nom["count"] - self_oh.astype(jnp.float32)
+        fits = _pod_feasible(node_cfg, eff_used, eff_count, pod, mask)
         score = _pod_score(node_cfg, nz_used, pod, static)
         masked = jnp.where(fits, score, NEG)
         # selectHost rotates among max-score ties across cycles (:286-296):

@@ -99,6 +99,20 @@ class PredicateMetadata:
         self.affinity_term_pairs = list(zip(own_aff_terms, aff_pairs))
         self.anti_term_pairs = list(zip(own_anti_terms, anti_pairs))
 
+    def clone(self) -> "PredicateMetadata":
+        """Ref: metadata.go ShallowCopy — preemption's per-candidate-node
+        what-if mutations need an isolated copy without re-scanning the
+        cluster's topology maps."""
+        c = object.__new__(PredicateMetadata)
+        c.pod = self.pod
+        c.pod_request = self.pod_request
+        c.pod_ports = self.pod_ports
+        c.memo = dict(self.memo)
+        c.anti_affinity_pairs = set(self.anti_affinity_pairs)
+        c.affinity_term_pairs = [(t, set(p)) for t, p in self.affinity_term_pairs]
+        c.anti_term_pairs = [(t, set(p)) for t, p in self.anti_term_pairs]
+        return c
+
     # incremental update for preemption what-if evaluation (ref: metadata.go
     # AddPod/RemovePod)
     def remove_pod(self, deleted: Pod, node_info: NodeInfo) -> None:

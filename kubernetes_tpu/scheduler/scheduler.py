@@ -38,28 +38,35 @@ class Scheduler:
                  informer_factory: Optional[SharedInformerFactory] = None,
                  batch_size: int = DEFAULT_BATCH_SIZE,
                  scheduler_name: str = "default-scheduler",
-                 clock: Clock = REAL_CLOCK):
+                 clock: Clock = REAL_CLOCK,
+                 disable_preemption: bool = False):
         self.client = client
         self.scheduler_name = scheduler_name
         self.batch_size = batch_size
         self.clock = clock
+        self.disable_preemption = disable_preemption
         self.cache = Cache(clock=clock)
         self.queue = SchedulingQueue(clock=clock)
         self.informers = informer_factory or SharedInformerFactory(client)
         pvc_lister, pv_by_name, pv_all, sc_lister = self._volume_listers()
+        from ..api.policy import PodDisruptionBudget
         from .volumebinder import VolumeBinder
         self.volume_binder = VolumeBinder(
             pvc_lister=pvc_lister, pv_lister=pv_all,
             sc_lister=sc_lister, client=client)
+        pdb_informer = self.informers.informer_for(PodDisruptionBudget)
         self.algorithm = BatchScheduler(
             self.cache, listers=self._spread_listers(),
             volume_binder=self.volume_binder,
-            pvc_lister=pvc_lister, pv_lister=pv_by_name)
+            pvc_lister=pvc_lister, pv_lister=pv_by_name,
+            nominated=self.queue.nominated,
+            pdb_lister=lambda: pdb_informer.indexer.list())
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
         self.scheduled_count = 0
         self.unschedulable_count = 0
+        self.preemption_count = 0
         self._add_all_event_handlers()
 
     # ------------------------------------------------- event handlers
@@ -74,25 +81,30 @@ class Scheduler:
         from ..api.apps import ReplicaSet, StatefulSet
         from ..api.core import ReplicationController, Service
         from .priorities import SpreadListers
-        inf = self.informers.informer_for
+        svc_inf = self.informers.informer_for(Service)
+        rc_inf = self.informers.informer_for(ReplicationController)
+        rs_inf = self.informers.informer_for(ReplicaSet)
+        ss_inf = self.informers.informer_for(StatefulSet)
         return SpreadListers(
-            services=lambda ns: inf(Service).indexer.list(ns),
-            rcs=lambda ns: inf(ReplicationController).indexer.list(ns),
-            rss=lambda ns: inf(ReplicaSet).indexer.list(ns),
-            statefulsets=lambda ns: inf(StatefulSet).indexer.list(ns))
+            services=lambda ns: svc_inf.indexer.list(ns),
+            rcs=lambda ns: rc_inf.indexer.list(ns),
+            rss=lambda ns: rs_inf.indexer.list(ns),
+            statefulsets=lambda ns: ss_inf.indexer.list(ns))
 
     def _volume_listers(self):
         from ..api.core import PersistentVolume, PersistentVolumeClaim
         from ..api.policy import StorageClass
-        inf = self.informers.informer_for
-        # create eagerly so factory.start() syncs them with everything else
-        for cls in (PersistentVolumeClaim, PersistentVolume, StorageClass):
-            inf(cls)
-        pvc_lister = lambda ns, name: inf(PersistentVolumeClaim) \
-            .indexer.get_by_key(f"{ns}/{name}")
-        pv_by_name = lambda name: inf(PersistentVolume).indexer.get_by_key(name)
-        pv_all = lambda: inf(PersistentVolume).indexer.list()
-        sc_lister = lambda name: inf(StorageClass).indexer.get_by_key(name)
+        # capture the informers ONCE: these listers run inside per-pod
+        # per-node predicate loops, so routing every lookup through the
+        # factory (its lock + lazy-start check) would be pure overhead;
+        # creating them here also means factory.start() syncs them
+        pvc_inf = self.informers.informer_for(PersistentVolumeClaim)
+        pv_inf = self.informers.informer_for(PersistentVolume)
+        sc_inf = self.informers.informer_for(StorageClass)
+        pvc_lister = lambda ns, name: pvc_inf.indexer.get_by_key(f"{ns}/{name}")
+        pv_by_name = lambda name: pv_inf.indexer.get_by_key(name)
+        pv_all = lambda: pv_inf.indexer.list()
+        sc_lister = lambda name: sc_inf.indexer.get_by_key(name)
         return pvc_lister, pv_by_name, pv_all, sc_lister
 
     def _add_all_event_handlers(self) -> None:
@@ -298,6 +310,9 @@ class Scheduler:
         n_assumed = 0
         for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
+                # ref: scheduler.go assume :382-409 — the nomination is
+                # consumed the moment the pod lands
+                self.queue.nominated.delete(out)
                 try:
                     self.cache.assume_pod(out)
                     n_assumed += 1
@@ -340,6 +355,59 @@ class Scheduler:
             self._record_event(pod, "FailedScheduling", fit_err.error())
         except Exception:
             pass
+        self._try_preempt(pod)
+
+    def _try_preempt(self, pod: Pod) -> None:
+        """Ref: scheduler.go preempt (:292-380): nominate the pod to the
+        chosen node, clear invalidated lower-priority nominations there,
+        evict the victims. The pod itself stays in the queue — the victims'
+        delete events move it back to active, and the kernel's reservation
+        tensors (BatchScheduler._nominated_device) shield the freed space
+        until it lands."""
+        if self.disable_preemption:
+            return
+        try:
+            plan = self.algorithm.preempt(pod)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return
+        if plan is None:
+            return
+
+        def set_nominated(cur):
+            cur.status.nominated_node_name = plan.node_name
+            return cur
+        try:
+            updated = self.client.pods(pod.metadata.namespace).patch(
+                pod.metadata.name, set_nominated)
+        except Exception:
+            return  # pod vanished; nothing to preempt for
+        # make the nomination visible to the next batch immediately (the
+        # informer update will confirm): reservation tensor + queue pod
+        self.queue.nominated.add(updated, plan.node_name)
+        self.queue.update(pod, updated)
+        for other in plan.nominated_to_clear:
+            def clear_nominated(cur):
+                cur.status.nominated_node_name = ""
+                return cur
+            try:
+                self.client.pods(other.metadata.namespace).patch(
+                    other.metadata.name, clear_nominated)
+            except Exception:
+                pass
+            self.queue.nominated.delete(other)
+        for victim in plan.victims:
+            self._record_event(
+                victim, "Preempted",
+                f"Preempted by {pod.metadata.namespace}/{pod.metadata.name} "
+                f"on node {plan.node_name}")
+            try:
+                self.client.pods(victim.metadata.namespace).delete(
+                    victim.metadata.name)
+            except Exception:
+                pass
+        self.preemption_count += 1
 
     def _record_event(self, pod: Pod, reason: str, message: str) -> None:
         """Ref: client-go tools/record EventRecorder -> apiserver Events."""

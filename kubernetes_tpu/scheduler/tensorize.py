@@ -489,6 +489,13 @@ class PodBatchTensors:
         self.seq = (seq_base + np.arange(P, dtype=np.int64)) \
             .astype(np.int32) & 0x7FFFFFFF
         self.mask_idx = np.zeros((P,), np.int32)
+        # the pod's own nominated node's row (-1 if none): the kernel
+        # subtracts the pod's own reservation there so a preemptor is not
+        # blocked by the space reserved for itself. Filled by the caller
+        # (core.schedule_launch) from the live NominatedPodMap — the SAME
+        # source the reservation tensor is built from; pod.status can lag
+        # the map (cleared nominations) and would desync the subtraction.
+        self.nom_row = np.full((P,), -1, np.int32)
         self._mirror = mirror
 
         # Pods stamped from one controller template share requests, QoS,
@@ -607,5 +614,6 @@ class PodBatchTensors:
                 "seq": jnp.asarray(self.seq),
                 "mask_idx": jnp.asarray(self.mask_idx),
                 "score_idx": jnp.asarray(self.score_idx),
+                "nom_row": jnp.asarray(self.nom_row),
                 "unique_masks": jnp.asarray(self.unique_masks),
                 "unique_scores": jnp.asarray(self.unique_scores)}

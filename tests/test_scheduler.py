@@ -682,6 +682,181 @@ class TestResidualPredicates:
         assert ok2
 
 
+class TestPreemption:
+    """Mirrors generic_scheduler.go Preempt/selectVictimsOnNode/
+    pickOneNodeForPreemption semantics (:310-369, :837-962, :1054-1128)."""
+
+    def _fits(self, pod, meta, ni):
+        ok, _ = preds.pod_fits_on_node(pod, meta, ni)
+        return ok
+
+    def test_select_victims_basic(self):
+        from kubernetes_tpu.scheduler.preemption import select_victims_on_node
+        node = make_node("n1", cpu="1", mem="1Gi")
+        ni = NodeInfo(node)
+        low = make_pod("low", cpu="800m", priority=1, node="n1")
+        ni.add_pod(low)
+        pod = make_pod("high", cpu="500m", priority=100)
+        sel = select_victims_on_node(pod, ni, {"n1": ni}, self._fits, [])
+        assert sel is not None
+        victims, nviol = sel
+        assert [v.metadata.name for v in victims] == ["low"]
+        assert nviol == 0
+
+    def test_select_victims_reprieves_what_fits(self):
+        """Only as many victims as needed are evicted; the rest are
+        reprieved, most important first."""
+        from kubernetes_tpu.scheduler.preemption import select_victims_on_node
+        node = make_node("n1", cpu="2", mem="4Gi")
+        ni = NodeInfo(node)
+        for name, cpu, prio in (("a", "800m", 5), ("b", "800m", 3),
+                                ("c", "300m", 1)):
+            ni.add_pod(make_pod(name, cpu=cpu, priority=prio, node="n1"))
+        # needs 900m; freeing c (300m) is not enough, b (800m) suffices
+        pod = make_pod("high", cpu="900m", priority=100)
+        sel = select_victims_on_node(pod, ni, {"n1": ni}, self._fits, [])
+        assert sel is not None
+        victims, _ = sel
+        # a (most important) reprieved first, then b can't come back
+        # (a + b + 900m > 2 CPU), then c fits again
+        assert [v.metadata.name for v in victims] == ["b"]
+
+    def test_select_victims_no_lower_priority(self):
+        from kubernetes_tpu.scheduler.preemption import select_victims_on_node
+        ni = NodeInfo(make_node("n1", cpu="1"))
+        ni.add_pod(make_pod("peer", cpu="800m", priority=100, node="n1"))
+        pod = make_pod("high", cpu="500m", priority=100)
+        assert select_victims_on_node(pod, ni, {"n1": ni},
+                                      self._fits, []) is None
+
+    def test_pdb_violation_accounting(self):
+        from kubernetes_tpu.scheduler.preemption import \
+            filter_pods_with_pdb_violation
+        pdb = api.PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="pdb", namespace="default"),
+            spec=api.PodDisruptionBudgetSpec(
+                selector=api.LabelSelector(match_labels={"app": "x"})),
+            status=api.PodDisruptionBudgetStatus(disruptions_allowed=1))
+        pods = [make_pod(f"p{i}", labels={"app": "x"}) for i in range(3)]
+        violating, ok = filter_pods_with_pdb_violation(pods, [pdb])
+        # one disruption allowed: first pod ok, the rest violate
+        assert [p.metadata.name for p in ok] == ["p0"]
+        assert [p.metadata.name for p in violating] == ["p1", "p2"]
+
+    def test_pick_one_node_tiebreaks(self):
+        from kubernetes_tpu.scheduler.preemption import \
+            pick_one_node_for_preemption
+        v = lambda prio, start="2026-01-01T00:00:00Z": api.Pod(
+            metadata=api.ObjectMeta(name=f"v{prio}-{start[-3:]}",
+                                    namespace="default"),
+            spec=api.PodSpec(priority=prio),
+            status=api.PodStatus(start_time=start))
+        # fewest PDB violations wins
+        assert pick_one_node_for_preemption(
+            {"a": ([v(5)], 1), "b": ([v(5)], 0)}) == "b"
+        # lowest highest-victim priority wins
+        assert pick_one_node_for_preemption(
+            {"a": ([v(9)], 0), "b": ([v(5)], 0)}) == "b"
+        # smallest priority sum wins
+        assert pick_one_node_for_preemption(
+            {"a": ([v(5), v(4)], 0), "b": ([v(5), v(1)], 0)}) == "b"
+        # fewest victims wins
+        assert pick_one_node_for_preemption(
+            {"a": ([v(5), v(5)], 0), "b": ([v(5)], 0)}) == "b"
+        # latest start of highest-priority victim wins
+        assert pick_one_node_for_preemption(
+            {"a": ([v(5, "2026-01-01T00:00:00Z")], 0),
+             "b": ([v(5, "2026-06-01T00:00:00Z")], 0)}) == "b"
+
+    def test_eligibility_waits_for_terminating_victims(self):
+        from kubernetes_tpu.scheduler.preemption import \
+            pod_eligible_to_preempt_others
+        ni = NodeInfo(make_node("n1"))
+        dying = make_pod("dying", priority=1, node="n1")
+        dying.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+        ni.add_pod(dying)
+        pod = make_pod("high", priority=100)
+        pod.status.nominated_node_name = "n1"
+        assert not pod_eligible_to_preempt_others(pod, {"n1": ni})
+        pod2 = make_pod("fresh", priority=100)
+        assert pod_eligible_to_preempt_others(pod2, {"n1": ni})
+
+    def test_batch_preempt_picks_min_victim_node(self):
+        """BatchScheduler.preempt: candidates screened by tensors, victims
+        chosen per node, tie-breaks applied."""
+        cache = Cache()
+        n1, n2 = make_node("n1", cpu="1"), make_node("n2", cpu="1")
+        cache.add_node(n1)
+        cache.add_node(n2)
+        # n1 holds a priority-5 pod, n2 a priority-2 pod: n2's victim set
+        # has lower max priority
+        cache.add_pod(make_pod("v1", cpu="800m", priority=5, node="n1"))
+        cache.add_pod(make_pod("v2", cpu="800m", priority=2, node="n2"))
+        sched = BatchScheduler(cache)
+        sched.refresh()
+        pod = make_pod("high", cpu="500m", priority=100)
+        plan = sched.preempt(pod)
+        assert plan is not None
+        assert plan.node_name == "n2"
+        assert [v.metadata.name for v in plan.victims] == ["v2"]
+
+    def test_nominated_reservation_shields_space(self):
+        """A nominated pod's space is invisible to other pods (kernel
+        reservation tensors) but usable by the nominee itself."""
+        from kubernetes_tpu.scheduler.queue import NominatedPodMap
+        cache = Cache()
+        cache.add_node(make_node("only", cpu="1", mem="1Gi", pods=10))
+        nominated = NominatedPodMap()
+        nominee = make_pod("nominee", cpu="600m", priority=100)
+        nominee.status.nominated_node_name = "only"
+        nominated.add(nominee)
+        sched = BatchScheduler(cache, nominated=nominated)
+        # an unrelated pod that needs the reserved space must NOT fit
+        (res,) = sched.schedule([make_pod("thief", cpu="600m", priority=1)])
+        assert res.node_name is None
+        # the nominee itself lands (its own reservation is subtracted)
+        (res2,) = sched.schedule([nominee])
+        assert res2.node_name == "only"
+
+    def test_end_to_end_preemption(self):
+        """High-priority pod evicts a low-priority pod and lands
+        (ref: test/integration/scheduler preemption tests)."""
+        client = Client()
+        client.nodes().create(make_node("only", cpu="1", mem="1Gi", pods=5))
+        sched = Scheduler(client, batch_size=8)
+        sched.start()
+        try:
+            client.pods().create(make_pod("low", cpu="700m", priority=1))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.pods().get("low").spec.node_name:
+                    break
+                time.sleep(0.05)
+            assert client.pods().get("low").spec.node_name == "only"
+            client.pods().create(make_pod("high", cpu="700m", priority=100))
+            deadline = time.time() + 30
+            high_bound = False
+            while time.time() < deadline:
+                try:
+                    high = client.pods().get("high")
+                except Exception:
+                    break
+                if high.spec.node_name:
+                    high_bound = True
+                    break
+                time.sleep(0.05)
+            assert high_bound, "high-priority pod never landed"
+            assert client.pods().get("high").spec.node_name == "only"
+            # the victim is gone
+            names = [p.metadata.name for p in client.pods().list()]
+            assert "low" not in names
+            assert sched.preemption_count == 1
+            events = client.events("default").list()
+            assert any(e.reason == "Preempted" for e in events)
+        finally:
+            sched.stop()
+
+
 class TestEndToEnd:
     """The aha-slice: store -> informers -> queue -> TPU kernel -> bind."""
 
