@@ -1,7 +1,9 @@
 """Typed API object model (ref: pkg/apis + staging/src/k8s.io/api)."""
 
 from . import helpers, labels, serde, validation, wellknown
-from .apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
+from .apps import (DaemonSet, DaemonSetSpec, Deployment, DeploymentSpec,
+                   DeploymentStrategy, ReplicaSet, ReplicaSetSpec,
+                   RollingUpdateDeployment, StatefulSet, StatefulSetSpec)
 from .batch import CronJob, Job
 from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
                    Endpoints, Event, Namespace, Node, NodeAffinity,

@@ -8,6 +8,16 @@ from .meta import LabelSelector
 from .quantity import Quantity
 
 
+def _coerce_quantities(resources) -> None:
+    """Plain strings/ints in resource maps become Quantity — the decode
+    path produces Quantity, and direct dataclass construction should not
+    crash validation with a TypeError for the same input."""
+    for m in (resources.requests, resources.limits):
+        for name, q in list(m.items()):
+            if not isinstance(q, Quantity):
+                m[name] = Quantity(q)
+
+
 def default_pod(pod: Pod) -> Pod:
     spec = pod.spec
     if not spec.restart_policy:
@@ -20,6 +30,7 @@ def default_pod(pod: Pod) -> Pod:
         for p in c.ports:
             if not p.protocol:
                 p.protocol = "TCP"
+        _coerce_quantities(c.resources)
         # requests default from limits (ref: SetDefaults_ResourceList semantics
         # in defaults.go: limits set + requests unset -> requests = limits)
         for name, q in c.resources.limits.items():

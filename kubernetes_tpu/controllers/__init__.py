@@ -1,0 +1,30 @@
+"""Control loops — the kube-controller-manager analog.
+
+Ref: pkg/controller/* (33 controllers registered at
+cmd/kube-controller-manager/app/controllermanager.go:367-403). Every
+controller follows one shape: informer event handlers -> rate-limited
+workqueue -> sync(key) -> API writes, with exponential retry on error
+(ref: pkg/controller/deployment/deployment_controller.go:148 Run).
+
+Implemented slice (dependency-ordered):
+  ReplicaSetController     replicaset.py      (pkg/controller/replicaset)
+  DeploymentController     deployment.py      (pkg/controller/deployment)
+  NodeLifecycleController  nodelifecycle.py   (pkg/controller/nodelifecycle)
+  GarbageCollector         garbagecollector.py (pkg/controller/garbagecollector)
+  ControllerManager        manager.py         (cmd/kube-controller-manager)
+
+These are host-side control loops by design — the TPU owns the pods x nodes
+scheduling math; reconciliation is branchy per-object logic where a batch
+device round trip has nothing to amortize.
+"""
+
+from .base import Controller
+from .deployment import DeploymentController
+from .garbagecollector import GarbageCollector
+from .manager import ControllerManager
+from .nodelifecycle import NodeLifecycleController
+from .replicaset import ReplicaSetController
+
+__all__ = ["Controller", "ControllerManager", "DeploymentController",
+           "GarbageCollector", "NodeLifecycleController",
+           "ReplicaSetController"]

@@ -1,0 +1,125 @@
+"""Shared controller plumbing.
+
+Ref: the worker-pool shape every pkg/controller/* loop uses —
+processNextWorkItem off a rate-limited workqueue with forget-on-success /
+AddRateLimited-on-error (e.g. deployment_controller.go:460-486), plus
+ControllerExpectations (pkg/controller/controller_utils.go:150-260), the
+in-flight create/delete accounting that stops a controller from double-
+acting on its own unobserved writes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from ..state.workqueue import RateLimitingQueue
+
+
+class Controller:
+    """informer handlers -> workqueue -> sync(key), N workers."""
+
+    name = "controller"
+
+    def __init__(self, workers: int = 1):
+        self.queue = RateLimitingQueue()
+        self.workers = workers
+        self._threads: List[threading.Thread] = []
+        self._stopped = False
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def enqueue_after(self, key: str, delay: float) -> None:
+        self.queue.add_after(key, delay)
+
+    def sync(self, key: str) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def run(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"{self.name}-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self) -> None:
+        while True:
+            key, shutdown = self.queue.get()
+            if shutdown:
+                return
+            if key is None:
+                continue
+            try:
+                self.sync(key)
+            except Exception:
+                traceback.print_exc()
+                self.queue.add_rate_limited(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+EXPECTATION_TIMEOUT = 300.0  # ExpectationsTimeout, controller_utils.go:46
+
+
+class Expectations:
+    """Per-key outstanding creations (a counter) and deletions (tracked by
+    pod UID — ref: UIDTrackingControllerExpectations) the controller is
+    waiting to observe via informer events. sync() must no-op its
+    create/delete phase until satisfied, or a slow informer would make it
+    double-create. Deletions track UIDs because a bare counter
+    double-decrements when a failed delete's compensation races that pod's
+    own (late) informer delete event."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> [outstanding_adds, outstanding_delete_uids, created_at]
+        self._exp: Dict[str, list] = {}
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._lock:
+            self._exp[key] = [n, set(), time.time()]
+
+    def expect_deletions(self, key: str, uids) -> None:
+        with self._lock:
+            self._exp[key] = [0, set(uids), time.time()]
+
+    def creation_observed(self, key: str) -> None:
+        with self._lock:
+            cur = self._exp.get(key)
+            if cur is not None:
+                cur[0] -= 1
+
+    def deletion_observed(self, key: str, uid: str) -> None:
+        with self._lock:
+            cur = self._exp.get(key)
+            if cur is not None:
+                cur[1].discard(uid)
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            cur = self._exp.get(key)
+            if cur is None:
+                return True
+            adds, del_uids, ts = cur
+            if adds <= 0 and not del_uids:
+                del self._exp[key]
+                return True
+            if time.time() - ts > EXPECTATION_TIMEOUT:
+                del self._exp[key]
+                return True
+            return False
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._exp.pop(key, None)

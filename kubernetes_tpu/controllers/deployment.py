@@ -1,0 +1,281 @@
+"""Deployment controller — rollouts via owned ReplicaSets.
+
+Ref: pkg/controller/deployment/{deployment_controller.go (syncDeployment
+:560), sync.go (getAllReplicaSetsAndSyncRevision, scale), rolling.go
+(rolloutRolling: scaleUpNewReplicaSetForRollingUpdate /
+scaleDownOldReplicaSetsForRollingUpdate incl. cleanupUnhealthyReplicas),
+recreate.go, util/deployment_util.go (MaxSurge/MaxUnavailable int-or-percent
+resolution, template hashing)}.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import List, Optional, Tuple
+
+from ..api import serde
+from ..api.apps import Deployment, ReplicaSet, ReplicaSetSpec
+from ..api.core import Pod, PodTemplateSpec
+from ..api.meta import (LabelSelector, ObjectMeta, controller_ref,
+                        new_controller_ref)
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+HASH_LABEL = "pod-template-hash"  # ref: DefaultDeploymentUniqueLabelKey
+
+
+def resolve_int_or_percent(value: Optional[str], total: int,
+                           round_up: bool) -> int:
+    """Ref: intstr.GetValueFromIntOrPercent."""
+    if value is None:
+        return 0
+    s = str(value)
+    if s.endswith("%"):
+        frac = int(s[:-1]) / 100.0 * total
+        return math.ceil(frac) if round_up else math.floor(frac)
+    return int(s)
+
+
+def max_surge_unavailable(d: Deployment) -> Tuple[int, int]:
+    """Ref: deployment_util.go ResolveFenceposts — surge rounds up,
+    unavailable rounds down; both-zero degenerates to unavailable=1."""
+    ru = d.spec.strategy.rolling_update
+    surge_v = ru.max_surge if ru else "25%"
+    unav_v = ru.max_unavailable if ru else "25%"
+    if surge_v is None:
+        surge_v = "25%"
+    if unav_v is None:
+        unav_v = "25%"
+    surge = resolve_int_or_percent(surge_v, d.spec.replicas, True)
+    unavailable = resolve_int_or_percent(unav_v, d.spec.replicas, False)
+    if surge == 0 and unavailable == 0:
+        unavailable = 1
+    return surge, unavailable
+
+
+def template_hash(tmpl: PodTemplateSpec) -> str:
+    """Deterministic short hash of the pod template, the HASH_LABEL value
+    (ref: deployment_util.go ComputeHash — fnv over the struct; any stable
+    digest serves)."""
+    cleaned = serde.deepcopy_obj(tmpl)
+    cleaned.metadata.labels.pop(HASH_LABEL, None)
+    payload = serde.to_json_str(cleaned)
+    return hashlib.sha256(payload.encode()).hexdigest()[:10]
+
+
+def _templates_equal(a: PodTemplateSpec, b: PodTemplateSpec) -> bool:
+    """Ref: EqualIgnoreHash (deployment_util.go:633)."""
+    ca, cb = serde.deepcopy_obj(a), serde.deepcopy_obj(b)
+    ca.metadata.labels.pop(HASH_LABEL, None)
+    cb.metadata.labels.pop(HASH_LABEL, None)
+    return ca == cb
+
+
+class DeploymentController(Controller):
+    name = "deployment"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.d_informer = informers.informer_for(Deployment)
+        self.rs_informer = informers.informer_for(ReplicaSet)
+        self.pod_informer = informers.informer_for(Pod)
+        self.d_informer.add_event_handlers(EventHandlers(
+            on_add=lambda d: self.enqueue(d.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key()),
+            on_delete=lambda d: self.enqueue(d.metadata.key())))
+        self.rs_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_rs_event,
+            on_update=lambda o, n: self._on_rs_event(n),
+            on_delete=self._on_rs_event))
+        # pod deletions gate the Recreate rollout (ref: deletePod handler,
+        # deployment_controller.go:271)
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_delete=self._on_pod_delete))
+
+    def _on_rs_event(self, rs: ReplicaSet) -> None:
+        ref = controller_ref(rs.metadata)
+        if ref is not None and ref.kind == "Deployment":
+            self.enqueue(f"{rs.metadata.namespace}/{ref.name}")
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        ref = controller_ref(pod.metadata)
+        if ref is None or ref.kind != "ReplicaSet":
+            return
+        rs = self.rs_informer.indexer.get_by_key(
+            f"{pod.metadata.namespace}/{ref.name}")
+        if rs is not None:
+            self._on_rs_event(rs)
+
+    # ------------------------------------------------------------- sync
+
+    def sync(self, key: str) -> None:
+        d = self.d_informer.indexer.get_by_key(key)
+        if d is None or d.metadata.deletion_timestamp is not None:
+            return
+        owned = self._owned_replica_sets(d)
+        new_rs, old_rss = self._find_new_and_old(d, owned)
+        if d.spec.paused:
+            self._sync_status(d, new_rs, old_rss)
+            return
+        if new_rs is None:
+            new_rs = self._create_new_rs(d, owned)
+            if new_rs is None:
+                return
+        if d.spec.strategy.type == "Recreate":
+            self._rollout_recreate(d, new_rs, old_rss)
+        else:
+            self._rollout_rolling(d, new_rs, old_rss)
+        self._sync_status(d, new_rs, old_rss)
+
+    def _owned_replica_sets(self, d: Deployment) -> List[ReplicaSet]:
+        out = []
+        for rs in self.rs_informer.indexer.list(d.metadata.namespace):
+            ref = controller_ref(rs.metadata)
+            if ref is not None and ref.uid == d.metadata.uid:
+                out.append(rs)
+        return out
+
+    def _find_new_and_old(self, d: Deployment, owned: List[ReplicaSet]
+                          ) -> Tuple[Optional[ReplicaSet], List[ReplicaSet]]:
+        """Newest owned RS with the deployment's current template is 'new'
+        (ref: FindNewReplicaSet sorts by creation time)."""
+        new_rs = None
+        for rs in sorted(owned,
+                         key=lambda r: r.metadata.creation_timestamp or ""):
+            if _templates_equal(rs.spec.template, d.spec.template):
+                new_rs = rs
+                break
+        old = [rs for rs in owned
+               if new_rs is None or rs.metadata.uid != new_rs.metadata.uid]
+        return new_rs, old
+
+    def _create_new_rs(self, d: Deployment,
+                       owned: List[ReplicaSet]) -> Optional[ReplicaSet]:
+        h = template_hash(d.spec.template)
+        tmpl = serde.deepcopy_obj(d.spec.template)
+        tmpl.metadata.labels[HASH_LABEL] = h
+        sel_labels = dict((d.spec.selector.match_labels
+                           if d.spec.selector else tmpl.metadata.labels))
+        sel_labels[HASH_LABEL] = h
+        rs = ReplicaSet(
+            metadata=ObjectMeta(
+                name=f"{d.metadata.name}-{h}",
+                namespace=d.metadata.namespace,
+                labels=dict(tmpl.metadata.labels),
+                owner_references=[new_controller_ref(
+                    "Deployment", d.api_version, d.metadata)]),
+            spec=ReplicaSetSpec(
+                replicas=0,  # scaled by the rollout logic
+                selector=LabelSelector(match_labels=sel_labels),
+                template=tmpl,
+                min_ready_seconds=d.spec.min_ready_seconds))
+        try:
+            return self.client.replica_sets(d.metadata.namespace).create(rs)
+        except Exception:
+            # AlreadyExists: informer lag; retry next sync
+            return self.rs_informer.indexer.get_by_key(
+                f"{d.metadata.namespace}/{rs.metadata.name}")
+
+    def _scale_rs(self, rs: ReplicaSet, replicas: int) -> ReplicaSet:
+        """Returns the patched copy; `rs` (a frozen canonical store object)
+        is never written through."""
+        if rs.spec.replicas == replicas:
+            return rs
+        def mutate(cur):
+            cur.spec.replicas = replicas
+            return cur
+        return self.client.replica_sets(rs.metadata.namespace).patch(
+            rs.metadata.name, mutate)
+
+    # ---------------------------------------------------------- rollouts
+
+    def _rollout_recreate(self, d: Deployment, new_rs: ReplicaSet,
+                          old_rss: List[ReplicaSet]) -> None:
+        """Ref: recreate.go rolloutRecreate — old down to zero, wait for
+        their pods to vanish, then new up. The gate checks ACTUAL pods, not
+        RS status: terminating pods (deletion timestamp set, finalizers
+        pending) have already left status.replicas but still run, and
+        Recreate's contract is zero overlap (ref: oldPodsRunning)."""
+        for rs in old_rss:
+            self._scale_rs(rs, 0)
+        old_uids = {rs.metadata.uid for rs in old_rss}
+        for pod in self.pod_informer.indexer.list(d.metadata.namespace):
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            ref = controller_ref(pod.metadata)
+            if ref is not None and ref.uid in old_uids:
+                return  # pod delete events will re-enqueue
+        self._scale_rs(new_rs, d.spec.replicas)
+
+    def _rollout_rolling(self, d: Deployment, new_rs: ReplicaSet,
+                         old_rss: List[ReplicaSet]) -> None:
+        """Ref: rolling.go rolloutRolling."""
+        surge, unavailable = max_surge_unavailable(d)
+        actives = [new_rs] + [rs for rs in old_rss if rs.spec.replicas > 0]
+        total = sum(rs.spec.replicas for rs in actives)
+        # scale up (scaleUpNewReplicaSetForRollingUpdate)
+        if new_rs.spec.replicas < d.spec.replicas:
+            allowed = d.spec.replicas + surge - total
+            if allowed > 0:
+                self._scale_rs(new_rs, min(d.spec.replicas,
+                                           new_rs.spec.replicas + allowed))
+                return  # one move per sync, like the reference
+        # scale down (scaleDownOldReplicaSetsForRollingUpdate):
+        # unhealthy old replicas go first and cost nothing from the budget
+        for rs in old_rss:
+            unhealthy = rs.spec.replicas - rs.status.available_replicas
+            if rs.spec.replicas > 0 and unhealthy > 0:
+                self._scale_rs(rs, max(0, rs.spec.replicas - unhealthy))
+                return
+        total_available = sum(rs.status.available_replicas
+                              for rs in [new_rs] + old_rss)
+        budget = total_available - (d.spec.replicas - unavailable)
+        if budget <= 0:
+            return
+        for rs in sorted(old_rss,
+                         key=lambda r: r.metadata.creation_timestamp or ""):
+            if budget <= 0:
+                break
+            if rs.spec.replicas == 0:
+                continue
+            down = min(budget, rs.spec.replicas)
+            self._scale_rs(rs, rs.spec.replicas - down)
+            budget -= down
+
+    def _sync_status(self, d: Deployment, new_rs: Optional[ReplicaSet],
+                     old_rss: List[ReplicaSet]) -> None:
+        """Ref: sync.go syncDeploymentStatus / calculateStatus."""
+        all_rss = ([new_rs] if new_rs is not None else []) + old_rss
+        replicas = sum(rs.status.replicas for rs in all_rss)
+        ready = sum(rs.status.ready_replicas for rs in all_rss)
+        available = sum(rs.status.available_replicas for rs in all_rss)
+        updated = new_rs.status.replicas if new_rs is not None else 0
+        st = d.status
+        # observe the generation this sync RECONCILED, not whatever the live
+        # object has at patch time — a concurrent spec bump must not be
+        # reported as observed with stale counts (rollout waiters check
+        # observedGeneration >= generation)
+        observed = d.metadata.generation
+        if (st.replicas == replicas and st.updated_replicas == updated
+                and st.ready_replicas == ready
+                and st.available_replicas == available
+                and st.observed_generation == observed):
+            return
+        def mutate(cur):
+            cur.status.replicas = replicas
+            cur.status.updated_replicas = updated
+            cur.status.ready_replicas = ready
+            cur.status.available_replicas = available
+            cur.status.unavailable_replicas = max(
+                0, cur.spec.replicas - available)
+            cur.status.observed_generation = max(
+                cur.status.observed_generation, observed)
+            return cur
+        try:
+            self.client.deployments(d.metadata.namespace).patch(
+                d.metadata.name, mutate)
+        except Exception:
+            pass

@@ -1,0 +1,48 @@
+"""Controller manager — wires and runs the control loops.
+
+Ref: cmd/kube-controller-manager/app/controllermanager.go (StartControllers
+:367-403 registers 33 NewControllerInitializers; each gets the shared
+informer factory and a client). Leader election wraps Run in the reference;
+here it is available via state.leaderelection and applied by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..state.informer import SharedInformerFactory
+from .deployment import DeploymentController
+from .garbagecollector import GarbageCollector
+from .nodelifecycle import NodeLifecycleController
+from .replicaset import ReplicaSetController
+
+
+class ControllerManager:
+    def __init__(self, client,
+                 informers: Optional[SharedInformerFactory] = None,
+                 node_monitor_period: float = 5.0,
+                 node_grace_period: float = 40.0,
+                 pod_eviction_timeout: float = 300.0):
+        self.client = client
+        self.informers = informers or SharedInformerFactory(client)
+        self.replicaset = ReplicaSetController(client, self.informers)
+        self.deployment = DeploymentController(client, self.informers)
+        self.nodelifecycle = NodeLifecycleController(
+            client, self.informers,
+            monitor_period=node_monitor_period,
+            grace_period=node_grace_period,
+            eviction_timeout=pod_eviction_timeout)
+        self.garbagecollector = GarbageCollector(client, self.informers)
+        self.controllers: List = [self.replicaset, self.deployment,
+                                  self.nodelifecycle, self.garbagecollector]
+
+    def start(self) -> None:
+        self.informers.start()
+        self.informers.wait_for_cache_sync()
+        for c in self.controllers:
+            c.run()
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+        self.informers.stop()

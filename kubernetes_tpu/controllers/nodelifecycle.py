@@ -1,0 +1,182 @@
+"""Node lifecycle controller — health monitoring, taints, eviction.
+
+Ref: pkg/controller/nodelifecycle/node_lifecycle_controller.go (2,698 LoC):
+monitorNodeHealth (heartbeat staleness -> Ready=Unknown), the not-ready/
+unreachable NoExecute+NoSchedule taints, and pod eviction after
+--pod-eviction-timeout. The reference splits taint application (NoExecute
+taint manager) from the classic eviction path; here one monitor loop does
+both: taint immediately on not-ready, evict the node's pods once the
+condition has persisted past the eviction timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, Optional
+
+from ..api import helpers, wellknown
+from ..api.core import Node, Pod, Taint
+from ..api.meta import controller_ref
+from ..state.informer import SharedInformerFactory
+from ..utils.clock import Clock, REAL_CLOCK, now_iso, parse_iso
+
+DEFAULT_MONITOR_PERIOD = 5.0      # --node-monitor-period
+DEFAULT_GRACE_PERIOD = 40.0       # --node-monitor-grace-period
+DEFAULT_EVICTION_TIMEOUT = 300.0  # --pod-eviction-timeout
+
+
+class NodeLifecycleController:
+    name = "nodelifecycle"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 monitor_period: float = DEFAULT_MONITOR_PERIOD,
+                 grace_period: float = DEFAULT_GRACE_PERIOD,
+                 eviction_timeout: float = DEFAULT_EVICTION_TIMEOUT,
+                 clock: Clock = REAL_CLOCK):
+        self.client = client
+        self.clock = clock
+        self.monitor_period = monitor_period
+        self.grace_period = grace_period
+        self.eviction_timeout = eviction_timeout
+        self.node_informer = informers.informer_for(Node)
+        self.pod_informer = informers.informer_for(Pod)
+        #: node name -> monotonic time the node was first seen not-ready
+        self._not_ready_since: Dict[str, float] = {}
+        self.evicted_pod_count = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._monitor_loop,
+                                        daemon=True, name=self.name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.monitor_period):
+            try:
+                self.monitor_once()
+            except Exception:
+                traceback.print_exc()
+
+    # ------------------------------------------------------------ monitor
+
+    def monitor_once(self) -> None:
+        """One monitorNodeHealth pass over every known node."""
+        for node in self.node_informer.indexer.list():
+            self._check_node(node)
+
+    def _ready_condition(self, node: Node):
+        for cond in node.status.conditions:
+            if cond.type == "Ready":
+                return cond
+        return None
+
+    def _check_node(self, node: Node) -> None:
+        name = node.metadata.name
+        cond = self._ready_condition(node)
+        hb = parse_iso(cond.last_heartbeat_time) \
+            if cond is not None and cond.last_heartbeat_time else None
+        stale = hb is not None and self.clock.now() - hb > self.grace_period
+        # Unknown with no parseable heartbeat covers the condition this
+        # controller itself wrote: it must stay on the unreachable taint
+        # instead of flip-flopping to not-ready on the next pass
+        if cond is None or stale or (cond.status == "Unknown" and hb is None):
+            # the kubelet stopped reporting: the controller marks Unknown
+            # (ref: monitorNodeHealth setting ConditionUnknown)
+            if cond is None or cond.status != "Unknown":
+                self._set_ready_unknown(node)
+            not_ready, taint_key = True, wellknown.TAINT_NODE_UNREACHABLE
+        elif cond.status != "True":
+            not_ready, taint_key = True, wellknown.TAINT_NODE_NOT_READY
+        else:
+            not_ready, taint_key = False, ""
+        if not_ready:
+            self._ensure_taints(node, taint_key)
+            since = self._not_ready_since.setdefault(name, self.clock.now())
+            if self.clock.now() - since >= self.eviction_timeout:
+                self._evict_pods(name)
+        else:
+            if name in self._not_ready_since:
+                del self._not_ready_since[name]
+            self._clear_taints(node)
+
+    def _set_ready_unknown(self, node: Node) -> None:
+        def mutate(cur):
+            for cond in cur.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = "Unknown"
+                    cond.reason = "NodeStatusUnknown"
+                    cond.last_transition_time = now_iso()
+                    return cur
+            from ..api.core import NodeCondition
+            cur.status.conditions.append(NodeCondition(
+                type="Ready", status="Unknown", reason="NodeStatusUnknown",
+                last_transition_time=now_iso()))
+            return cur
+        try:
+            self.client.nodes().patch(node.metadata.name, mutate)
+        except Exception:
+            pass
+
+    _OUR_TAINTS = (wellknown.TAINT_NODE_NOT_READY,
+                   wellknown.TAINT_NODE_UNREACHABLE)
+
+    def _ensure_taints(self, node: Node, key: str) -> None:
+        wanted = [Taint(key=key, effect="NoSchedule", time_added=now_iso()),
+                  Taint(key=key, effect="NoExecute", time_added=now_iso())]
+        have = {(t.key, t.effect) for t in node.spec.taints}
+        missing = [t for t in wanted if (t.key, t.effect) not in have]
+        stale = [t for t in node.spec.taints
+                 if t.key in self._OUR_TAINTS and t.key != key]
+        if not missing and not stale:
+            return
+        def mutate(cur):
+            cur.spec.taints = [
+                t for t in cur.spec.taints
+                if not (t.key in self._OUR_TAINTS and t.key != key)]
+            have_now = {(t.key, t.effect) for t in cur.spec.taints}
+            for t in wanted:
+                if (t.key, t.effect) not in have_now:
+                    cur.spec.taints.append(t)
+            return cur
+        try:
+            self.client.nodes().patch(node.metadata.name, mutate)
+        except Exception:
+            pass
+
+    def _clear_taints(self, node: Node) -> None:
+        if not any(t.key in self._OUR_TAINTS for t in node.spec.taints):
+            return
+        def mutate(cur):
+            cur.spec.taints = [t for t in cur.spec.taints
+                               if t.key not in self._OUR_TAINTS]
+            return cur
+        try:
+            self.client.nodes().patch(node.metadata.name, mutate)
+        except Exception:
+            pass
+
+    def _evict_pods(self, node_name: str) -> None:
+        """Delete the dead node's pods so their controllers replace them
+        (ref: the classic eviction path; DaemonSet pods are left — their
+        controller pins them to nodes)."""
+        # O(pods-on-node): the factory registers the nodeName index on the
+        # pod informer for exactly this lookup
+        for pod in self.pod_informer.indexer.by_index("nodeName", node_name):
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            ref = controller_ref(pod.metadata)
+            if ref is not None and ref.kind == "DaemonSet":
+                continue
+            try:
+                self.client.pods(pod.metadata.namespace).delete(
+                    pod.metadata.name)
+                self.evicted_pod_count += 1
+            except Exception:
+                pass

@@ -1,0 +1,219 @@
+"""ReplicaSet controller.
+
+Ref: pkg/controller/replicaset/replica_set.go (syncReplicaSet :562,
+manageReplicas :459) + pkg/controller/controller_utils.go (PodControllerRefManager
+adoption/orphaning, ActivePods deletion ranking, ControllerExpectations).
+
+Also reconciles ReplicationControllers: the reference's rc controller is a
+thin wrapper over the same logic (pkg/controller/replication/conversion.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..api import helpers, labels as labelsmod, serde
+from ..api.apps import ReplicaSet
+from ..api.core import Pod
+from ..api.meta import (LabelSelector, ObjectMeta, controller_ref,
+                        new_controller_ref)
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller, Expectations
+
+
+def pod_is_active(pod: Pod) -> bool:
+    """Ref: controller_utils.go IsPodActive."""
+    return (pod.status.phase not in ("Succeeded", "Failed")
+            and pod.metadata.deletion_timestamp is None)
+
+
+def pod_is_ready(pod: Pod) -> bool:
+    return any(c.type == "Ready" and c.status == "True"
+               for c in pod.status.conditions)
+
+
+def _deletion_rank(pod: Pod):
+    """Ref: controller_utils.go ActivePods.Less — prefer deleting unassigned,
+    then pending, then not-ready, then the youngest."""
+    return (
+        0 if not pod.spec.node_name else 1,
+        0 if pod.status.phase == "Pending" else 1,
+        0 if not pod_is_ready(pod) else 1,
+        # youngest first within a class: reverse creation order
+        tuple(-ord(c) for c in (pod.metadata.creation_timestamp or "")),
+    )
+
+
+class ReplicaSetController(Controller):
+    name = "replicaset"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 kind=ReplicaSet, workers: int = 2,
+                 burst_replicas: int = 500):
+        super().__init__(workers)
+        self.client = client
+        self.kind = kind
+        self.api_version = kind().api_version
+        self.burst_replicas = burst_replicas
+        self.expectations = Expectations()
+        self.rs_informer = informers.informer_for(kind)
+        self.pod_informer = informers.informer_for(Pod)
+        self.rs_informer.add_event_handlers(EventHandlers(
+            on_add=lambda rs: self.enqueue(rs.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key()),
+            on_delete=self._on_rs_delete))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete))
+
+    # --------------------------------------------------------- handlers
+
+    def _rs_key_of_pod(self, pod: Pod) -> Optional[str]:
+        ref = controller_ref(pod.metadata)
+        if ref is None or ref.kind != self.kind().kind:
+            return None
+        return f"{pod.metadata.namespace}/{ref.name}"
+
+    def _on_rs_delete(self, rs) -> None:
+        key = rs.metadata.key()
+        self.expectations.delete(key)
+        self.enqueue(key)
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        key = self._rs_key_of_pod(pod)
+        if key is not None:
+            self.expectations.creation_observed(key)
+            self.enqueue(key)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        key = self._rs_key_of_pod(new)
+        if key is not None:
+            self.enqueue(key)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        key = self._rs_key_of_pod(pod)
+        if key is not None:
+            self.expectations.deletion_observed(key, pod.metadata.uid)
+            self.enqueue(key)
+
+    # ------------------------------------------------------------- sync
+
+    def _client_for(self):
+        return self.client.resource(self.kind)
+
+    def sync(self, key: str) -> None:
+        """Ref: syncReplicaSet :562."""
+        rs = self.rs_informer.indexer.get_by_key(key)
+        if rs is None:
+            self.expectations.delete(key)
+            return
+        sel = rs.spec.selector or LabelSelector(
+            match_labels=dict(rs.spec.template.metadata.labels))
+        pods = self._claim_pods(rs, sel)
+        active = [p for p in pods if pod_is_active(p)]
+        if self.expectations.satisfied(key):
+            self._manage_replicas(key, rs, active)
+        self._update_status(rs, active)
+
+    def _claim_pods(self, rs, sel: LabelSelector) -> List[Pod]:
+        """Owned pods + adoption of matching orphans
+        (ref: PodControllerRefManager.ClaimPods)."""
+        out: List[Pod] = []
+        my_uid = rs.metadata.uid
+        for pod in self.pod_informer.indexer.list(rs.metadata.namespace):
+            ref = controller_ref(pod.metadata)
+            if ref is not None:
+                if ref.uid == my_uid:
+                    out.append(pod)
+                continue
+            if rs.metadata.deletion_timestamp is not None:
+                continue
+            if not labelsmod.matches(sel, pod.metadata.labels) or \
+                    pod.metadata.deletion_timestamp is not None:
+                continue
+            # orphan adoption
+            owner = new_controller_ref(self.kind().kind, self.api_version,
+                                       rs.metadata)
+            def adopt(cur, _owner=owner):
+                if controller_ref(cur.metadata) is None:
+                    cur.metadata.owner_references.append(_owner)
+                return cur
+            try:
+                out.append(self.client.pods(pod.metadata.namespace).patch(
+                    pod.metadata.name, adopt))
+            except Exception:
+                pass
+        return out
+
+    def _manage_replicas(self, key: str, rs, active: List[Pod]) -> None:
+        """Ref: manageReplicas :459."""
+        diff = len(active) - rs.spec.replicas
+        if diff < 0:
+            n = min(-diff, self.burst_replicas)
+            self.expectations.expect_creations(key, n)
+            created = 0
+            for _ in range(n):
+                try:
+                    self._create_pod(rs)
+                    created += 1
+                except Exception:
+                    break
+            # creations that never happened will never be observed
+            for _ in range(n - created):
+                self.expectations.creation_observed(key)
+        elif diff > 0:
+            n = min(diff, self.burst_replicas)
+            victims = sorted(active, key=_deletion_rank)[:n]
+            self.expectations.expect_deletions(
+                key, [p.metadata.uid for p in victims])
+            for pod in victims:
+                try:
+                    self.client.pods(pod.metadata.namespace).delete(
+                        pod.metadata.name)
+                except Exception:
+                    self.expectations.deletion_observed(key,
+                                                        pod.metadata.uid)
+
+    def _create_pod(self, rs) -> None:
+        tmpl = rs.spec.template
+        pod = Pod(
+            metadata=ObjectMeta(
+                generate_name=f"{rs.metadata.name}-",
+                namespace=rs.metadata.namespace,
+                labels=dict(tmpl.metadata.labels),
+                annotations=dict(tmpl.metadata.annotations),
+                owner_references=[new_controller_ref(
+                    self.kind().kind, self.api_version, rs.metadata)]),
+            spec=serde.deepcopy_obj(tmpl.spec))
+        self.client.pods(rs.metadata.namespace).create(pod)
+
+    def _update_status(self, rs, active: List[Pod]) -> None:
+        """Ref: updateReplicaSetStatus (replica_set_utils.go)."""
+        ready = sum(1 for p in active if pod_is_ready(p))
+        available = ready  # minReadySeconds elided: no per-pod ready clocks
+        fully_labeled = sum(
+            1 for p in active
+            if all(p.metadata.labels.get(k) == v
+                   for k, v in rs.spec.template.metadata.labels.items()))
+        st = rs.status
+        observed = rs.metadata.generation  # the generation THIS sync saw
+        if (st.replicas == len(active) and st.ready_replicas == ready
+                and st.available_replicas == available
+                and st.fully_labeled_replicas == fully_labeled
+                and st.observed_generation == observed):
+            return
+        def mutate(cur):
+            cur.status.replicas = len(active)
+            cur.status.fully_labeled_replicas = fully_labeled
+            cur.status.ready_replicas = ready
+            cur.status.available_replicas = available
+            cur.status.observed_generation = max(
+                cur.status.observed_generation, observed)
+            return cur
+        try:
+            self._client_for().patch(rs.metadata.name, mutate,
+                                     namespace=rs.metadata.namespace)
+        except Exception:
+            pass

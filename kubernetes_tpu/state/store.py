@@ -124,6 +124,11 @@ class Store:
             if not meta.uid:
                 self._uid_counter += 1
                 meta.uid = f"uid-{self._uid_counter:08x}"
+            if meta.creation_timestamp is None:
+                from ..utils.clock import now_iso
+                meta.creation_timestamp = now_iso()
+            if meta.generation == 0 and hasattr(stored, "spec"):
+                meta.generation = 1  # ref: registry strategies PrepareForCreate
             meta.resource_version = str(self._rv)
             bucket[key] = (stored, self._rv)
             self._publish(resource, WatchEvent(ADDED, stored, self._rv))
@@ -147,6 +152,17 @@ class Store:
             stored.metadata.resource_version = str(self._rv)
             if not stored.metadata.uid:
                 stored.metadata.uid = cur_obj.metadata.uid
+            if stored.metadata.creation_timestamp is None:
+                stored.metadata.creation_timestamp = \
+                    cur_obj.metadata.creation_timestamp
+            # spec changes bump metadata.generation (ref: registry strategies
+            # PrepareForUpdate; status-only writes keep it). The bind hot path
+            # (bulk_apply) intentionally skips this comparison.
+            if hasattr(stored, "spec"):
+                if stored.spec != cur_obj.spec:
+                    stored.metadata.generation = cur_obj.metadata.generation + 1
+                else:
+                    stored.metadata.generation = cur_obj.metadata.generation
             # removing the last finalizer completes a pending deletion
             # (ref: registry/generic Store.Update deleteCollection path)
             if stored.metadata.deletion_timestamp is not None and \

@@ -43,3 +43,12 @@ REAL_CLOCK = Clock()
 def now_iso(clock: Clock = REAL_CLOCK) -> str:
     return datetime.fromtimestamp(clock.now(), tz=timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_iso(ts: str):
+    """RFC3339 -> unix seconds, or None on malformed input."""
+    try:
+        return datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ") \
+            .replace(tzinfo=timezone.utc).timestamp()
+    except (ValueError, TypeError):
+        return None

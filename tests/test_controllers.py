@@ -1,0 +1,326 @@
+"""Controller tests: ReplicaSet reconcile, Deployment rollouts, node
+lifecycle eviction, GC cascade — modeled on
+pkg/controller/{replicaset,deployment,nodelifecycle,garbagecollector} tests
+and the e2e Deployment flows.
+"""
+
+import time
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Client
+
+
+def make_node(name, cpu="4", mem="32Gi", pods=110):
+    alloc = {"cpu": Quantity(cpu), "memory": Quantity(mem),
+             "pods": Quantity(pods)}
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            capacity=dict(alloc), allocatable=dict(alloc),
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def pod_template(labels, cpu="100m"):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(
+            name="app", image="img:v1",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu),
+                          "memory": Quantity("64Mi")}))]))
+
+
+def make_rs(name, replicas, labels):
+    return api.ReplicaSet(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ReplicaSetSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=pod_template(labels)))
+
+
+def make_deployment(name, replicas, labels, image="img:v1"):
+    tmpl = pod_template(labels)
+    tmpl.spec.containers[0].image = image
+    return api.Deployment(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.DeploymentSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=tmpl))
+
+
+def wait_for(fn, timeout=15.0, period=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(period)
+    return fn()
+
+
+def mark_pods_ready(client, selector_labels):
+    """Fake-kubelet helper: flip matching bound pods to Running/Ready (the
+    reference's integration tests have no kubelet either and fake status)."""
+    for pod in client.pods("default").list():
+        if not pod.spec.node_name:
+            continue
+        if not all(pod.metadata.labels.get(k) == v
+                   for k, v in selector_labels.items()):
+            continue
+        if any(c.type == "Ready" and c.status == "True"
+               for c in pod.status.conditions):
+            continue
+        def mutate(cur):
+            cur.status.phase = "Running"
+            cur.status.conditions.append(api.PodCondition(
+                type="Ready", status="True"))
+            return cur
+        client.pods("default").patch(pod.metadata.name, mutate)
+
+
+class TestReplicaSetController:
+    def test_creates_and_scales_pods(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.replica_sets("default").create(
+                make_rs("web", 3, {"app": "web"}))
+            assert wait_for(lambda: len(client.pods("default").list()) == 3)
+            pods = client.pods("default").list()
+            ref = api.controller_ref(pods[0].metadata)
+            assert ref is not None and ref.kind == "ReplicaSet"
+            assert ref.name == "web"
+            # scale down
+            def scale(cur):
+                cur.spec.replicas = 1
+                return cur
+            client.replica_sets("default").patch("web", scale)
+            assert wait_for(lambda: len(client.pods("default").list()) == 1)
+            # scale up again
+            def scale_up(cur):
+                cur.spec.replicas = 2
+                return cur
+            client.replica_sets("default").patch("web", scale_up)
+            assert wait_for(lambda: len(client.pods("default").list()) == 2)
+            rs = client.replica_sets("default").get("web")
+            assert wait_for(lambda: client.replica_sets("default")
+                            .get("web").status.replicas == 2)
+        finally:
+            mgr.stop()
+
+    def test_adopts_matching_orphans(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            orphan = api.Pod(
+                metadata=api.ObjectMeta(name="orphan", namespace="default",
+                                        labels={"app": "web"}),
+                spec=pod_template({"app": "web"}).spec)
+            client.pods("default").create(orphan)
+            client.replica_sets("default").create(
+                make_rs("web", 1, {"app": "web"}))
+            def adopted():
+                p = client.pods("default").get("orphan")
+                ref = api.controller_ref(p.metadata)
+                return ref is not None and ref.name == "web"
+            assert wait_for(adopted)
+            # the orphan satisfies the replica count: no second pod
+            time.sleep(0.3)
+            assert len(client.pods("default").list()) == 1
+        finally:
+            mgr.stop()
+
+    def test_replaces_deleted_pod(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.replica_sets("default").create(
+                make_rs("web", 2, {"app": "web"}))
+            assert wait_for(lambda: len(client.pods("default").list()) == 2)
+            victim = client.pods("default").list()[0]
+            client.pods("default").delete(victim.metadata.name)
+            assert wait_for(
+                lambda: len(client.pods("default").list()) == 2 and
+                all(p.metadata.name != victim.metadata.name
+                    for p in client.pods("default").list()))
+        finally:
+            mgr.stop()
+
+
+class TestDeploymentController:
+    def test_deployment_creates_rs_and_pods(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.deployments("default").create(
+                make_deployment("site", 3, {"app": "site"}))
+            assert wait_for(lambda: len(client.pods("default").list()) == 3)
+            rss = client.replica_sets("default").list()
+            assert len(rss) == 1
+            assert rss[0].metadata.name.startswith("site-")
+            ref = api.controller_ref(rss[0].metadata)
+            assert ref is not None and ref.kind == "Deployment"
+            # pods carry the pod-template-hash label
+            for p in client.pods("default").list():
+                assert "pod-template-hash" in p.metadata.labels
+        finally:
+            mgr.stop()
+
+    def test_rolling_update_replaces_rs(self):
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        sched = Scheduler(client, batch_size=32)
+        mgr = ControllerManager(client)
+        mgr.start()
+        sched.start()
+        try:
+            client.deployments("default").create(
+                make_deployment("site", 3, {"app": "site"}, image="img:v1"))
+            assert wait_for(lambda: len([
+                p for p in client.pods("default").list()
+                if p.spec.node_name]) == 3, timeout=30)
+            mark_pods_ready(client, {"app": "site"})
+            assert wait_for(lambda: client.deployments("default")
+                            .get("site").status.available_replicas == 3,
+                            timeout=30)
+            # roll to v2; keep marking pods ready as they appear (fake kubelet)
+            def bump(cur):
+                cur.spec.template.spec.containers[0].image = "img:v2"
+                return cur
+            client.deployments("default").patch("site", bump)
+
+            def rolled():
+                mark_pods_ready(client, {"app": "site"})
+                pods = [p for p in client.pods("default").list()
+                        if p.metadata.deletion_timestamp is None]
+                return (len(pods) == 3 and all(
+                    p.spec.containers[0].image == "img:v2" for p in pods))
+            assert wait_for(rolled, timeout=30)
+            # old RS scaled to zero but retained (revision history)
+            rss = client.replica_sets("default").list()
+            assert len(rss) == 2
+            by_replicas = sorted(rss, key=lambda r: r.spec.replicas)
+            assert by_replicas[0].spec.replicas == 0
+            assert by_replicas[1].spec.replicas == 3
+        finally:
+            sched.stop()
+            mgr.stop()
+
+
+class TestGarbageCollector:
+    def test_cascade_delete(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.deployments("default").create(
+                make_deployment("site", 2, {"app": "site"}))
+            assert wait_for(lambda: len(client.pods("default").list()) == 2)
+            client.deployments("default").delete("site")
+            assert wait_for(
+                lambda: not client.replica_sets("default").list(), timeout=20)
+            assert wait_for(
+                lambda: not client.pods("default").list(), timeout=20)
+        finally:
+            mgr.stop()
+
+    def test_sweep_collects_preexisting_orphans(self):
+        client = Client()
+        # a pod owned by a uid that never existed in this store
+        pod = api.Pod(
+            metadata=api.ObjectMeta(
+                name="ghost", namespace="default",
+                owner_references=[api.OwnerReference(
+                    api_version="apps/v1", kind="ReplicaSet",
+                    name="gone", uid="uid-dead", controller=True)]),
+            spec=pod_template({"app": "x"}).spec)
+        client.pods("default").create(pod)
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            n = mgr.garbagecollector.sweep_once()
+            assert n == 1
+            assert wait_for(lambda: not client.pods("default").list())
+        finally:
+            mgr.stop()
+
+
+class TestNodeLifecycle:
+    def test_not_ready_node_tainted_and_evicted(self):
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        client.nodes().create(make_node("n2"))
+        sched = Scheduler(client, batch_size=32)
+        mgr = ControllerManager(client, node_monitor_period=0.1,
+                                pod_eviction_timeout=0.5)
+        mgr.start()
+        sched.start()
+        try:
+            client.replica_sets("default").create(
+                make_rs("web", 2, {"app": "web"}))
+            assert wait_for(lambda: len([
+                p for p in client.pods("default").list()
+                if p.spec.node_name]) == 2, timeout=30)
+            # fail whichever node holds pods (same-batch pods may co-locate:
+            # spread counts freeze at batch start, a documented deviation)
+            dead = client.pods("default").list()[0].spec.node_name
+            alive = "n2" if dead == "n1" else "n1"
+            def fail(cur):
+                for c in cur.status.conditions:
+                    if c.type == "Ready":
+                        c.status = "False"
+                return cur
+            client.nodes().patch(dead, fail)
+            # tainted promptly
+            def tainted():
+                n = client.nodes().get(dead)
+                return any(t.key == api.wellknown.TAINT_NODE_NOT_READY
+                           for t in n.spec.taints)
+            assert wait_for(tainted, timeout=10)
+            # after the eviction timeout the pods land on the healthy node
+            def rescheduled():
+                pods = [p for p in client.pods("default").list()
+                        if p.spec.node_name]
+                return len(pods) == 2 and all(
+                    p.spec.node_name == alive for p in pods)
+            assert wait_for(rescheduled, timeout=30)
+            assert mgr.nodelifecycle.evicted_pod_count >= 1
+            # recovery clears the taints
+            def recover(cur):
+                for c in cur.status.conditions:
+                    if c.type == "Ready":
+                        c.status = "True"
+                return cur
+            client.nodes().patch(dead, recover)
+            assert wait_for(lambda: not client.nodes().get(dead).spec.taints,
+                            timeout=10)
+        finally:
+            sched.stop()
+            mgr.stop()
+
+    def test_stale_heartbeat_marks_unknown(self):
+        client = Client()
+        node = make_node("n1")
+        node.status.conditions[0].last_heartbeat_time = "2020-01-01T00:00:00Z"
+        client.nodes().create(node)
+        mgr = ControllerManager(client, node_monitor_period=0.1)
+        mgr.start()
+        try:
+            def unknown():
+                n = client.nodes().get("n1")
+                cond = next(c for c in n.status.conditions
+                            if c.type == "Ready")
+                return cond.status == "Unknown" and any(
+                    t.key == api.wellknown.TAINT_NODE_UNREACHABLE
+                    for t in n.spec.taints)
+            assert wait_for(unknown, timeout=10)
+        finally:
+            mgr.stop()
