@@ -1,0 +1,19 @@
+"""API serving layer (L3) — HTTP REST + watch over the Store.
+
+Ref: staging/src/k8s.io/apiserver — the generic server's handler chain
+(server/config.go:543-557 DefaultBuildHandlerChain), route installation
+(endpoints/installer.go), REST handlers (endpoints/handlers/), and the
+watch cache's resumable streaming (storage/cacher/cacher.go). Reduced to
+the serving surface the in-process components actually exercise, so the
+scheduler and controllers can run as SEPARATE PROCESSES against the same
+hub — the hub-and-spoke property that defines the reference architecture.
+
+    APIServer      server.py      — ThreadingHTTPServer, REST + ?watch=true
+    HTTPClient     httpclient.py  — state.Client-compatible client over REST
+    admission      server.py      — mutating/validating hook chain on writes
+"""
+
+from .httpclient import HTTPClient
+from .server import APIServer, AdmissionChain, AdmissionDenied
+
+__all__ = ["APIServer", "AdmissionChain", "AdmissionDenied", "HTTPClient"]

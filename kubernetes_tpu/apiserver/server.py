@@ -1,0 +1,314 @@
+"""HTTP API server over the Store.
+
+Ref: staging/src/k8s.io/apiserver. Routes follow the reference's URL
+scheme (endpoints/installer.go registerResourceHandlers):
+
+    /api/v1/{resource}                              cluster-scoped core
+    /api/v1/namespaces/{ns}/{resource}[/{name}]     namespaced core
+    /apis/{group}/{version}/...                     named groups
+    .../pods/{name}/binding                         bind subresource (POST)
+    .../{resource}/{name}/status                    status subresource (PUT)
+    GET ...?watch=true&resourceVersion=N            chunked watch stream
+    /healthz, /readyz                               health endpoints
+
+The handler chain is the reference's DefaultBuildHandlerChain
+(config.go:543-557) reduced to what a single-tenant hub needs: panic
+recovery (http.server gives per-request isolation), request-info parsing,
+then ADMISSION on writes — the mutating-then-validating plugin chain
+(apiserver/pkg/admission) as a first-class hook point.
+
+Wire format: the serde camelCase JSON; watch frames are one JSON object
+per line `{"type": "ADDED", "object": {...}}` exactly like the reference's
+watch framing (application/json;stream=watch).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api import serde
+from ..api.core import Binding
+from ..api.validation import ValidationError
+from ..runtime.scheme import SCHEME, Scheme
+from ..state.client import Client
+from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
+                           NotFoundError, Store)
+
+
+class AdmissionDenied(Exception):
+    pass
+
+
+class AdmissionChain:
+    """Mutating-then-validating plugin chain (ref: apiserver/pkg/admission
+    — Interface.Admit then Validate). A mutator returns the (possibly
+    replaced) object; a validator raises AdmissionDenied to reject."""
+
+    def __init__(self):
+        self.mutators: List[Callable[[str, str, Any], Any]] = []
+        self.validators: List[Callable[[str, str, Any], None]] = []
+
+    def admit(self, operation: str, resource: str, obj: Any) -> Any:
+        for m in self.mutators:
+            obj = m(operation, resource, obj) or obj
+        for v in self.validators:
+            v(operation, resource, obj)
+        return obj
+
+
+class _Request:
+    """Parsed request-info (ref: apiserver/pkg/endpoints/request
+    RequestInfoFactory)."""
+
+    __slots__ = ("resource", "namespace", "name", "subresource", "query")
+
+    def __init__(self, resource: str, namespace: str, name: str,
+                 subresource: str, query: dict):
+        self.resource = resource
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+        self.query = query
+
+
+class APIServer:
+    def __init__(self, store: Optional[Store] = None, scheme: Scheme = SCHEME,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = Client(store)
+        self.store = self.client.store
+        self.scheme = scheme
+        self.admission = AdmissionChain()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                outer._dispatch(self, "GET")
+
+            def do_POST(self):
+                outer._dispatch(self, "POST")
+
+            def do_PUT(self):
+                outer._dispatch(self, "PUT")
+
+            def do_DELETE(self):
+                outer._dispatch(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="apiserver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- routing
+
+    def _parse(self, path: str, query: dict) -> Optional[_Request]:
+        """URL -> request-info. Accepts /api/v1/... and /apis/{g}/{v}/..."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api" and len(parts) >= 2:
+            rest = parts[2:]
+        elif parts[0] == "apis" and len(parts) >= 3:
+            rest = parts[3:]
+        else:
+            return None
+        ns = ""
+        # /namespaces/{ns}/{resource}/... scopes the request; a bare
+        # /namespaces or /namespaces/{name}[/{sub}] addresses Namespace
+        # objects — disambiguated by whether the third segment is a known
+        # resource (the reference's RequestInfoFactory does the same)
+        if rest and rest[0] == "namespaces" and len(rest) >= 3 and \
+                self.scheme.type_for_resource(rest[2]) is not None:
+            ns, rest = rest[1], rest[2:]
+        if not rest:
+            return None
+        resource = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        sub = rest[2] if len(rest) > 2 else ""
+        return _Request(resource, ns, name, sub, query)
+
+    def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            url = urlparse(h.path)
+            query = {k: v[0] for k, v in parse_qs(url.query).items()}
+            if url.path in ("/healthz", "/readyz", "/livez"):
+                self._respond_raw(h, 200, b"ok", "text/plain")
+                return
+            req = self._parse(url.path, query)
+            if req is None:
+                self._error(h, 404, "NotFound", f"unknown path {url.path}")
+                return
+            cls = self.scheme.type_for_resource(req.resource)
+            if cls is None:
+                self._error(h, 404, "NotFound",
+                            f"unknown resource {req.resource}")
+                return
+            self._handle(h, method, req, cls)
+        except ExpiredError as e:
+            # 410 Gone: the reflector must relist (reflector.go:159)
+            self._error(h, 410, "Expired", str(e))
+        except (NotFoundError, KeyError) as e:
+            self._error(h, 404, "NotFound", str(e))
+        except AlreadyExistsError as e:
+            self._error(h, 409, "AlreadyExists", str(e))
+        except ConflictError as e:
+            self._error(h, 409, "Conflict", str(e))
+        except (ValidationError, AdmissionDenied, ValueError) as e:
+            self._error(h, 422, "Invalid", str(e))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:
+            traceback.print_exc()
+            try:
+                self._error(h, 500, "InternalError", str(e))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- handlers
+
+    def _rc(self, cls, namespace: str):
+        return self.client.resource(cls, namespace or None)
+
+    def _read_body(self, h) -> Any:
+        length = int(h.headers.get("Content-Length", 0))
+        return json.loads(h.rfile.read(length)) if length else None
+
+    def _handle(self, h, method: str, req: _Request, cls) -> None:
+        rc = self._rc(cls, req.namespace)
+        if method == "GET":
+            if req.name:
+                obj = rc.get(req.name, namespace=req.namespace or None)
+                self._respond(h, 200, obj)
+            elif req.query.get("watch") in ("true", "1"):
+                self._serve_watch(h, req)
+            else:
+                items, rv = self.store.list(
+                    req.resource, req.namespace or None)
+                body = {
+                    "apiVersion": "v1", "kind": "List",
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": [serde.encode(o) for o in items]}
+                self._respond_raw(h, 200, json.dumps(body).encode(),
+                                  "application/json")
+        elif method == "POST":
+            data = self._read_body(h)
+            if data is None:
+                self._error(h, 422, "Invalid", "empty request body")
+                return
+            if req.subresource == "binding" or (
+                    req.resource == "pods" and not req.name and
+                    data and data.get("kind") == "Binding"):
+                binding = serde.decode(Binding, data)
+                out = self.client.pods(req.namespace or None).bind(binding)
+                self._respond(h, 201, out)
+                return
+            obj = self.scheme.decode_any(data) if "kind" in data \
+                else serde.decode(cls, data)
+            obj = self.admission.admit("CREATE", req.resource, obj)
+            out = rc.create(obj)
+            self._respond(h, 201, out)
+        elif method == "PUT":
+            data = self._read_body(h)
+            obj = serde.decode(cls, data)
+            if req.subresource == "status":
+                out = rc.update_status(obj)
+            else:
+                obj = self.admission.admit("UPDATE", req.resource, obj)
+                out = rc.update(obj)
+            self._respond(h, 200, out)
+        elif method == "DELETE":
+            out = rc.delete(req.name, namespace=req.namespace or None,
+                            resource_version=req.query.get("resourceVersion"))
+            self._respond(h, 200, out)
+        else:
+            self._error(h, 405, "MethodNotAllowed", method)
+
+    def _serve_watch(self, h, req: _Request) -> None:
+        """Chunked watch stream: one JSON frame per line (ref: the
+        apiserver's WatchServer over the cacher; resumable by
+        resourceVersion exactly like storage/cacher/cacher.go)."""
+        rv = req.query.get("resourceVersion")
+        watch = self.store.watch(req.resource, req.namespace or None,
+                                 int(rv) if rv else None)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json;stream=watch")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def write_chunk(payload: bytes) -> None:
+            h.wfile.write(f"{len(payload):X}\r\n".encode())
+            h.wfile.write(payload + b"\r\n")
+            h.wfile.flush()
+
+        import queue as queue_mod
+        try:
+            while True:
+                try:
+                    ev = watch.events.get(timeout=1.0)
+                except queue_mod.Empty:
+                    # heartbeat (the reference's watch BOOKMARK): keeps the
+                    # client's blocking read turning over so a stopped
+                    # client can notice and close from its OWN thread —
+                    # closing an http response cross-thread deadlocks
+                    write_chunk(b"\n")
+                    continue
+                if ev is None:
+                    break
+                frame = json.dumps({
+                    "type": ev.type,
+                    "object": serde.encode(ev.object)}) + "\n"
+                write_chunk(frame.encode())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watch.stop()
+            try:
+                h.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ responses
+
+    def _respond(self, h, code: int, obj: Any) -> None:
+        self._respond_raw(h, code, serde.to_json_str(obj).encode(),
+                          "application/json")
+
+    def _respond_raw(self, h, code: int, body: bytes, ctype: str) -> None:
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _error(self, h, code: int, reason: str, message: str) -> None:
+        body = json.dumps({
+            "apiVersion": "v1", "kind": "Status", "status": "Failure",
+            "reason": reason, "message": message, "code": code}).encode()
+        self._respond_raw(h, code, body, "application/json")

@@ -27,7 +27,6 @@ class Controller:
         self.queue = RateLimitingQueue()
         self.workers = workers
         self._threads: List[threading.Thread] = []
-        self._stopped = False
 
     def enqueue(self, key: str) -> None:
         self.queue.add(key)
@@ -63,7 +62,6 @@ class Controller:
                 self.queue.done(key)
 
     def stop(self) -> None:
-        self._stopped = True
         self.queue.shutdown()
         for t in self._threads:
             t.join(timeout=2)

@@ -172,10 +172,13 @@ class DeploymentController(Controller):
                 selector=LabelSelector(match_labels=sel_labels),
                 template=tmpl,
                 min_ready_seconds=d.spec.min_ready_seconds))
+        from ..state.store import AlreadyExistsError
         try:
             return self.client.replica_sets(d.metadata.namespace).create(rs)
-        except Exception:
-            # AlreadyExists: informer lag; retry next sync
+        except AlreadyExistsError:
+            # informer lag: the RS exists but the indexer hasn't seen it;
+            # any other error propagates so the workqueue retries with
+            # backoff instead of silently forgetting the key
             return self.rs_informer.indexer.get_by_key(
                 f"{d.metadata.namespace}/{rs.metadata.name}")
 
@@ -223,16 +226,25 @@ class DeploymentController(Controller):
                 self._scale_rs(new_rs, min(d.spec.replicas,
                                            new_rs.spec.replicas + allowed))
                 return  # one move per sync, like the reference
-        # scale down (scaleDownOldReplicaSetsForRollingUpdate):
-        # unhealthy old replicas go first and cost nothing from the budget
+        # scale down (scaleDownOldReplicaSetsForRollingUpdate). Unhealthy
+        # old replicas go first, CAPPED by the availability budget — status
+        # can lag reality, so an uncapped cleanup could delete serving pods
+        # below minAvailable (ref: cleanupUnhealthyReplicas maxCleanupCount)
+        min_available = d.spec.replicas - unavailable
+        new_unavailable = max(
+            0, new_rs.spec.replicas - new_rs.status.available_replicas)
+        max_cleanup = total - min_available - new_unavailable
         for rs in old_rss:
+            if max_cleanup <= 0:
+                break
             unhealthy = rs.spec.replicas - rs.status.available_replicas
             if rs.spec.replicas > 0 and unhealthy > 0:
-                self._scale_rs(rs, max(0, rs.spec.replicas - unhealthy))
+                down = min(unhealthy, max_cleanup)
+                self._scale_rs(rs, max(0, rs.spec.replicas - down))
                 return
         total_available = sum(rs.status.available_replicas
                               for rs in [new_rs] + old_rss)
-        budget = total_available - (d.spec.replicas - unavailable)
+        budget = total_available - min_available
         if budget <= 0:
             return
         for rs in sorted(old_rss,

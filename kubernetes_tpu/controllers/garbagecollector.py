@@ -65,7 +65,8 @@ class GarbageCollector:
             self._informers[cls] = inf
             inf.add_event_handlers(EventHandlers(
                 on_add=lambda obj, _cls=cls: self._on_add(_cls, obj),
-                on_update=lambda old, new, _cls=cls: self._on_add(_cls, new),
+                on_update=lambda old, new, _cls=cls:
+                    self._on_update(_cls, old, new),
                 on_delete=lambda obj, _cls=cls: self._on_delete(_cls, obj)))
 
     def _edges(self, cls: Type, obj):
@@ -79,21 +80,53 @@ class GarbageCollector:
             for uid in owner_uids:
                 self._dependents.setdefault(uid, set()).add(key)
 
+    def _drop_edges_locked(self, key, owner_uids) -> None:
+        for ouid in owner_uids:
+            deps = self._dependents.get(ouid)
+            if deps is not None:
+                deps.discard(key)
+                if not deps:
+                    del self._dependents[ouid]
+
+    def _on_update(self, cls: Type, old, new) -> None:
+        """Owner references dropped by an update (orphaning) must drop their
+        edges, or the ex-owner's eventual delete would wrongly cascade."""
+        key, old_uids = self._edges(cls, old)
+        _, new_uids = self._edges(cls, new)
+        with self._lock:
+            self._live[new.metadata.uid] = True
+            self._drop_edges_locked(key, set(old_uids) - set(new_uids))
+            for uid in new_uids:
+                self._dependents.setdefault(uid, set()).add(key)
+
     def _on_delete(self, cls: Type, obj) -> None:
         key, owner_uids = self._edges(cls, obj)
         uid = obj.metadata.uid
         with self._lock:
             self._live.pop(uid, None)
-            for ouid in owner_uids:
-                deps = self._dependents.get(ouid)
-                if deps is not None:
-                    deps.discard(key)
-                    if not deps:
-                        del self._dependents[ouid]
+            self._drop_edges_locked(key, owner_uids)
             doomed = self._dependents.pop(uid, set())
-        # cascade: each dependent's own delete event recurses
+        # cascade — but only dependents whose EVERY owner is now gone
+        # (k8s collects on all-owners-dead, not any-owner-dead), with the
+        # same store verification the sweep uses
         for dcls, ns, name in doomed:
-            self._delete(dcls, ns, name)
+            self._collect_if_orphaned(dcls, ns, name)
+
+    def _collect_if_orphaned(self, cls: Type, namespace: str,
+                             name: str) -> None:
+        inf = self._informers.get(cls)
+        cur = inf.indexer.get_by_key(
+            f"{namespace}/{name}" if namespace else name) if inf else None
+        if cur is None:
+            return  # already gone (or unseen; the sweep will revisit)
+        refs = cur.metadata.owner_references
+        if not refs:
+            return
+        if any(self._owner_alive(r) for r in refs):
+            return
+        if any(self._owner_alive_in_store(r, namespace) for r in refs):
+            return
+        self._delete(cls, namespace, name)
 
     def _delete(self, cls: Type, namespace: str, name: str) -> None:
         try:

@@ -68,7 +68,13 @@ class NodeLifecycleController:
 
     def monitor_once(self) -> None:
         """One monitorNodeHealth pass over every known node."""
-        for node in self.node_informer.indexer.list():
+        nodes = self.node_informer.indexer.list()
+        # forget deleted nodes: a recreated node with a reused name must
+        # start a fresh eviction clock, not inherit the old one
+        names = {n.metadata.name for n in nodes}
+        for gone in [k for k in self._not_ready_since if k not in names]:
+            del self._not_ready_since[gone]
+        for node in nodes:
             self._check_node(node)
 
     def _ready_condition(self, node: Node):

@@ -125,8 +125,24 @@ class ReplicaSetController(Controller):
         for pod in self.pod_informer.indexer.list(rs.metadata.namespace):
             ref = controller_ref(pod.metadata)
             if ref is not None:
-                if ref.uid == my_uid:
-                    out.append(pod)
+                if ref.uid != my_uid:
+                    continue
+                if not labelsmod.matches(sel, pod.metadata.labels):
+                    # release: an owned pod whose labels no longer match is
+                    # orphaned, not counted (ref: PodControllerRefManager
+                    # ReleasePod) — a replacement gets created this sync
+                    def release(cur, _uid=my_uid):
+                        cur.metadata.owner_references = [
+                            r for r in cur.metadata.owner_references
+                            if r.uid != _uid]
+                        return cur
+                    try:
+                        self.client.pods(pod.metadata.namespace).patch(
+                            pod.metadata.name, release)
+                    except Exception:
+                        pass
+                    continue
+                out.append(pod)
                 continue
             if rs.metadata.deletion_timestamp is not None:
                 continue
