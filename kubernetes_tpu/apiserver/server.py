@@ -231,6 +231,13 @@ class APIServer:
                 return
             obj = self.scheme.decode_any(data) if "kind" in data \
                 else serde.decode(cls, data)
+            if not isinstance(obj, cls):
+                # a body of the wrong kind must not land in this resource's
+                # bucket (it would poison every watcher of the resource)
+                self._error(h, 422, "Invalid",
+                            f"body kind {data.get('kind')} does not match "
+                            f"resource {req.resource}")
+                return
             obj = self.admission.admit("CREATE", req.resource, obj)
             out = rc.create(obj)
             self._respond(h, 201, out)

@@ -109,11 +109,14 @@ class BatchScheduler:
                  hard_pod_affinity_weight: Optional[int] = None,
                  volume_binder=None,
                  pvc_lister=None, pv_lister=None,
-                 nominated=None, pdb_lister=None):
+                 nominated=None, pdb_lister=None, extenders=None):
         from . import priorities as prios_mod
         from .queue import NominatedPodMap
         from .scorer import ScoreCompiler
         from .volumebinder import FakeVolumeBinder
+        #: out-of-process extenders (ref: core/extender.go); filter joins
+        #: the residual host path, prioritize merges into static scores
+        self.extenders = list(extenders or [])
         #: shared with the SchedulingQueue; feeds the kernel's reservation
         #: tensors and preemption's nominated-to-clear list
         self.nominated = nominated if nominated is not None else NominatedPodMap()
@@ -157,10 +160,14 @@ class BatchScheduler:
 
     def _needs_residual(self, pod: Pod) -> bool:
         """MatchInterPodAffinity / NoDiskConflict / volume predicates need
-        the host path."""
+        the internal host path (extender filters are handled separately so
+        they don't drag every pod through the per-node predicate loop)."""
         return (self._has_affinity_pods or pod_has_affinity_constraints(pod)
                 or _pod_has_conflict_volumes(pod) or _pod_has_pvc(pod)
                 or _pod_has_attach_volumes(pod))
+
+    def _has_filter_extenders(self) -> bool:
+        return any(e.config.filter_verb for e in self.extenders)
 
     def _passes_basic_checks(self, pod: Pod) -> bool:
         """Ref: podPassesBasicChecks (generic_scheduler.go:188) — referenced
@@ -180,14 +187,30 @@ class BatchScheduler:
                        ) -> Tuple[Optional[np.ndarray], Dict[int, preds.PredicateMetadata]]:
         metas: Dict[int, preds.PredicateMetadata] = {}
         extra: Optional[np.ndarray] = None
+        filter_extenders = [e for e in self.extenders
+                            if e.config.filter_verb]
+        live_nodes = []
+        enc_nodes: Optional[list] = None
+        if filter_extenders:
+            from ..api import serde as serde_mod
+            live_nodes = [ni.node for ni in self.snapshot.node_infos.values()
+                          if ni.node is not None]
+            # encoded once per batch: the wire payload is pod-invariant
+            enc_nodes = [serde_mod.encode(n) for n in live_nodes]
         for i, pod in enumerate(pods):
-            if not self._needs_residual(pod):
+            internal = self._needs_residual(pod)
+            if not internal and not filter_extenders:
                 continue
             if extra is None:
                 extra = np.ones((len(pods), self.mirror.t.capacity), bool)
             if not self._passes_basic_checks(pod):
                 extra[i, :] = False
                 continue
+            if filter_extenders and not self._apply_filter_extenders(
+                    filter_extenders, pod, live_nodes, extra, i, enc_nodes):
+                continue
+            if not internal:
+                continue  # extender-only pod: skip the per-node predicates
             meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
             metas[i] = meta
             has_disk = _pod_has_conflict_volumes(pod)
@@ -195,8 +218,8 @@ class BatchScheduler:
             has_attach = has_pvc or _pod_has_attach_volumes(pod)
             for name, ni in self.snapshot.node_infos.items():
                 row = self.mirror.row_of.get(name)
-                if row is None:
-                    continue
+                if row is None or not extra[i, row]:
+                    continue  # already vetoed (extender filter)
                 ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
                 if ok and has_disk:
                     ok, _ = preds.no_disk_conflict(pod, meta, ni)
@@ -211,6 +234,66 @@ class BatchScheduler:
                         ok = self.volume_binder.find_pod_volumes(pod, ni.node)
                 extra[i, row] = ok
         return extra, metas
+
+    def _apply_filter_extenders(self, filter_extenders, pod: Pod,
+                                live_nodes, extra: np.ndarray,
+                                i: int, enc_nodes=None) -> bool:
+        """AND each extender's feasible set into the pod's row. The batch
+        deviation from the reference: extenders see ALL live nodes, not
+        only internal-predicate survivors (core/extender.go runs after
+        findNodesThatFit) — the intersection is identical. Returns False
+        when a non-ignorable extender failed (the pod is unschedulable
+        this cycle, ref: Filter error handling :258)."""
+        from .extender import ExtenderError
+        for e in filter_extenders:
+            try:
+                names, _failed = e.filter(pod, live_nodes, enc_nodes)
+            except ExtenderError:
+                if e.is_ignorable():
+                    continue
+                extra[i, :] = False
+                return False
+            allowed = np.zeros((extra.shape[1],), bool)
+            for nm in names:
+                row = self.mirror.row_of.get(nm)
+                if row is not None:
+                    allowed[row] = True
+            extra[i] &= allowed
+        return True
+
+    def _apply_prioritize_extenders(self, pods: List[Pod],
+                                    batch: "PodBatchTensors",
+                                    static) -> None:
+        """Merge extender prioritize scores into the batch's static score
+        rows (ref: PrioritizeNodes :774-804 — weighted extender scores add
+        to the internal sum). Errors are ignored per extender, matching
+        the reference's ignorable-prioritize behavior."""
+        from ..api import serde as serde_mod
+        from .extender import ExtenderError
+        N = self.mirror.t.capacity
+        live_nodes = [ni.node for ni in self.snapshot.node_infos.values()
+                      if ni.node is not None]
+        enc_nodes = [serde_mod.encode(n) for n in live_nodes]
+        ext = np.zeros((len(pods), N), np.float32)
+        for i, pod in enumerate(pods):
+            for e in self.extenders:
+                if not e.config.prioritize_verb:
+                    continue
+                try:
+                    scores = e.prioritize(pod, live_nodes, enc_nodes)
+                except ExtenderError:
+                    continue
+                for nm, s in scores.items():
+                    row = self.mirror.row_of.get(nm)
+                    if row is not None:
+                        ext[i, row] += s
+        if static is not None:
+            idx, rows = static
+            base = rows[np.asarray(idx[:len(pods)])]
+        else:
+            base = np.zeros((len(pods), N), np.float32)
+        batch.set_static_scores(
+            np.arange(len(pods), dtype=np.int32), base + ext)
 
     def _repair_batch(self, results: List[ScheduleResult],
                       metas: Dict[int, preds.PredicateMetadata]) -> None:
@@ -360,7 +443,9 @@ class BatchScheduler:
                     # the NEW batch's residual predicates (anti-affinity /
                     # disk / PVC) would be evaluated against a snapshot that
                     # excludes the chain's uncommitted winners — sequential
-                    # path only for such batches
+                    # path only for such batches; extender filters likewise
+                    # produce an extra mask every batch
+                    and not self._has_filter_extenders()
                     and not any(self._needs_residual(p) for p in pods))
         if chaining:
             self.mirror.apply_chained(self.snapshot, dirty)
@@ -396,12 +481,17 @@ class BatchScheduler:
                 if row is not None:
                     batch.nom_row[i] = row
         static = self.scorer.static_scores(pods, batch)
+        has_prio_ext = any(e.config.prioritize_verb for e in self.extenders)
         # hysteresis: while static scores are in play, later launches refuse
         # the chain up front (before tensorize) instead of discarding work
-        self._static_likely = static is not None
-        if static is not None:
+        self._static_likely = static is not None or has_prio_ext
+        if has_prio_ext:
             if chaining:
                 return None  # host scores would lag the uncommitted chain
+            self._apply_prioritize_extenders(pods, batch, static)
+        elif static is not None:
+            if chaining:
+                return None
             batch.set_static_scores(*static)
         if chaining and not self.mirror.device_ready():
             return None  # tensorize grew the column axis; chain handle stale

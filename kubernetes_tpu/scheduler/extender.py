@@ -1,0 +1,277 @@
+"""Scheduler extender — out-of-process Filter/Prioritize/Bind over HTTP.
+
+Ref: pkg/scheduler/core/extender.go (HTTPExtender :42-53, Filter :258,
+Prioritize :318, Bind :360, send :387) and the wire types in
+pkg/scheduler/api (ExtenderArgs, ExtenderFilterResult, HostPriorityList,
+ExtenderBindingArgs). Two halves:
+
+  HTTPExtender        — the client: the scheduler shells out per pod
+  ExtenderServer      — the sidecar: exposes THIS framework's predicate
+                        oracle over the same protocol, so an unmodified
+                        upstream scheduler can delegate Filter/Prioritize
+                        (and Bind) to the TPU-backed implementation —
+                        the designated M5 integration boundary.
+
+Wire format (exactly the reference's JSON):
+  POST {url_prefix}/{filter_verb}     ExtenderArgs{pod, nodes|nodenames}
+     -> ExtenderFilterResult{nodes|nodenames, failedNodes, error}
+  POST {url_prefix}/{prioritize_verb} ExtenderArgs
+     -> [{host, score}, ...]          (HostPriorityList, 0-10 per node)
+  POST {url_prefix}/{bind_verb}       ExtenderBindingArgs{podName,
+                                      podNamespace, podUID, node}
+     -> {error}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from ..api import serde
+from ..api.core import Node, Pod
+
+
+class ExtenderConfig:
+    """Ref: schedulerapi.ExtenderConfig (pkg/scheduler/api/types.go)."""
+
+    def __init__(self, url_prefix: str, filter_verb: str = "",
+                 prioritize_verb: str = "", bind_verb: str = "",
+                 weight: int = 1, node_cache_capable: bool = False,
+                 ignorable: bool = False):
+        self.url_prefix = url_prefix.rstrip("/")
+        self.filter_verb = filter_verb
+        self.prioritize_verb = prioritize_verb
+        self.bind_verb = bind_verb
+        self.weight = weight
+        self.node_cache_capable = node_cache_capable
+        self.ignorable = ignorable
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    """The scheduler-side client (ref: HTTPExtender)."""
+
+    def __init__(self, config: ExtenderConfig, timeout: float = 5.0):
+        self.config = config
+        self.timeout = timeout
+
+    def _send(self, verb: str, payload: dict) -> dict:
+        """Ref: HTTPExtender.send :387. Any transport OR malformed-body
+        failure surfaces as ExtenderError so `ignorable` works."""
+        url = f"{self.config.url_prefix}/{verb}"
+        req = urlrequest.Request(
+            url, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except (urlerror.URLError, OSError, ValueError) as e:
+            raise ExtenderError(f"extender {url}: {e}") from e
+
+    def _args(self, pod: Pod, nodes: List[Node],
+              encoded_nodes: Optional[list] = None) -> dict:
+        args: Dict[str, object] = {"pod": serde.encode(pod)}
+        if self.config.node_cache_capable:
+            args["nodenames"] = [n.metadata.name for n in nodes]
+        else:
+            # node encoding is batch-invariant: callers fanning one node
+            # list across many pods pass it pre-encoded once
+            args["nodes"] = {"items": encoded_nodes if encoded_nodes
+                             is not None
+                             else [serde.encode(n) for n in nodes]}
+        return args
+
+    def is_ignorable(self) -> bool:
+        return self.config.ignorable
+
+    def filter(self, pod: Pod, nodes: List[Node],
+               encoded_nodes: Optional[list] = None
+               ) -> Tuple[List[str], Dict[str, str]]:
+        """Returns (feasible node names, failed {node: reason})
+        (ref: Filter :258)."""
+        if not self.config.filter_verb:
+            return [n.metadata.name for n in nodes], {}
+        result = self._send(self.config.filter_verb,
+                            self._args(pod, nodes, encoded_nodes))
+        try:
+            if result.get("error"):
+                raise ExtenderError(result["error"])
+            if result.get("nodenames") is not None:
+                names = [str(n) for n in result["nodenames"]]
+            elif result.get("nodes") is not None:
+                names = [item["metadata"]["name"]
+                         for item in result["nodes"].get("items", [])]
+            else:
+                names = []
+            return names, dict(result.get("failedNodes") or {})
+        except (AttributeError, KeyError, TypeError) as e:
+            raise ExtenderError(f"malformed filter result: {e}") from e
+
+    def prioritize(self, pod: Pod, nodes: List[Node],
+                   encoded_nodes: Optional[list] = None
+                   ) -> Dict[str, float]:
+        """Node name -> weighted score (ref: Prioritize :318 — the caller
+        multiplies by the extender weight; done here)."""
+        if not self.config.prioritize_verb:
+            return {}
+        result = self._send(self.config.prioritize_verb,
+                            self._args(pod, nodes, encoded_nodes))
+        try:
+            return {hp["host"]: float(hp["score"]) * self.config.weight
+                    for hp in result or []}
+        except (AttributeError, KeyError, TypeError, ValueError) as e:
+            raise ExtenderError(f"malformed prioritize result: {e}") from e
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """Ref: Bind :360."""
+        if not self.config.bind_verb:
+            raise ExtenderError("extender has no bind verb")
+        result = self._send(self.config.bind_verb, {
+            "podName": pod.metadata.name,
+            "podNamespace": pod.metadata.namespace,
+            "podUID": pod.metadata.uid,
+            "node": node_name})
+        if result and result.get("error"):
+            raise ExtenderError(result["error"])
+
+    def supports_bind(self) -> bool:
+        return bool(self.config.bind_verb)
+
+
+class ExtenderServer:
+    """Sidecar serving THIS framework's scheduling oracle over the extender
+    protocol: an unmodified upstream kube-scheduler configured with an
+    ExtenderConfig pointing here delegates Filter/Prioritize (and Bind when
+    a client is provided) to the TPU-backed implementation."""
+
+    def __init__(self, client=None, host: str = "127.0.0.1", port: int = 0):
+        self.client = client
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    verb = self.path.strip("/").split("/")[-1]
+                    if verb == "filter":
+                        out = outer._filter(payload)
+                    elif verb == "prioritize":
+                        out = outer._prioritize(payload)
+                    elif verb == "bind":
+                        out = outer._bind(payload)
+                    else:
+                        self.send_error(404)
+                        return
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception:
+                    traceback.print_exc()
+                    self.send_error(500)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ExtenderServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="extender-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # --------------------------------------------------------------- verbs
+
+    def _decode_args(self, payload: dict) -> Tuple[Pod, List[Node]]:
+        pod = serde.decode(Pod, payload["pod"])
+        nodes = [serde.decode(Node, item)
+                 for item in (payload.get("nodes") or {}).get("items", [])]
+        if not nodes and payload.get("nodenames") is not None:
+            # node_cache_capable caller: names only — resolve from the hub
+            # (needs a client); without one this sidecar can't evaluate
+            if self.client is None:
+                raise ValueError(
+                    "nodenames-only args need a client-backed sidecar "
+                    "(set nodeCacheCapable: false, or give it a client)")
+            for nm in payload["nodenames"]:
+                try:
+                    nodes.append(self.client.nodes().get(nm))
+                except Exception:
+                    pass
+        return pod, nodes
+
+    def _filter(self, payload: dict) -> dict:
+        """Evaluate the full default predicate set on the caller's own
+        pod+nodes (stateless: nodes arrive in the args, the non-cache-
+        capable mode)."""
+        from . import predicates as preds
+        from .nodeinfo import NodeInfo
+        try:
+            pod, nodes = self._decode_args(payload)
+        except ValueError as e:
+            return {"nodes": {"items": []}, "nodenames": [],
+                    "failedNodes": {}, "error": str(e)}
+        infos = {n.metadata.name: NodeInfo(n) for n in nodes}
+        meta = preds.PredicateMetadata(pod, infos)
+        feasible, failed = [], {}
+        for name, ni in infos.items():
+            ok, reasons = preds.pod_fits_on_node(pod, meta, ni)
+            if ok:
+                feasible.append(ni.node)
+            else:
+                failed[name] = "; ".join(reasons) or "unschedulable"
+        return {"nodes": {"items": [serde.encode(n) for n in feasible]},
+                "nodenames": [n.metadata.name for n in feasible],
+                "failedNodes": failed, "error": ""}
+
+    def _prioritize(self, payload: dict) -> list:
+        """Default priority scores per node (host oracle Map/Reduce)."""
+        from . import priorities as prios
+        from .nodeinfo import NodeInfo
+        pod, nodes = self._decode_args(payload)
+        infos = {n.metadata.name: NodeInfo(n) for n in nodes}
+        meta = prios.PriorityMetadata(pod)
+        scores = prios.prioritize_nodes(pod, meta, infos)
+        return [{"host": name, "score": score}
+                for name, score in scores.items()]
+
+    def _bind(self, payload: dict) -> dict:
+        if self.client is None:
+            return {"error": "binding not enabled on this sidecar"}
+        from ..api.core import Binding, ObjectReference
+        from ..api.meta import ObjectMeta
+        try:
+            self.client.pods(payload["podNamespace"]).bind(Binding(
+                metadata=ObjectMeta(name=payload["podName"],
+                                    namespace=payload["podNamespace"]),
+                target=ObjectReference(kind="Node", name=payload["node"])))
+        except Exception as e:
+            return {"error": str(e)}
+        return {"error": ""}

@@ -39,12 +39,21 @@ class Scheduler:
                  batch_size: int = DEFAULT_BATCH_SIZE,
                  scheduler_name: str = "default-scheduler",
                  clock: Clock = REAL_CLOCK,
-                 disable_preemption: bool = False):
+                 disable_preemption: bool = False,
+                 framework=None, extenders=None):
+        from .framework import Framework
         self.client = client
         self.scheduler_name = scheduler_name
         self.batch_size = batch_size
         self.clock = clock
         self.disable_preemption = disable_preemption
+        #: Reserve/Prebind plugin runner (ref: framework/v1alpha1)
+        self.framework = framework or Framework()
+        self.extenders = list(extenders or [])
+        #: first bind-capable extender takes over binds (ref: GetBinder,
+        #: scheduler.go:411 — extender bind wins when it manages the pod)
+        self._bind_extender = next(
+            (e for e in self.extenders if e.supports_bind()), None)
         self.cache = Cache(clock=clock)
         self.queue = SchedulingQueue(clock=clock)
         self.informers = informer_factory or SharedInformerFactory(client)
@@ -60,7 +69,8 @@ class Scheduler:
             volume_binder=self.volume_binder,
             pvc_lister=pvc_lister, pv_lister=pv_by_name,
             nominated=self.queue.nominated,
-            pdb_lister=lambda: pdb_informer.indexer.list())
+            pdb_lister=lambda: pdb_informer.indexer.list(),
+            extenders=self.extenders)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
@@ -273,6 +283,7 @@ class Scheduler:
         scheduleOne sees the pod; here bind is synchronous within the same
         cycle, so assume-after-bind exposes the same states to observers."""
         from ..state.store import ConflictError, NotFoundError
+        from .framework import PluginContext
         fresh: List[ScheduleResult] = []
         for res in bound:
             if self.cache.assigned_node(res.pod.metadata.key()) is not None:
@@ -299,14 +310,47 @@ class Scheduler:
                     self.queue.add_unschedulable_if_not_present(
                         res.pod, self.queue.scheduling_cycle)
                     continue
+            # Reserve then Prebind plugin points (ref: scheduler.go:507,:533
+            # — between host selection and assume/bind); a failure rejects
+            # the pod for this cycle. One context PER POD, matching the
+            # reference's per-scheduleOne pluginContext — plugins key their
+            # scratch by fixed names, so sharing across pods would leak
+            # one pod's reserve state into another's prebind
+            ctx = PluginContext()
+            st = self.framework.run_reserve_plugins(ctx, res.pod,
+                                                    res.node_name)
+            if st.success:
+                st = self.framework.run_prebind_plugins(ctx, res.pod,
+                                                        res.node_name)
+            if not st.success:
+                self.volume_binder.forget_pod_volumes(res.pod)
+                self.algorithm.mirror.invalidate_usage()
+                self._record_event(res.pod, "FailedScheduling", st.message)
+                self.queue.add_unschedulable_if_not_present(
+                    res.pod, self.queue.scheduling_cycle)
+                continue
             fresh.append(res)
         bound = fresh
-        bindings = [Binding(
-            metadata=ObjectMeta(name=res.pod.metadata.name,
-                                namespace=res.pod.metadata.namespace),
-            target=ObjectReference(kind="Node", name=res.node_name))
-            for res in bound]
-        outs = self.client.pods().bind_bulk(bindings)
+        if self._bind_extender is not None:
+            # extender-managed binding (ref: scheduler.go:411 GetBinder):
+            # the extender performs the API write; the local clone feeds
+            # the cache so accounting doesn't wait on the informer echo
+            outs = []
+            for res in bound:
+                try:
+                    self._bind_extender.bind(res.pod, res.node_name)
+                    clone = serde.deepcopy_obj(res.pod)
+                    clone.spec.node_name = res.node_name
+                    outs.append(clone)
+                except Exception as e:
+                    outs.append(e)
+        else:
+            bindings = [Binding(
+                metadata=ObjectMeta(name=res.pod.metadata.name,
+                                    namespace=res.pod.metadata.namespace),
+                target=ObjectReference(kind="Node", name=res.node_name))
+                for res in bound]
+            outs = self.client.pods().bind_bulk(bindings)
         n_assumed = 0
         for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
