@@ -93,6 +93,20 @@ def main():
     scheduled = sched.drain_pipelined()
     elapsed = time.time() - t0
     rate = scheduled / elapsed if elapsed > 0 else 0.0
+    # per-phase latencies from the scheduler's own metrics histograms
+    # (ref: scheduling_duration_seconds{operation} scraped in density e2e,
+    # metrics_util.go:670-713) — not ad-hoc timers
+    m = sched.metrics
+    latency = {
+        "e2e_batch_p50_s": m.e2e_scheduling_duration.quantile(0.5),
+        "e2e_batch_p99_s": m.e2e_scheduling_duration.quantile(0.99),
+        "fetch_p99_s": m.scheduling_duration.quantile(0.99,
+                                                      operation="fetch"),
+        "commit_p99_s": m.scheduling_duration.quantile(0.99,
+                                                       operation="commit"),
+        "binding_p99_s": m.binding_duration.quantile(0.99),
+        "batches": m.e2e_scheduling_duration.count(),
+    }
     print(json.dumps({
         "metric": "scheduler_perf pods-scheduled/sec "
                   f"({N_PODS} pods x {N_NODES} nodes)",
@@ -101,7 +115,8 @@ def main():
         "vs_baseline": round(rate / BASELINE_PODS_PER_SEC, 2),
         "detail": {"scheduled": scheduled, "pending": N_PODS,
                    "elapsed_s": round(elapsed, 2),
-                   "setup_s": round(setup_s, 2), "batch": BATCH},
+                   "setup_s": round(setup_s, 2), "batch": BATCH,
+                   "latency": latency},
     }))
 
 

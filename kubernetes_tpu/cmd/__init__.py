@@ -1,0 +1,7 @@
+"""Component entry points — the cmd/ analog.
+
+Ref: cmd/kube-scheduler, cmd/kube-controller-manager, cmd/kube-apiserver.
+Each module exposes main(argv) and runs as `python -m
+kubernetes_tpu.cmd.<component>`; flags > config file > defaults, matching
+the reference's precedence (component-base/cli/flag).
+"""

@@ -1,0 +1,36 @@
+"""kube-apiserver entry point.
+
+Ref: cmd/kube-apiserver/app/server.go — here the generic server IS the
+assembly (no aggregation layers yet); serves REST+watch on --port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..apiserver.server import APIServer
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-apiserver")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    args = p.parse_args(argv)
+    srv = APIServer(host=args.bind_address, port=args.port).start()
+    print(f"serving on {srv.address}", flush=True)
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""kube-controller-manager entry point.
+
+Ref: cmd/kube-controller-manager/app (controllermanager.go Run — leader
+election wrapping StartControllers against the shared informer factory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from ..apiserver.httpclient import HTTPClient
+from ..controllers import ControllerManager
+from ..state.leaderelection import LeaderElector
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-controller-manager")
+    p.add_argument("--master", required=True)
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--node-monitor-period", type=float, default=5.0)
+    p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
+    p.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    client = HTTPClient(args.master)
+    mgr = ControllerManager(
+        client,
+        node_monitor_period=args.node_monitor_period,
+        node_grace_period=args.node_monitor_grace_period,
+        pod_eviction_timeout=args.pod_eviction_timeout)
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    if args.leader_elect:
+        def lost_lease():
+            # ref: controllermanager.go OnStoppedLeading -> Fatalf; exit and
+            # let the supervisor restart a fresh process
+            mgr.stop()
+            stop.set()
+        elector = LeaderElector(
+            client, name="kube-controller-manager",
+            identity=f"{os.uname().nodename}_{os.getpid()}",
+            on_started_leading=mgr.start,
+            on_stopped_leading=lost_lease)
+        elector.start()
+        stop.wait()
+        elector.stop()
+    else:
+        mgr.start()
+        stop.wait()
+        mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,18 @@ class BatchScheduler:
     def _has_filter_extenders(self) -> bool:
         return any(e.config.filter_verb for e in self.extenders)
 
+    def _encoded_live_nodes(self):
+        """(live_nodes, encoded_items), cached by mirror epoch — the filter
+        and prioritize extender paths share one full-cluster JSON encode
+        per snapshot instead of one each per batch."""
+        if getattr(self, "_enc_nodes_epoch", None) != self.mirror.epoch:
+            from ..api import serde as serde_mod
+            live = [ni.node for ni in self.snapshot.node_infos.values()
+                    if ni.node is not None]
+            self._enc_nodes = (live, [serde_mod.encode(n) for n in live])
+            self._enc_nodes_epoch = self.mirror.epoch
+        return self._enc_nodes
+
     def _passes_basic_checks(self, pod: Pod) -> bool:
         """Ref: podPassesBasicChecks (generic_scheduler.go:188) — referenced
         PVCs must exist and not be deleting."""
@@ -192,11 +204,7 @@ class BatchScheduler:
         live_nodes = []
         enc_nodes: Optional[list] = None
         if filter_extenders:
-            from ..api import serde as serde_mod
-            live_nodes = [ni.node for ni in self.snapshot.node_infos.values()
-                          if ni.node is not None]
-            # encoded once per batch: the wire payload is pod-invariant
-            enc_nodes = [serde_mod.encode(n) for n in live_nodes]
+            live_nodes, enc_nodes = self._encoded_live_nodes()
         for i, pod in enumerate(pods):
             internal = self._needs_residual(pod)
             if not internal and not filter_extenders:
@@ -268,12 +276,9 @@ class BatchScheduler:
         rows (ref: PrioritizeNodes :774-804 — weighted extender scores add
         to the internal sum). Errors are ignored per extender, matching
         the reference's ignorable-prioritize behavior."""
-        from ..api import serde as serde_mod
         from .extender import ExtenderError
         N = self.mirror.t.capacity
-        live_nodes = [ni.node for ni in self.snapshot.node_infos.values()
-                      if ni.node is not None]
-        enc_nodes = [serde_mod.encode(n) for n in live_nodes]
+        live_nodes, enc_nodes = self._encoded_live_nodes()
         ext = np.zeros((len(pods), N), np.float32)
         for i, pod in enumerate(pods):
             for e in self.extenders:
@@ -471,6 +476,9 @@ class BatchScheduler:
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
+        w = self.scorer.weights
+        batch.resource_weights[0] = w.get("LeastRequestedPriority", 1)
+        batch.resource_weights[1] = w.get("BalancedResourceAllocation", 1)
         nom_dev = self._nominated_device()
         if nom_dev is not None:
             # each pod's own nominated row, from the EXACT snapshot the

@@ -167,14 +167,25 @@ class ExtenderServer:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
                     verb = self.path.strip("/").split("/")[-1]
-                    if verb == "filter":
-                        out = outer._filter(payload)
-                    elif verb == "prioritize":
-                        out = outer._prioritize(payload)
-                    elif verb == "bind":
-                        out = outer._bind(payload)
-                    else:
-                        self.send_error(404)
+                    try:
+                        if verb == "filter":
+                            out = outer._filter(payload)
+                        elif verb == "prioritize":
+                            out = outer._prioritize(payload)
+                        elif verb == "bind":
+                            out = outer._bind(payload)
+                        else:
+                            self.send_error(404)
+                            return
+                    except ValueError as e:
+                        # protocol-level rejection (e.g. nodenames-only args
+                        # on a clientless sidecar): clean 400, no traceback
+                        body = json.dumps({"error": str(e)}).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                         return
                     body = json.dumps(out).encode()
                     self.send_response(200)

@@ -99,22 +99,31 @@ def _pod_feasible(node_cfg: dict, used, pod_count, pod: dict,
 
 
 def _pod_score(node_cfg: dict, nz_used, pod: dict,
-               static_score: jnp.ndarray) -> jnp.ndarray:
-    """One pod's [N] batch-varying score (resource priorities) plus the
+               static_score: jnp.ndarray,
+               rw: jnp.ndarray) -> jnp.ndarray:
+    """One pod's [N] batch-varying score (resource priorities, weighted by
+    rw = [LeastRequested, BalancedAllocation] from the Policy) plus the
     host-precomputed batch-invariant terms (its unique_scores row)."""
     cap_cpu = node_cfg["alloc"][:, COL_CPU]
     cap_mem = node_cfg["alloc"][:, COL_MEM]
-    score = _least_requested(nz_used, pod["nonzero_req"], cap_cpu, cap_mem)
-    score = score + _balanced_allocation(nz_used, pod["nonzero_req"],
-                                         cap_cpu, cap_mem)
+    score = rw[0] * _least_requested(nz_used, pod["nonzero_req"],
+                                     cap_cpu, cap_mem)
+    score = score + rw[1] * _balanced_allocation(nz_used, pod["nonzero_req"],
+                                                 cap_cpu, cap_mem)
     return score + static_score
 
 
-def _split_batch(pod_batch: dict) -> Tuple[dict, jnp.ndarray, jnp.ndarray]:
-    """(per-pod scanned arrays, unique_masks, unique_scores)."""
+_BATCH_INVARIANT = ("unique_masks", "unique_scores", "resource_weights")
+
+
+def _split_batch(pod_batch: dict):
+    """(per-pod scanned arrays, unique_masks, unique_scores, rw)."""
     per_pod = {k: v for k, v in pod_batch.items()
-               if k not in ("unique_masks", "unique_scores")}
-    return per_pod, pod_batch["unique_masks"], pod_batch["unique_scores"]
+               if k not in _BATCH_INVARIANT}
+    rw = pod_batch.get("resource_weights")
+    if rw is None:
+        rw = jnp.ones((2,), jnp.float32)
+    return per_pod, pod_batch["unique_masks"], pod_batch["unique_scores"], rw
 
 
 @jax.jit
@@ -122,14 +131,14 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full pods x nodes mask + score matrix against the frozen snapshot
     (no in-batch usage updates). vmap over the pod axis."""
-    per_pod, unique_masks, unique_scores = _split_batch(pod_batch)
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
 
     def one(pod):
         mask = unique_masks[pod["mask_idx"]]
         static = unique_scores[pod["score_idx"]]
         fits = _pod_feasible(node_cfg, usage["used"], usage["pod_count"],
                              pod, mask)
-        score = _pod_score(node_cfg, usage["nonzero_used"], pod, static)
+        score = _pod_score(node_cfg, usage["nonzero_used"], pod, static, rw)
         return fits, jnp.where(fits, score, NEG)
     return jax.vmap(one)(per_pod)
 
@@ -156,7 +165,7 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     a higher-priority pod pushed off a full nominated node preempts
     instead. Scores stay on real usage (matching PrioritizeNodes, which
     ranks against the snapshot)."""
-    per_pod, unique_masks, unique_scores = _split_batch(pod_batch)
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     rows = jnp.arange(N, dtype=jnp.int32)
     if nom is None:
@@ -172,7 +181,7 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
             jnp.where(self_oh[:, None], pod["req"][None, :], 0.0)
         eff_count = pod_count + nom["count"] - self_oh.astype(jnp.float32)
         fits = _pod_feasible(node_cfg, eff_used, eff_count, pod, mask)
-        score = _pod_score(node_cfg, nz_used, pod, static)
+        score = _pod_score(node_cfg, nz_used, pod, static, rw)
         masked = jnp.where(fits, score, NEG)
         # selectHost rotates among max-score ties across cycles (:286-296):
         # sub-integer hash penalty keyed on (row, pod seq). Base scores are

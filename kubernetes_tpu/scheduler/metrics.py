@@ -1,0 +1,71 @@
+"""Scheduler metrics — the reference's metric families over the batch path.
+
+Ref: pkg/scheduler/metrics/metrics.go:30-180. Same families and labels
+where the concept survives batching; the batch-specific additions are
+labeled phases of the device pipeline (tensorize/kernel/fetch) that the
+reference's per-pod timers have no analog for.
+"""
+
+from __future__ import annotations
+
+from ..utils.metrics import Registry
+
+SCHEDULING_LATENCY_BUCKETS = (0.0005, 0.001, 0.002, 0.004, 0.008, 0.016,
+                              0.032, 0.064, 0.128, 0.256, 0.512, 1.024,
+                              2.048, 4.096, 8.192)
+
+
+class SchedulerMetrics:
+    def __init__(self, registry: Registry = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        # ref: SchedulingLatency histogram labeled by operation
+        # {predicate_evaluation, priority_evaluation, binding, ...}; the
+        # batch analog is per-phase wall time per cycle
+        self.scheduling_duration = r.histogram(
+            "scheduler_scheduling_duration_seconds",
+            "Scheduling phase latency per batch cycle, by operation",
+            buckets=SCHEDULING_LATENCY_BUCKETS)
+        # ref: E2eSchedulingLatency — queue pop to bind committed
+        self.e2e_scheduling_duration = r.histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "End-to-end batch latency from pop to binds committed",
+            buckets=SCHEDULING_LATENCY_BUCKETS)
+        self.binding_duration = r.histogram(
+            "scheduler_binding_duration_seconds",
+            "Bind transaction latency per batch",
+            buckets=SCHEDULING_LATENCY_BUCKETS)
+        # ref: scheduleAttempts counter labeled result
+        # {scheduled, unschedulable, error}
+        self.schedule_attempts = r.counter(
+            "scheduler_schedule_attempts_total",
+            "Scheduling attempts by result")
+        # ref: PreemptionAttempts / PreemptionVictims
+        self.preemption_attempts = r.counter(
+            "scheduler_total_preemption_attempts",
+            "Preemption attempts")
+        self.preemption_victims = r.counter(
+            "scheduler_preemption_victims",
+            "Pods evicted by preemption")
+        self.pod_scheduling_errors = r.counter(
+            "scheduler_pod_scheduling_errors_total",
+            "Pods that failed a scheduling cycle with an error")
+        # ref: PendingPods gauges {active, backoff, unschedulable}
+        self.pending_pods = r.gauge(
+            "scheduler_pending_pods",
+            "Pending pods by queue")
+        self.batch_size = r.histogram(
+            "scheduler_batch_size",
+            "Pods decided per batch cycle",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                     4096))
+
+    def observe_queue(self, queue) -> None:
+        """Sample the three sub-queue depths (PendingPods gauges)."""
+        with queue._lock:
+            active = len(queue._in_active)
+            backoff = len(queue._in_backoff)
+            unschedulable = len(queue._unschedulable)
+        self.pending_pods.set(active, queue="active")
+        self.pending_pods.set(backoff, queue="backoff")
+        self.pending_pods.set(unschedulable, queue="unschedulable")

@@ -40,8 +40,10 @@ class Scheduler:
                  scheduler_name: str = "default-scheduler",
                  clock: Clock = REAL_CLOCK,
                  disable_preemption: bool = False,
-                 framework=None, extenders=None):
+                 framework=None, extenders=None, metrics=None):
         from .framework import Framework
+        from .metrics import SchedulerMetrics
+        self.metrics = metrics if metrics is not None else SchedulerMetrics()
         self.client = client
         self.scheduler_name = scheduler_name
         self.batch_size = batch_size
@@ -199,8 +201,18 @@ class Scheduler:
 
     def _schedule_batch_locked(self, pods: List[Pod], cycle: int
                                ) -> List[ScheduleResult]:
+        import time as _time
+        t0 = _time.perf_counter()
         results = self.algorithm.schedule(pods)
+        t1 = _time.perf_counter()
         self._commit_results(results, cycle)
+        t2 = _time.perf_counter()
+        m = self.metrics
+        m.scheduling_duration.observe(t1 - t0, operation="algorithm")
+        m.scheduling_duration.observe(t2 - t1, operation="commit")
+        m.e2e_scheduling_duration.observe(t2 - t0)
+        m.batch_size.observe(len(pods))
+        m.observe_queue(self.queue)
         return results
 
     def _commit_results(self, results: List[ScheduleResult], cycle: int) -> int:
@@ -240,6 +252,8 @@ class Scheduler:
                 cycle = self.queue.scheduling_cycle
                 pods = self.queue.pop_batch(self.batch_size, timeout=0,
                                             on_pop=_mark)
+                if pods:
+                    self.metrics.batch_size.observe(len(pods))
                 if not pods and prev is None:
                     break
                 pending = None
@@ -265,8 +279,16 @@ class Scheduler:
 
     def _finish_and_commit(self, pending, cycle: int,
                            expected_seq: Optional[int]) -> Optional[int]:
+        import time as _time
+        t0 = _time.perf_counter()
         results = self.algorithm.schedule_finish(pending)
+        t1 = _time.perf_counter()
         n_assumed = self._commit_results(results, cycle)
+        t2 = _time.perf_counter()
+        m = self.metrics
+        m.scheduling_duration.observe(t1 - t0, operation="fetch")
+        m.scheduling_duration.observe(t2 - t1, operation="commit")
+        m.e2e_scheduling_duration.observe(t2 - t0)
         self._in_flight -= len(results)
         if expected_seq is None:
             return None
@@ -331,10 +353,16 @@ class Scheduler:
                 continue
             fresh.append(res)
         bound = fresh
+        import time as _time
+        t_bind = _time.perf_counter()
         if self._bind_extender is not None:
             # extender-managed binding (ref: scheduler.go:411 GetBinder):
             # the extender performs the API write; the local clone feeds
-            # the cache so accounting doesn't wait on the informer echo
+            # the cache so accounting doesn't wait on the informer echo.
+            # CONTRACT: the extender must write the binding to the SAME hub
+            # this scheduler watches (as ExtenderServer does) — otherwise
+            # no confirmation ever arrives and the assumed usage expires on
+            # the cache TTL, the reference's self-heal for lost binds
             outs = []
             for res in bound:
                 try:
@@ -351,6 +379,7 @@ class Scheduler:
                 target=ObjectReference(kind="Node", name=res.node_name))
                 for res in bound]
             outs = self.client.pods().bind_bulk(bindings)
+        self.metrics.binding_duration.observe(_time.perf_counter() - t_bind)
         n_assumed = 0
         for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
@@ -375,6 +404,7 @@ class Scheduler:
                 else:
                     self.cache.finish_binding(out)
                 self.scheduled_count += 1
+                self.metrics.schedule_attempts.inc(result="scheduled")
                 continue
             # any failed bind is a kernel winner that will never be assumed:
             # no dirty row can repair its phantom usage on device
@@ -385,6 +415,8 @@ class Scheduler:
                 # bound it elsewhere: drop, don't requeue forever
                 continue
             pod = res.pod
+            self.metrics.schedule_attempts.inc(result="error")
+            self.metrics.pod_scheduling_errors.inc()
             if pod.metadata.deletion_timestamp is not None:
                 continue
             self.queue.add_unschedulable_if_not_present(
@@ -393,6 +425,7 @@ class Scheduler:
 
     def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
         self.unschedulable_count += 1
+        self.metrics.schedule_attempts.inc(result="unschedulable")
         self.queue.add_unschedulable_if_not_present(pod, cycle)
         try:
             fit_err = self.algorithm.explain(pod)
@@ -441,6 +474,8 @@ class Scheduler:
             except Exception:
                 pass
             self.queue.nominated.delete(other)
+        self.metrics.preemption_attempts.inc()
+        self.metrics.preemption_victims.inc(len(plan.victims))
         for victim in plan.victims:
             self._record_event(
                 victim, "Preempted",

@@ -112,6 +112,16 @@ class ScoreCompiler:
         self._any_avoid_annotations = False
         self._cluster_has_affinity_pods = False
 
+    def set_weights(self, weights: Dict[str, int],
+                    hard_pod_affinity_weight: Optional[int] = None) -> None:
+        """Install Policy weights (ref: CreateFromConfig applying
+        policy.Priorities); invalidates the static-vector cache."""
+        self.weights = dict(weights)
+        if hard_pod_affinity_weight is not None:
+            self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self._epoch = -1
+        self._vec_cache.clear()
+
     # ------------------------------------------------------- cached vectors
 
     def _refresh_epoch(self) -> None:

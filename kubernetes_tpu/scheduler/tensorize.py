@@ -581,6 +581,9 @@ class PodBatchTensors:
         # score dedupe table; default single zero row (resource-only scoring)
         self.score_idx = np.zeros((P,), np.int32)
         self.unique_scores = np.zeros((1, N), np.float32)
+        # [LeastRequested, BalancedAllocation] weights for the device scan
+        # (Policy-configurable; defaults.go:126-137 defaults both to 1)
+        self.resource_weights = np.ones((2,), np.float32)
 
     def set_static_scores(self, score_idx: np.ndarray,
                           unique_scores: np.ndarray) -> None:
@@ -616,4 +619,5 @@ class PodBatchTensors:
                 "score_idx": jnp.asarray(self.score_idx),
                 "nom_row": jnp.asarray(self.nom_row),
                 "unique_masks": jnp.asarray(self.unique_masks),
-                "unique_scores": jnp.asarray(self.unique_scores)}
+                "unique_scores": jnp.asarray(self.unique_scores),
+                "resource_weights": jnp.asarray(self.resource_weights)}

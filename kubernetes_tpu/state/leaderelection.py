@@ -1,0 +1,149 @@
+"""Leader election over Lease resource locks.
+
+Ref: staging/src/k8s.io/client-go/tools/leaderelection (LeaderElector,
+leaderelection.go Run/acquire/renew) with the leaselock resource lock
+(resourcelock/leaselock.go). Active-passive replication for the scheduler
+and controller manager: one replica holds the lease and runs; the rest
+retry acquisition and take over when the holder stops renewing —
+deadline-based fencing, exactly the reference's semantics (the holder
+voluntarily stops its loop when it cannot renew within the deadline).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Optional
+
+from ..api.policy import Lease, LeaseSpec
+from ..api.meta import ObjectMeta
+from ..state.store import AlreadyExistsError, ConflictError, NotFoundError
+from ..utils.clock import Clock, REAL_CLOCK, now_iso, parse_iso
+
+DEFAULT_LEASE_DURATION = 15.0   # LeaseDuration
+DEFAULT_RENEW_DEADLINE = 10.0   # RenewDeadline
+DEFAULT_RETRY_PERIOD = 2.0      # RetryPeriod
+
+
+class LeaderElector:
+    def __init__(self, client, name: str, identity: str,
+                 namespace: str = "kube-system",
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 renew_deadline: float = DEFAULT_RENEW_DEADLINE,
+                 retry_period: float = DEFAULT_RETRY_PERIOD,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 clock: Clock = REAL_CLOCK):
+        self.client = client
+        self.name = name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.is_leader = False
+
+    # ------------------------------------------------------------ lease ops
+
+    def _leases(self):
+        return self.client.leases(self.namespace)
+
+    def _try_acquire_or_renew(self) -> bool:
+        """Ref: leaderelection.go tryAcquireOrRenew — create the lease, or
+        take it over when expired, or renew when held by us."""
+        now = now_iso(self.clock)
+        try:
+            cur = self._leases().get(self.name)
+        except NotFoundError:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+                spec=LeaseSpec(holder_identity=self.identity,
+                               lease_duration_seconds=max(1, math.ceil(self.lease_duration)),
+                               acquire_time=now, renew_time=now))
+            try:
+                self._leases().create(lease)
+                return True
+            except (AlreadyExistsError, ConflictError):
+                return False
+        if cur.spec.holder_identity != self.identity:
+            renew = parse_iso(cur.spec.renew_time or "") or 0.0
+            if self.clock.now() - renew < cur.spec.lease_duration_seconds:
+                return False  # held and fresh
+        # expired or ours: CAS the takeover/renewal
+
+        def mutate(lease):
+            if lease.spec.holder_identity != self.identity:
+                renew = parse_iso(lease.spec.renew_time or "") or 0.0
+                if self.clock.now() - renew < lease.spec.lease_duration_seconds:
+                    raise ConflictError("lease held")  # lost the race
+                lease.spec.lease_transitions += 1
+                lease.spec.acquire_time = now
+            lease.spec.holder_identity = self.identity
+            lease.spec.lease_duration_seconds = max(1, math.ceil(self.lease_duration))
+            lease.spec.renew_time = now
+            return lease
+        try:
+            self._leases().patch(self.name, mutate)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (ref: leaderelection.go release).
+        No is_leader guard: the run loop clears the flag on its way out, so
+        stop() would otherwise never hand the lease off and standbys would
+        wait out the full lease duration; the patch itself only touches a
+        lease this identity still holds."""
+
+        def mutate(lease):
+            if lease.spec.holder_identity == self.identity:
+                lease.spec.holder_identity = ""
+                lease.spec.renew_time = None
+            return lease
+        try:
+            self._leases().patch(self.name, mutate)
+        except Exception:
+            pass
+        self.is_leader = False
+
+    # -------------------------------------------------------------- loop
+
+    def run(self) -> None:
+        """Blocking: acquire, call on_started_leading, renew until the
+        deadline is missed, then on_stopped_leading and re-acquire."""
+        while not self._stop.is_set():
+            if not self._try_acquire_or_renew():
+                self._stop.wait(self.retry_period)
+                continue
+            self.is_leader = True
+            if self.on_started_leading:
+                self.on_started_leading()
+            last_renew = self.clock.now()
+            while not self._stop.is_set():
+                self._stop.wait(self.retry_period)
+                if self._stop.is_set():
+                    break
+                if self._try_acquire_or_renew():
+                    last_renew = self.clock.now()
+                elif self.clock.now() - last_renew > self.renew_deadline:
+                    break  # fencing: stop leading when renewal fails
+            self.is_leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"leaderelection-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.release()

@@ -1,0 +1,97 @@
+"""Component health + metrics serving.
+
+Ref: apiserver/pkg/server/healthz (every component serves /healthz with
+named checks) and the scheduler's insecure serving mux which also exposes
+/metrics with DELETE -> Reset (cmd/kube-scheduler/app/server.go:194-211,
+:287-291).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .metrics import Registry
+
+
+class HealthzServer:
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _write(self, code: int, body: bytes,
+                       ctype: str = "text/plain") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/healthz") or \
+                        self.path.startswith("/readyz") or \
+                        self.path.startswith("/livez"):
+                    failed = [n for n, fn in outer.checks.items()
+                              if not _safe(fn)]
+                    if failed:
+                        self._write(500, ("unhealthy: " +
+                                          ",".join(failed)).encode())
+                    else:
+                        self._write(200, b"ok")
+                elif self.path.startswith("/metrics"):
+                    if outer.registry is None:
+                        self._write(404, b"no metrics registry")
+                    else:
+                        self._write(200, outer.registry.expose().encode(),
+                                    "text/plain; version=0.0.4")
+                else:
+                    self._write(404, b"not found")
+
+            def do_DELETE(self):
+                # ref: server.go:287-291 DELETE /metrics -> metrics.Reset()
+                if self.path.startswith("/metrics") and \
+                        outer.registry is not None:
+                    outer.registry.reset()
+                    self._write(200, b"metrics reset")
+                else:
+                    self._write(404, b"not found")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def add_check(self, name: str, fn: Callable[[], bool]) -> None:
+        self.checks[name] = fn
+
+    def start(self) -> "HealthzServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="healthz")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def _safe(fn) -> bool:
+    try:
+        return bool(fn())
+    except Exception:
+        return False

@@ -1,0 +1,235 @@
+"""Prometheus-style metrics: Counter / Gauge / Histogram + text exposition.
+
+Ref: the reference instruments every component with prometheus client_golang
+(e.g. pkg/scheduler/metrics/metrics.go, apiserver endpoints/metrics). This
+is the minimal compatible core: labeled metric families, histogram buckets
+matching prometheus semantics (+Inf bucket, _sum/_count), and the text
+exposition format scrapers parse.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (0.001, 0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
+                   0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(labels: Tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+    def expose(self) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def clear(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str = ""):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = self._header()
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str = "", fn=None):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+        self._fn = fn  # callback gauge: sampled at expose time
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(v)
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, n: float = 1.0, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        if self._fn is not None:
+            out.append(f"{self.name} {float(self._fn())}")
+            return out
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(buckets)
+        # label key -> (bucket counts, sum, count)
+        self._series: Dict[Tuple, list] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = s
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    s[0][i] += 1
+                    break
+            else:
+                s[0][-1] += 1
+            s[1] += v
+            s[2] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s[2] if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s[1] if s else 0.0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket upper bounds (scrape-side
+        histogram_quantile equivalent, for tests and bench reporting)."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if not s or s[2] == 0:
+                return 0.0
+            target = q * s[2]
+            acc = 0
+            for i, c in enumerate(s[0][:-1]):
+                acc += c
+                if acc >= target:
+                    return self.buckets[i]
+            return float("inf")
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            items = sorted((k, ([*s[0]], s[1], s[2]))
+                           for k, s in self._series.items())
+        out = self._header()
+        for key, (counts, total, n) in items:
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc += counts[i]
+                lab = dict(key)
+                lab["le"] = repr(b) if b != int(b) else str(b)
+                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {acc}")
+            lab = dict(key)
+            lab["le"] = "+Inf"
+            out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {n}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {total}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+        return out
+
+
+class Registry:
+    """Metric family registry with /metrics text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"metric {metric.name} already registered")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self.register(Counter(name, help_text))  # type: ignore
+
+    def gauge(self, name: str, help_text: str = "", fn=None) -> Gauge:
+        return self.register(Gauge(name, help_text, fn=fn))  # type: ignore
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_text, buckets))  # type: ignore
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Ref: the scheduler serves DELETE /metrics -> metrics.Reset()
+        (cmd/kube-scheduler/app/server.go:287-291). Values are zeroed but
+        the families STAY registered — holders keep observing into the same
+        objects and /metrics keeps serving them."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()

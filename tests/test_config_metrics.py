@@ -1,0 +1,257 @@
+"""Config/metrics/healthz/leader-election tests.
+
+Ref: pkg/scheduler/apis/config tests, api/compatibility policy tests,
+client-go leaderelection tests, component healthz behavior.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.scheduler.config import (KubeSchedulerConfiguration,
+                                             Policy, build_scheduler)
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.state.leaderelection import LeaderElector
+from kubernetes_tpu.utils.healthz import HealthzServer
+from kubernetes_tpu.utils.metrics import Registry
+
+
+def make_node(name):
+    alloc = {"cpu": Quantity("4"), "memory": Quantity("8Gi"),
+             "pods": Quantity(110)}
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            capacity=dict(alloc), allocatable=dict(alloc),
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def make_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("100m"),
+                          "memory": Quantity("64Mi")}))]))
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        r = Registry()
+        c = r.counter("requests_total", "total requests")
+        c.inc(result="ok")
+        c.inc(result="ok")
+        c.inc(result="error")
+        g = r.gauge("pending", "pending items")
+        g.set(7, queue="active")
+        h = r.histogram("latency_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = r.expose()
+        assert 'requests_total{result="ok"} 2.0' in text
+        assert 'pending{queue="active"} 7.0' in text
+        assert 'latency_seconds_bucket{le="0.1"} 1' in text
+        assert 'latency_seconds_bucket{le="1.0"} 2' in text
+        assert 'latency_seconds_bucket{le="+Inf"} 3' in text
+        assert "latency_seconds_count 3" in text
+        assert h.quantile(0.5) == 1.0
+
+    def test_scheduler_records_metrics(self):
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        from kubernetes_tpu.scheduler import Scheduler
+        sched = Scheduler(client, batch_size=8)
+        sched.start()
+        try:
+            client.pods("default").create(make_pod("p1"))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.pods("default").get("p1").spec.node_name:
+                    break
+                time.sleep(0.05)
+            m = sched.metrics
+            assert m.schedule_attempts.value(result="scheduled") == 1
+            assert m.e2e_scheduling_duration.count() >= 1
+            assert m.binding_duration.count() >= 1
+            assert m.scheduling_duration.count(operation="algorithm") >= 1
+            text = m.registry.expose()
+            assert "scheduler_e2e_scheduling_duration_seconds_count" in text
+        finally:
+            sched.stop()
+
+
+class TestHealthz:
+    def test_healthz_and_metrics_endpoints(self):
+        r = Registry()
+        r.counter("x_total", "x").inc()
+        srv = HealthzServer(registry=r).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/healthz") as resp:
+                assert resp.read() == b"ok"
+            with urllib.request.urlopen(srv.url + "/metrics") as resp:
+                assert b"x_total 1.0" in resp.read()
+            # a failing check flips healthz to 500
+            srv.add_check("down", lambda: False)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(srv.url + "/healthz")
+            assert e.value.code == 500
+            # DELETE /metrics resets VALUES; families stay registered
+            # (server.go:287-291 metrics.Reset semantics)
+            req = urllib.request.Request(srv.url + "/metrics",
+                                         method="DELETE")
+            urllib.request.urlopen(req)
+            with urllib.request.urlopen(srv.url + "/metrics") as resp:
+                body = resp.read()
+            assert b"x_total 1.0" not in body
+            assert b"x_total 0.0" in body  # family survives, value zeroed
+        finally:
+            srv.stop()
+
+
+class TestPolicyConfig:
+    def test_policy_parsing(self, tmp_path):
+        policy = {
+            "kind": "Policy", "apiVersion": "v1",
+            "predicates": [{"name": "PodFitsResources"},
+                           {"name": "MatchNodeSelector"}],
+            "priorities": [{"name": "NodeAffinityPriority", "weight": 3},
+                           {"name": "SelectorSpreadPriority", "weight": 2}],
+            "extenders": [{"urlPrefix": "http://127.0.0.1:9999",
+                           "filterVerb": "filter", "weight": 2,
+                           "ignorable": True}],
+            "hardPodAffinitySymmetricWeight": 10,
+        }
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(policy))
+        p = Policy.from_file(str(path))
+        assert p.predicates == ["PodFitsResources", "MatchNodeSelector"]
+        assert p.priorities == {"NodeAffinityPriority": 3,
+                                "SelectorSpreadPriority": 2}
+        assert p.extenders[0].filter_verb == "filter"
+        assert p.extenders[0].ignorable
+        assert p.hard_pod_affinity_symmetric_weight == 10
+        w = p.weights()
+        assert w["NodeAffinityPriority"] == 3
+        assert w["TaintTolerationPriority"] == 0  # not listed -> off
+
+    def test_unknown_names_rejected(self):
+        with pytest.raises(ValueError):
+            Policy.from_dict({"predicates": [{"name": "NoSuchPredicate"}]})
+        with pytest.raises(ValueError):
+            Policy.from_dict({"priorities": [{"name": "NoSuchPriority",
+                                              "weight": 1}]})
+
+    def test_component_config_and_build(self, tmp_path):
+        cfg_data = {
+            "schedulerName": "tpu-scheduler",
+            "batchSize": 256,
+            "disablePreemption": True,
+            "leaderElection": {"leaderElect": True,
+                              "resourceName": "tpu-sched"},
+            "algorithmSource": {"policy": {"inline": {
+                "priorities": [{"name": "NodeAffinityPriority",
+                                "weight": 5}]}}},
+        }
+        path = tmp_path / "config.json"
+        path.write_text(json.dumps(cfg_data))
+        cfg = KubeSchedulerConfiguration.from_file(str(path))
+        assert cfg.scheduler_name == "tpu-scheduler"
+        assert cfg.batch_size == 256
+        assert cfg.disable_preemption
+        assert cfg.leader_election.leader_elect
+        assert cfg.leader_election.resource_name == "tpu-sched"
+        sched = build_scheduler(Client(), cfg)
+        assert sched.scheduler_name == "tpu-scheduler"
+        assert sched.batch_size == 256
+        assert sched.disable_preemption
+        assert sched.algorithm.scorer.weights["NodeAffinityPriority"] == 5
+        assert sched.algorithm.scorer.weights["SelectorSpreadPriority"] == 0
+
+    def test_kernel_resource_weights_flow_to_device(self):
+        """Policy weights for the device-resident resource priorities reach
+        the batch's resource_weights vector."""
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        cfg = KubeSchedulerConfiguration()
+        cfg.policy = Policy(priorities={"LeastRequestedPriority": 7,
+                                        "BalancedResourceAllocation": 0})
+        sched = build_scheduler(client, cfg)
+        alg = sched.algorithm
+        alg.cache.add_node(make_node("n1"))
+        pending = alg.schedule_launch([make_pod("p")])
+        assert pending is not None
+        assert list(pending.batch.resource_weights) == [7.0, 0.0]
+        alg.schedule_finish(pending)
+
+    def test_policy_weights_change_decisions(self):
+        """A policy that zeroes SelectorSpread but keeps NodeAffinity at
+        weight 5 must steer pods to the preferred node."""
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        preferred = make_node("n2")
+        preferred.metadata.labels["zone"] = "gold"
+        client.nodes().create(preferred)
+        cfg = KubeSchedulerConfiguration()
+        cfg.policy = Policy(priorities={"NodeAffinityPriority": 5})
+        cfg.batch_size = 8
+        sched = build_scheduler(client, cfg)
+        sched.start()
+        try:
+            pod = make_pod("wants-gold")
+            pod.spec.affinity = api.Affinity(node_affinity=api.NodeAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    api.PreferredSchedulingTerm(
+                        weight=100,
+                        preference=api.NodeSelectorTerm(
+                            match_expressions=[api.NodeSelectorRequirement(
+                                key="zone", operator="In",
+                                values=["gold"])]))]))
+            client.pods("default").create(pod)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if client.pods("default").get("wants-gold").spec.node_name:
+                    break
+                time.sleep(0.05)
+            assert client.pods("default").get(
+                "wants-gold").spec.node_name == "n2"
+        finally:
+            sched.stop()
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        client = Client()
+        events = []
+        a = LeaderElector(client, "sched", "a", retry_period=0.05,
+                          lease_duration=0.5, renew_deadline=0.3,
+                          on_started_leading=lambda: events.append("a-up"),
+                          on_stopped_leading=lambda: events.append("a-down"))
+        b = LeaderElector(client, "sched", "b", retry_period=0.05,
+                          lease_duration=0.5, renew_deadline=0.3,
+                          on_started_leading=lambda: events.append("b-up"),
+                          on_stopped_leading=lambda: events.append("b-down"))
+        a.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.02)
+        assert a.is_leader
+        b.start()
+        time.sleep(0.3)
+        assert not b.is_leader  # lease held and fresh
+        # a dies; b takes over after the lease expires
+        a.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.is_leader:
+            time.sleep(0.02)
+        assert b.is_leader
+        assert events[0] == "a-up"
+        assert "b-up" in events
+        lease = client.leases("kube-system").get("sched")
+        assert lease.spec.holder_identity == "b"
+        b.stop()
