@@ -31,6 +31,13 @@ from kubernetes_tpu.state import Client
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+# affinity variants (scheduler_bench_test.go:39-131 runs 500-5000 nodes);
+# pod-(anti-)affinity exercises the host residual path, so size accordingly
+AFF_NODES = int(os.environ.get("BENCH_AFF_NODES", "1000"))
+AFF_PODS = int(os.environ.get("BENCH_AFF_PODS", "2000"))
+# parity harness: % of batch decisions identical to the serial oracle
+PARITY_PODS = int(os.environ.get("BENCH_PARITY_PODS", "500"))
+PARITY_NODES = int(os.environ.get("BENCH_PARITY_NODES", "100"))
 BASELINE_PODS_PER_SEC = 100.0
 
 
@@ -47,52 +54,177 @@ def make_node(i):
                                                             status="True")]))
 
 
-def make_pod(i):
+def make_pod(i, variant="uniform"):
     # mixed shapes like the reference's perf configs
     cpu = ["100m", "250m", "500m"][i % 3]
     mem = ["128Mi", "512Mi", "1Gi"][i % 3]
-    return api.Pod(
-        metadata=api.ObjectMeta(name=f"pod-{i}", namespace="default"),
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name=f"pod-{i}", namespace="default",
+                                labels={"app": "bench", "color": "blue"}),
         spec=api.PodSpec(containers=[api.Container(
             name="c", image="pause",
             resources=api.ResourceRequirements(
                 requests={"cpu": Quantity(cpu), "memory": Quantity(mem)}))]))
+    if variant == "node-affinity":
+        # ref: BenchmarkSchedulingNodeAffinity — required affinity matching
+        # half the nodes (zone labels)
+        pod.spec.affinity = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[api.NodeSelectorTerm(
+                    match_expressions=[api.NodeSelectorRequirement(
+                        key=api.wellknown.LABEL_ZONE, operator="In",
+                        values=[f"zone-{z}" for z in range(8)])])])))
+    elif variant == "pod-affinity":
+        # ref: BenchmarkSchedulingPodAffinity — required affinity to pods
+        # sharing the app label, zone topology
+        pod.spec.affinity = api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels={"app": "bench"}),
+                    topology_key=api.wellknown.LABEL_ZONE)]))
+    elif variant == "pod-anti-affinity":
+        # ref: BenchmarkSchedulingPodAntiAffinity — anti-affinity on a label
+        # only a seeded subset carries, hostname topology
+        pod.metadata.labels["color"] = f"c{i % 100}"
+        pod.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels={"color": f"c{i % 100}"}),
+                    topology_key=api.wellknown.LABEL_HOSTNAME)]))
+    return pod
 
 
-def main():
+def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
+               warm_all_buckets=True):
+    """One scheduler_perf config. Returns (pods/s, scheduled, sched,
+    setup_s, elapsed) — the ONE fixture/warmup scaffold every config runs
+    through, so warmup strategies cannot drift between configs.
+
+    Warmup compiles with the SAME variant (the unique-mask bucket U is part
+    of the kernel shape). warm_all_buckets walks every power-of-two pod
+    bucket the drain can produce — needed when in-batch (anti-)affinity
+    repair demotes losers into shrinking retry batches; uniform configs
+    produce no retries, so they warm just the full + final-partial buckets.
+    """
+    from kubernetes_tpu.scheduler import Scheduler
     client = Client(validate=False)
-    sched = Scheduler(client, batch_size=BATCH)
+    b = batch or BATCH
+    sched = Scheduler(client, batch_size=b)
     t_setup = time.time()
-    for i in range(N_NODES):
+    for i in range(n_nodes):
         node = make_node(i)
         client.nodes().create(node)
         sched.cache.add_node(node)
-    pods = []
-    for i in range(N_PODS):
-        pod = make_pod(i)
-        pod = client.pods().create(pod)
-        pods.append(pod)
+    # seeded existing pods give (anti-)affinity terms something to match
+    for i in range(seed_pods):
+        p = make_pod(1_000_000 + i, variant="uniform")
+        p.spec.node_name = f"node-{i % n_nodes}"
+        sched.cache.add_pod(p)
+    pods = [client.pods().create(make_pod(i, variant))
+            for i in range(n_pods)]
     for pod in pods:
         sched.queue.add(pod)
     setup_s = time.time() - t_setup
-
-    # warmup: compile the kernels for every pod-bucket shape the run will
-    # see (full batches + the final partial batch) on throwaway pods, so the
-    # timed region measures scheduling, not XLA compilation
     sched.algorithm.refresh()
-    warm_sizes = {min(BATCH, N_PODS)}
-    if N_PODS % BATCH:
-        warm_sizes.add(N_PODS % BATCH)
+    if warm_all_buckets:
+        warm_sizes = []
+        sz = min(b, n_pods)
+        while sz >= 1:
+            warm_sizes.append(sz)
+            sz //= 2
+    else:
+        warm_sizes = [min(b, n_pods)]
+        if n_pods % b:
+            warm_sizes.append(n_pods % b)
     for sz in warm_sizes:
-        dummies = [make_pod(10_000_000 + i) for i in range(sz)]
-        sched.algorithm.schedule(dummies)
-    # warmup assignments were never assumed; drop their phantom device usage
-    sched.algorithm.mirror.invalidate_usage()
-
+        sched.algorithm.schedule(
+            [make_pod(2_000_000 + i, variant) for i in range(sz)])
+        sched.algorithm.mirror.invalidate_usage()
+    _warm_dirty_scatter(sched)
     t0 = time.time()
     scheduled = sched.drain_pipelined()
     elapsed = time.time() - t0
-    rate = scheduled / elapsed if elapsed > 0 else 0.0
+    rate = scheduled / elapsed if elapsed else 0.0
+    return rate, scheduled, sched, setup_s, elapsed
+
+
+def _warm_dirty_scatter(sched):
+    """Compile the O(delta) row-scatter (kernels.apply_dirty) for every
+    dirty-bucket size the drain can hit — the first real batch's assumes
+    would otherwise compile it inside the timed region."""
+    mirror = sched.algorithm.mirror
+    mirror.device_cfg_usage()  # full upload path
+    cap = mirror.t.capacity
+    d = 1
+    while d <= cap:
+        mirror._dirty_rows = set(range(min(d, cap)))
+        mirror.device_cfg_usage()
+        d *= 2
+
+
+def measure_parity(n_pods, n_nodes):
+    """% of batch bind decisions identical to a serial python oracle that
+    replays the reference's per-pod loop (predicates + priorities + the
+    kernel's tie-break) over the same fixture in the same order
+    (the north star's bind-decision-parity claim, measured)."""
+    import numpy as np
+    from kubernetes_tpu.api.serde import deepcopy_obj
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.scheduler import predicates as preds
+    from kubernetes_tpu.scheduler import priorities as prios
+    from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+
+    nodes = [make_node(i) for i in range(n_nodes)]
+    pods = [make_pod(i) for i in range(n_pods)]
+    # batch decisions
+    client = Client(validate=False)
+    sched = Scheduler(client, batch_size=BATCH)
+    for n in nodes:
+        client.nodes().create(n)
+        sched.cache.add_node(n)
+    created = [client.pods().create(p) for p in pods]
+    for p in created:
+        sched.queue.add(p)
+    sched.algorithm.refresh()
+    sched.drain_pipelined()
+    batch_decision = {p.metadata.name: p.spec.node_name
+                      for p in client.pods().list()}
+    row_of = dict(sched.algorithm.mirror.row_of)
+
+    # serial oracle: one pod at a time, assume between iterations
+    infos = {n.metadata.name: NodeInfo(n) for n in nodes}
+    oracle_decision = {}
+    for seq, pod in enumerate(pods):
+        meta = preds.PredicateMetadata(pod, infos)
+        feasible = {name: ni for name, ni in infos.items()
+                    if preds.pod_fits_on_node(pod, meta, ni)[0]}
+        if not feasible:
+            oracle_decision[pod.metadata.name] = ""
+            continue
+        pmeta = prios.PriorityMetadata(pod)
+        scores = prios.prioritize_nodes(pod, pmeta, feasible,
+                                        all_node_infos=infos)
+        # the kernel's tie-break, bit-exact (kernels/batch.py): the low 16
+        # bits are invariant under 32-bit wraparound, so plain python ints
+        # match the kernel's int32 arithmetic without overflow warnings
+        def penalty(name):
+            h = (row_of[name] * -1640531527 + seq * 40503) & 0xFFFF
+            return float(h) * (0.5 / 65536.0)
+        best = max(feasible, key=lambda nm: scores.get(nm, 0) - penalty(nm))
+        oracle_decision[pod.metadata.name] = best
+        bound = deepcopy_obj(pod)
+        bound.spec.node_name = best
+        infos[best].add_pod(bound)
+    matches = sum(1 for name, nn in oracle_decision.items()
+                  if batch_decision.get(name, "") == nn)
+    return matches / max(1, len(oracle_decision))
+
+
+def main():
+    rate, scheduled, sched, setup_s, elapsed = run_config(
+        N_NODES, N_PODS, "uniform", warm_all_buckets=False)
     # per-phase latencies from the scheduler's own metrics histograms
     # (ref: scheduling_duration_seconds{operation} scraped in density e2e,
     # metrics_util.go:670-713) — not ad-hoc timers
@@ -107,6 +239,21 @@ def main():
         "binding_p99_s": m.binding_duration.quantile(0.99),
         "batches": m.e2e_scheduling_duration.count(),
     }
+    # affinity variants (ref: scheduler_bench_test.go:39-131) + parity
+    affinity = {}
+    if AFF_PODS > 0:
+        for variant, seed in (("node-affinity", 0),
+                              ("pod-affinity", AFF_NODES),
+                              ("pod-anti-affinity", 0)):
+            r, n_sched, _, _, _ = run_config(AFF_NODES, AFF_PODS, variant,
+                                             seed_pods=seed)
+            affinity[variant] = {
+                "pods_per_sec": round(r, 1), "scheduled": n_sched,
+                "nodes": AFF_NODES, "pods": AFF_PODS}
+    parity_rate = None
+    if PARITY_PODS > 0:
+        parity_rate = round(measure_parity(PARITY_PODS, PARITY_NODES), 4)
+
     print(json.dumps({
         "metric": "scheduler_perf pods-scheduled/sec "
                   f"({N_PODS} pods x {N_NODES} nodes)",
@@ -116,7 +263,10 @@ def main():
         "detail": {"scheduled": scheduled, "pending": N_PODS,
                    "elapsed_s": round(elapsed, 2),
                    "setup_s": round(setup_s, 2), "batch": BATCH,
-                   "latency": latency},
+                   "latency": latency,
+                   "affinity": affinity,
+                   "parity_rate": parity_rate,
+                   "parity_fixture": f"{PARITY_PODS}x{PARITY_NODES}"},
     }))
 
 

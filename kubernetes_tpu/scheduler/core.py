@@ -103,6 +103,65 @@ def _pod_has_pvc(pod: Pod) -> bool:
     return any(v.persistent_volume_claim for v in pod.spec.volumes)
 
 
+def _required_pod_terms(pod: Pod):
+    aff = pod.spec.affinity
+    if aff is None:
+        return []
+    out = []
+    if aff.pod_affinity:
+        out += (aff.pod_affinity
+                .required_during_scheduling_ignored_during_execution or [])
+    if aff.pod_anti_affinity:
+        out += (aff.pod_anti_affinity
+                .required_during_scheduling_ignored_during_execution or [])
+    return out
+
+
+class _WinnerIndex:
+    """Label-index prefilter for winner<->pod affinity interactions in the
+    in-batch repair. EXACT matching stays in PredicateMetadata.add_pod; the
+    index only prunes winners that provably cannot interact with a pod, so
+    repair cost drops from O(pods x winners) metadata updates to
+    O(pods x matching-winners) — the reference pays the same total via its
+    serial per-pod metadata recomputes. Selector subset logic: a
+    match_labels selector matches an object only if EVERY (k,v) appears in
+    the object's labels, so one (k,v) lookup yields a superset; selectors
+    with expressions (or empty) are never pruned."""
+
+    def __init__(self):
+        self.winners: List[Pod] = []
+        self._by_label: Dict[Tuple[str, str], List[int]] = {}
+        self._term_sel: Dict[Tuple[str, str], List[int]] = {}
+        self._unprunable: List[int] = []
+
+    def add(self, bound: Pod) -> None:
+        idx = len(self.winners)
+        self.winners.append(bound)
+        for kv in bound.metadata.labels.items():
+            self._by_label.setdefault(kv, []).append(idx)
+        for t in _required_pod_terms(bound):
+            sel = t.label_selector
+            if sel is None or sel.match_expressions or not sel.match_labels:
+                self._unprunable.append(idx)
+            else:
+                for kv in sel.match_labels.items():
+                    self._term_sel.setdefault(kv, []).append(idx)
+
+    def candidates(self, pod: Pod) -> List[Pod]:
+        cand = set(self._unprunable)
+        # winners whose own required terms might match this pod
+        for kv in pod.metadata.labels.items():
+            cand.update(self._term_sel.get(kv, ()))
+        # winners this pod's own required terms might match
+        for t in _required_pod_terms(pod):
+            sel = t.label_selector
+            if sel is None or sel.match_expressions or not sel.match_labels:
+                return list(self.winners)  # cannot prune for this pod
+            kv = next(iter(sel.match_labels.items()))
+            cand.update(self._by_label.get(kv, ()))
+        return [self.winners[i] for i in sorted(cand)]
+
+
 class BatchScheduler:
     def __init__(self, cache: Cache, listers=None,
                  weights: Optional[Dict[str, int]] = None,
@@ -195,6 +254,26 @@ class BatchScheduler:
                 return False
         return True
 
+    def _residual_sig(self, pod: Pod) -> Tuple:
+        """Everything the residual per-node evaluation can depend on:
+        controller-stamped pods share it, so the O(N) python predicate pass
+        and the cluster-wide PredicateMetadata scan run once per TEMPLATE
+        per batch, not once per pod (the affinity analog of the mask-row
+        dedupe in PodBatchTensors)."""
+        aff = pod.spec.affinity
+        # dataclass repr is deep and deterministic: a faithful canon
+        aff_canon = repr(aff) if aff is not None else ""
+        vols = tuple(sorted(
+            (v.name,
+             v.persistent_volume_claim.claim_name
+             if v.persistent_volume_claim else "",
+             repr(v.gce_persistent_disk), repr(v.aws_elastic_block_store),
+             repr(v.azure_disk), repr(v.rbd), repr(v.iscsi))
+            for v in pod.spec.volumes))
+        return (pod.metadata.namespace,
+                tuple(sorted(pod.metadata.labels.items())),
+                aff_canon, vols)
+
     def _residual_mask(self, pods: List[Pod]
                        ) -> Tuple[Optional[np.ndarray], Dict[int, preds.PredicateMetadata]]:
         metas: Dict[int, preds.PredicateMetadata] = {}
@@ -205,6 +284,8 @@ class BatchScheduler:
         enc_nodes: Optional[list] = None
         if filter_extenders:
             live_nodes, enc_nodes = self._encoded_live_nodes()
+        #: sig -> (row_mask, meta) computed once per template per batch
+        row_cache: Dict[Tuple, Tuple[np.ndarray, preds.PredicateMetadata]] = {}
         for i, pod in enumerate(pods):
             internal = self._needs_residual(pod)
             if not internal and not filter_extenders:
@@ -214,34 +295,47 @@ class BatchScheduler:
             if not self._passes_basic_checks(pod):
                 extra[i, :] = False
                 continue
+            if internal:
+                sig = self._residual_sig(pod)
+                cached = row_cache.get(sig)
+                if cached is None:
+                    cached = self._residual_row(pod)
+                    row_cache[sig] = cached
+                row_mask, meta = cached
+                metas[i] = meta
+                extra[i] &= row_mask
             if filter_extenders and not self._apply_filter_extenders(
                     filter_extenders, pod, live_nodes, extra, i, enc_nodes):
                 continue
-            if not internal:
-                continue  # extender-only pod: skip the per-node predicates
-            meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
-            metas[i] = meta
-            has_disk = _pod_has_conflict_volumes(pod)
-            has_pvc = _pod_has_pvc(pod)
-            has_attach = has_pvc or _pod_has_attach_volumes(pod)
-            for name, ni in self.snapshot.node_infos.items():
-                row = self.mirror.row_of.get(name)
-                if row is None or not extra[i, row]:
-                    continue  # already vetoed (extender filter)
-                ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
-                if ok and has_disk:
-                    ok, _ = preds.no_disk_conflict(pod, meta, ni)
-                if ok and has_attach:
-                    for fn in self._volume_count_preds.values():
-                        ok, _ = fn(pod, meta, ni)
-                        if not ok:
-                            break
-                if ok and has_pvc:
-                    ok, _ = self._zone_conflict(pod, meta, ni)
-                    if ok and ni.node is not None:
-                        ok = self.volume_binder.find_pod_volumes(pod, ni.node)
-                extra[i, row] = ok
         return extra, metas
+
+    def _residual_row(self, pod: Pod
+                      ) -> Tuple[np.ndarray, preds.PredicateMetadata]:
+        """One template's [capacity] residual-predicate mask + its metadata
+        (batch-start state; in-batch interactions are _repair_batch's job)."""
+        meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
+        row_mask = np.zeros((self.mirror.t.capacity,), bool)
+        has_disk = _pod_has_conflict_volumes(pod)
+        has_pvc = _pod_has_pvc(pod)
+        has_attach = has_pvc or _pod_has_attach_volumes(pod)
+        for name, ni in self.snapshot.node_infos.items():
+            row = self.mirror.row_of.get(name)
+            if row is None:
+                continue
+            ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
+            if ok and has_disk:
+                ok, _ = preds.no_disk_conflict(pod, meta, ni)
+            if ok and has_attach:
+                for fn in self._volume_count_preds.values():
+                    ok, _ = fn(pod, meta, ni)
+                    if not ok:
+                        break
+            if ok and has_pvc:
+                ok, _ = self._zone_conflict(pod, meta, ni)
+                if ok and ni.node is not None:
+                    ok = self.volume_binder.find_pod_volumes(pod, ni.node)
+            row_mask[row] = ok
+        return row_mask, meta
 
     def _apply_filter_extenders(self, filter_extenders, pod: Pod,
                                 live_nodes, extra: np.ndarray,
@@ -313,6 +407,7 @@ class BatchScheduler:
             return
         overlay: Dict[str, NodeInfo] = {}
         winners: List[Pod] = []
+        windex = _WinnerIndex()
         # PV names earlier winners will reserve: two winners in one batch
         # must not both claim the single matching PV (the serial reference
         # reserves via AssumePodVolumes between scheduleOne iterations)
@@ -376,7 +471,11 @@ class BatchScheduler:
                         base = self.snapshot.node_infos \
                             if self._has_affinity_pods else {}
                         meta = preds.PredicateMetadata(pod, base)
-                    for w in winners:
+                    else:
+                        # metas entries are SHARED across same-template pods
+                        # (row cache); mutate a private copy
+                        meta = meta.clone()
+                    for w in windex.candidates(pod):
                         wni = overlay.get(w.spec.node_name)
                         if wni is not None:
                             meta.add_pod(w, wni)
@@ -393,6 +492,7 @@ class BatchScheduler:
             if ni is not None:
                 ni.add_pod(bound)
             winners.append(bound)
+            windex.add(bound)
             aff = pod.spec.affinity
             if aff and aff.pod_anti_affinity and \
                     aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution:

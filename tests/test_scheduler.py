@@ -857,6 +857,17 @@ class TestPreemption:
             sched.stop()
 
 
+class TestDecisionParity:
+    def test_batch_matches_serial_oracle(self):
+        """The north star's bind-decision-parity claim, measured: the batch
+        path's decisions equal a serial python oracle replaying the
+        reference's per-pod loop (predicates + priorities + the kernel's
+        tie-break) over the same fixture in the same order."""
+        import bench
+        rate = bench.measure_parity(n_pods=120, n_nodes=40)
+        assert rate == 1.0, f"parity {rate:.4f} < 1.0"
+
+
 class TestEndToEnd:
     """The aha-slice: store -> informers -> queue -> TPU kernel -> bind."""
 
