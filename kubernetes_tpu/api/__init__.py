@@ -4,7 +4,7 @@ from . import helpers, labels, serde, validation, wellknown
 from .apps import (DaemonSet, DaemonSetSpec, Deployment, DeploymentSpec,
                    DeploymentStrategy, ReplicaSet, ReplicaSetSpec,
                    RollingUpdateDeployment, StatefulSet, StatefulSetSpec)
-from .batch import CronJob, Job
+from .batch import CronJob, CronJobSpec, Job, JobCondition, JobSpec
 from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
                    Endpoints, Event, Namespace, Node, NodeAffinity,
                    NodeCondition, NodeSelector, NodeSelectorRequirement,

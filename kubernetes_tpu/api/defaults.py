@@ -45,7 +45,7 @@ def _default_workload(obj, kind_labels_from_template: bool = True):
     if not obj.metadata.namespace:
         obj.metadata.namespace = "default"
     spec = obj.spec
-    if getattr(spec, "replicas", None) is None:
+    if hasattr(spec, "replicas") and spec.replicas is None:
         spec.replicas = 1
     # apps/v1 requires an explicit selector; default it from template labels
     # only for convenience in tests (v1beta legacy behavior)
@@ -62,10 +62,29 @@ def _default_workload(obj, kind_labels_from_template: bool = True):
 
 
 def default(obj):
+    from .batch import Job
     if isinstance(obj, Pod):
         return default_pod(obj)
     if isinstance(obj, (Deployment, ReplicaSet, StatefulSet, DaemonSet)):
         return _default_workload(obj)
+    if isinstance(obj, Job):
+        # the registry generates the Job selector (ref: pkg/registry/batch/
+        # job/strategy.go — uid-based there; job-name works pre-uid)
+        if obj.spec.selector is None and not obj.spec.manual_selector:
+            obj.spec.template.metadata.labels.setdefault(
+                "job-name", obj.metadata.name)
+            obj.spec.selector = LabelSelector(
+                match_labels={"job-name": obj.metadata.name})
+        return _default_workload(obj, kind_labels_from_template=False)
+    if getattr(obj, "kind", "") == "Namespace":
+        # the kubernetes finalizer gates deletion on content cleanup
+        # (ref: pkg/registry/core/namespace strategy + the namespace
+        # controller's finalization dance)
+        if "kubernetes" not in obj.spec.finalizers:
+            obj.spec.finalizers.append("kubernetes")
+        if "kubernetes" not in obj.metadata.finalizers:
+            obj.metadata.finalizers.append("kubernetes")
+        return obj
     meta = getattr(obj, "metadata", None)
     if meta is not None and not meta.namespace and getattr(obj, "kind", "") in (
             "Service", "Endpoints", "PersistentVolumeClaim", "Job", "CronJob",

@@ -9,8 +9,13 @@ workqueue -> sync(key) -> API writes, with exponential retry on error
 Implemented slice (dependency-ordered):
   ReplicaSetController     replicaset.py      (pkg/controller/replicaset)
   DeploymentController     deployment.py      (pkg/controller/deployment)
+  JobController            job.py             (pkg/controller/job)
+  EndpointsController      endpoints.py       (pkg/controller/endpoint)
+  NamespaceController      namespace.py       (pkg/controller/namespace)
+  PersistentVolumeBinder   volume.py          (pkg/controller/volume/persistentvolume)
   NodeLifecycleController  nodelifecycle.py   (pkg/controller/nodelifecycle)
   GarbageCollector         garbagecollector.py (pkg/controller/garbagecollector)
+  PodGCController          podgc.py           (pkg/controller/podgc + ttlafterfinished)
   ControllerManager        manager.py         (cmd/kube-controller-manager)
 
 These are host-side control loops by design — the TPU owns the pods x nodes
@@ -20,11 +25,18 @@ device round trip has nothing to amortize.
 
 from .base import Controller
 from .deployment import DeploymentController
+from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
+from .job import JobController
 from .manager import ControllerManager
+from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .volume import PersistentVolumeBinder
 
 __all__ = ["Controller", "ControllerManager", "DeploymentController",
-           "GarbageCollector", "NodeLifecycleController",
+           "EndpointsController", "GarbageCollector", "JobController",
+           "NamespaceController", "NodeLifecycleController",
+           "PersistentVolumeBinder", "PodGCController",
            "ReplicaSetController"]

@@ -219,6 +219,12 @@ class DeploymentController(Controller):
         surge, unavailable = max_surge_unavailable(d)
         actives = [new_rs] + [rs for rs in old_rss if rs.spec.replicas > 0]
         total = sum(rs.spec.replicas for rs in actives)
+        # pure scale-down of the deployment (kubectl scale to fewer
+        # replicas): the new RS follows immediately (ref:
+        # scaleUpNewReplicaSetForRollingUpdate's > arm -> scale down)
+        if new_rs.spec.replicas > d.spec.replicas:
+            self._scale_rs(new_rs, d.spec.replicas)
+            return
         # scale up (scaleUpNewReplicaSetForRollingUpdate)
         if new_rs.spec.replicas < d.spec.replicas:
             allowed = d.spec.replicas + surge - total

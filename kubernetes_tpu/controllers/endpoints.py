@@ -1,0 +1,96 @@
+"""Endpoints controller.
+
+Ref: pkg/controller/endpoint/endpoints_controller.go (syncService :397):
+for every Service with a selector, maintain an Endpoints object whose
+subsets hold the ready/not-ready addresses of matching pods.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api.core import (EndpointAddress, EndpointPort, Endpoints,
+                        EndpointSubset, Pod, Service)
+from ..api.meta import LabelSelector, ObjectMeta
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+from .replicaset import pod_is_active, pod_is_ready
+
+
+class EndpointsController(Controller):
+    name = "endpoints"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.svc_informer = informers.informer_for(Service)
+        self.pod_informer = informers.informer_for(Pod)
+        self.svc_informer.add_event_handlers(EventHandlers(
+            on_add=lambda s: self.enqueue(s.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key()),
+            on_delete=lambda s: self.enqueue(s.metadata.key())))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_event,
+            on_update=lambda o, n: self._on_pod_event(n),
+            on_delete=self._on_pod_event))
+
+    def _on_pod_event(self, pod: Pod) -> None:
+        for svc in self.svc_informer.indexer.list(pod.metadata.namespace):
+            sel = svc.spec.selector
+            if sel and all(pod.metadata.labels.get(k) == v
+                           for k, v in sel.items()):
+                self.enqueue(svc.metadata.key())
+
+    def sync(self, key: str) -> None:
+        from ..state.store import NotFoundError
+        svc = self.svc_informer.indexer.get_by_key(key)
+        ns, name = key.split("/", 1)
+        if svc is not None and not svc.spec.selector:
+            # selectorless services own user-managed Endpoints: hands off
+            # (ref: syncService skips services without a selector)
+            return
+        if svc is None:
+            try:
+                self.client.endpoints(ns).delete(name)
+            except Exception:
+                pass
+            return
+        ready: List[EndpointAddress] = []
+        not_ready: List[EndpointAddress] = []
+        for pod in self.pod_informer.indexer.list(ns):
+            if not all(pod.metadata.labels.get(k) == v
+                       for k, v in svc.spec.selector.items()):
+                continue
+            if not pod_is_active(pod) or not pod.spec.node_name:
+                continue
+            addr = EndpointAddress(
+                ip=pod.status.pod_ip or pod.status.host_ip or "0.0.0.0",
+                node_name=pod.spec.node_name,
+                target_ref={"kind": "Pod", "namespace": ns,
+                            "name": pod.metadata.name,
+                            "uid": pod.metadata.uid})
+            (ready if pod_is_ready(pod) else not_ready).append(addr)
+        ports = [EndpointPort(name=p.name, port=p.target_port or p.port,
+                              protocol=p.protocol)
+                 for p in svc.spec.ports]
+        subsets = []
+        if ready or not_ready:
+            subsets = [EndpointSubset(addresses=ready,
+                                      not_ready_addresses=not_ready,
+                                      ports=ports)]
+        ep = Endpoints(metadata=ObjectMeta(name=name, namespace=ns),
+                       subsets=subsets)
+        try:
+            cur = self.client.endpoints(ns).get(name)
+            if cur.subsets == subsets:
+                return
+            def mutate(c):
+                c.subsets = subsets
+                return c
+            self.client.endpoints(ns).patch(name, mutate)
+        except NotFoundError:
+            try:
+                self.client.endpoints(ns).create(ep)
+            except Exception:
+                pass

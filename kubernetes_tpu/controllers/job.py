@@ -1,0 +1,179 @@
+"""Job controller.
+
+Ref: pkg/controller/job/job_controller.go (syncJob :436, manageJob :711):
+run `parallelism` pods at a time until `completions` succeed; count
+failures against backoffLimit; stamp Complete/Failed conditions and
+completionTime.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api import serde
+from ..api.batch import Job, JobCondition
+from ..api.core import Pod
+from ..api.meta import LabelSelector, ObjectMeta, controller_ref, \
+    new_controller_ref
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..utils.clock import now_iso
+from .base import Controller, Expectations
+from .replicaset import pod_is_active
+
+
+class JobController(Controller):
+    name = "job"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 2):
+        super().__init__(workers)
+        self.client = client
+        self.expectations = Expectations()
+        self.job_informer = informers.informer_for(Job)
+        self.pod_informer = informers.informer_for(Pod)
+        self.job_informer.add_event_handlers(EventHandlers(
+            on_add=lambda j: self.enqueue(j.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key()),
+            on_delete=lambda j: (self.expectations.delete(j.metadata.key()),
+                                 self.enqueue(j.metadata.key()))))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_add,
+            on_update=lambda o, n: self._enqueue_owner(n),
+            on_delete=self._on_pod_delete))
+
+    def _job_key_of(self, pod: Pod):
+        ref = controller_ref(pod.metadata)
+        if ref is None or ref.kind != "Job":
+            return None
+        return f"{pod.metadata.namespace}/{ref.name}"
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        key = self._job_key_of(pod)
+        if key is not None:
+            self.expectations.creation_observed(key)
+            self.enqueue(key)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        key = self._job_key_of(pod)
+        if key is not None:
+            self.expectations.deletion_observed(key, pod.metadata.uid)
+            self.enqueue(key)
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        key = self._job_key_of(pod)
+        if key is not None:
+            self.enqueue(key)
+
+    # ------------------------------------------------------------- sync
+
+    def _finished(self, job: Job) -> bool:
+        return any(c.type in ("Complete", "Failed") and c.status == "True"
+                   for c in job.status.conditions)
+
+    def sync(self, key: str) -> None:
+        job = self.job_informer.indexer.get_by_key(key)
+        if job is None or job.metadata.deletion_timestamp is not None:
+            self.expectations.delete(key)
+            return
+        pods = [p for p in self.pod_informer.indexer.list(
+                    job.metadata.namespace)
+                if self._job_key_of(p) == key]
+        active = [p for p in pods if pod_is_active(p)
+                  and p.status.phase not in ("Succeeded", "Failed")]
+        succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
+        failed = sum(1 for p in pods if p.status.phase == "Failed")
+        if self._finished(job):
+            self._update_status(job, len(active), succeeded, failed, None)
+            return
+        completions = job.spec.completions
+        parallelism = job.spec.parallelism \
+            if job.spec.parallelism is not None else 1
+        # nil completions = work-queue semantics (ref: syncJob): any success
+        # completes the job once running pods drain; no new pods after the
+        # first success
+        if completions is None:
+            done = succeeded > 0 and not active
+            want = parallelism if succeeded == 0 else len(active)
+        else:
+            done = succeeded >= completions
+            want = min(parallelism, completions - succeeded)
+        condition = None
+        if failed > job.spec.backoff_limit:
+            condition = JobCondition(
+                type="Failed", status="True", reason="BackoffLimitExceeded",
+                message="Job has reached the specified backoff limit",
+                last_transition_time=now_iso())
+            for p in active:
+                try:
+                    self.client.pods(p.metadata.namespace).delete(
+                        p.metadata.name)
+                except Exception:
+                    pass
+        elif done:
+            condition = JobCondition(
+                type="Complete", status="True",
+                last_transition_time=now_iso())
+        elif self.expectations.satisfied(key):
+            diff = want - len(active)
+            if diff > 0:
+                self.expectations.expect_creations(key, diff)
+                created = 0
+                for _ in range(diff):
+                    try:
+                        self._create_pod(job)
+                        created += 1
+                    except Exception:
+                        break
+                for _ in range(diff - created):
+                    self.expectations.creation_observed(key)
+            elif diff < 0:
+                victims = active[:(-diff)]
+                self.expectations.expect_deletions(
+                    key, [p.metadata.uid for p in victims])
+                for p in victims:
+                    try:
+                        self.client.pods(p.metadata.namespace).delete(
+                            p.metadata.name)
+                    except Exception:
+                        self.expectations.deletion_observed(
+                            key, p.metadata.uid)
+        self._update_status(job, len(active), succeeded, failed, condition)
+
+    def _create_pod(self, job: Job) -> None:
+        tmpl = job.spec.template
+        labels = dict(tmpl.metadata.labels)
+        labels.setdefault("job-name", job.metadata.name)
+        spec = serde.deepcopy_obj(tmpl.spec)
+        if not spec.restart_policy or spec.restart_policy == "Always":
+            spec.restart_policy = "Never"
+        self.client.pods(job.metadata.namespace).create(Pod(
+            metadata=ObjectMeta(
+                generate_name=f"{job.metadata.name}-",
+                namespace=job.metadata.namespace, labels=labels,
+                owner_references=[new_controller_ref(
+                    "Job", job.api_version, job.metadata)]),
+            spec=spec))
+
+    def _update_status(self, job: Job, active: int, succeeded: int,
+                       failed: int, condition) -> None:
+        st = job.status
+        if (st.active == active and st.succeeded == succeeded
+                and st.failed == failed and condition is None):
+            return
+        def mutate(cur):
+            cur.status.active = active
+            cur.status.succeeded = succeeded
+            cur.status.failed = failed
+            if cur.status.start_time is None:
+                cur.status.start_time = now_iso()
+            if condition is not None and not any(
+                    c.type == condition.type for c in cur.status.conditions):
+                cur.status.conditions.append(condition)
+                if condition.type == "Complete":
+                    cur.status.completion_time = now_iso()
+            return cur
+        try:
+            self.client.jobs(job.metadata.namespace).patch(
+                job.metadata.name, mutate)
+        except Exception:
+            pass

@@ -12,9 +12,14 @@ from typing import List, Optional
 
 from ..state.informer import SharedInformerFactory
 from .deployment import DeploymentController
+from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
+from .job import JobController
+from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .volume import PersistentVolumeBinder
 
 
 class ControllerManager:
@@ -22,19 +27,31 @@ class ControllerManager:
                  informers: Optional[SharedInformerFactory] = None,
                  node_monitor_period: float = 5.0,
                  node_grace_period: float = 40.0,
-                 pod_eviction_timeout: float = 300.0):
+                 pod_eviction_timeout: float = 300.0,
+                 terminated_pod_gc_threshold: int = 12500,
+                 podgc_period: float = 20.0):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
         self.replicaset = ReplicaSetController(client, self.informers)
         self.deployment = DeploymentController(client, self.informers)
+        self.job = JobController(client, self.informers)
+        self.endpoints = EndpointsController(client, self.informers)
+        self.namespace = NamespaceController(client, self.informers)
+        self.pv_binder = PersistentVolumeBinder(client, self.informers)
         self.nodelifecycle = NodeLifecycleController(
             client, self.informers,
             monitor_period=node_monitor_period,
             grace_period=node_grace_period,
             eviction_timeout=pod_eviction_timeout)
         self.garbagecollector = GarbageCollector(client, self.informers)
-        self.controllers: List = [self.replicaset, self.deployment,
-                                  self.nodelifecycle, self.garbagecollector]
+        self.podgc = PodGCController(
+            client, self.informers,
+            terminated_threshold=terminated_pod_gc_threshold,
+            period=podgc_period)
+        self.controllers: List = [
+            self.replicaset, self.deployment, self.job, self.endpoints,
+            self.namespace, self.pv_binder, self.nodelifecycle,
+            self.garbagecollector, self.podgc]
 
     def start(self) -> None:
         self.informers.start()

@@ -1,0 +1,78 @@
+"""Namespace lifecycle controller.
+
+Ref: pkg/controller/namespace (namespace_controller.go + deletion/):
+a namespace deleted with the `kubernetes` finalizer enters Terminating,
+its contents are deleted group by group, and only then is the finalizer
+removed so the store completes the deletion.
+"""
+
+from __future__ import annotations
+
+from typing import List, Type
+
+from ..api.apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
+from ..api.batch import CronJob, Job
+from ..api.core import (Endpoints, Event, Namespace,
+                        PersistentVolumeClaim, Pod, ReplicationController,
+                        Service)
+from ..api.policy import PodDisruptionBudget
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+#: namespaced kinds emptied before finalization (ref: the discovery-driven
+#: group deletion in deletion/namespaced_resources_deleter.go)
+NAMESPACED_KINDS: List[Type] = [
+    Deployment, StatefulSet, DaemonSet, CronJob, Job, ReplicaSet,
+    ReplicationController, Pod, Service, Endpoints, PersistentVolumeClaim,
+    PodDisruptionBudget, Event]
+
+
+class NamespaceController(Controller):
+    name = "namespace"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.informer = informers.informer_for(Namespace)
+        self.informer.add_event_handlers(EventHandlers(
+            on_add=lambda n: self.enqueue(n.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key())))
+
+    def sync(self, key: str) -> None:
+        ns = self.informer.indexer.get_by_key(key)
+        if ns is None or ns.metadata.deletion_timestamp is None:
+            return
+        name = ns.metadata.name
+        if ns.status.phase != "Terminating":
+            def terminating(cur):
+                cur.status.phase = "Terminating"
+                return cur
+            try:
+                self.client.namespaces().patch(name, terminating)
+            except Exception:
+                pass
+        remaining = 0
+        for cls in NAMESPACED_KINDS:
+            rc = self.client.resource(cls, name)
+            for obj in rc.list(namespace=name):
+                remaining += 1
+                if obj.metadata.deletion_timestamp is None:
+                    try:
+                        rc.delete(obj.metadata.name, namespace=name)
+                    except Exception:
+                        pass
+        if remaining:
+            self.enqueue_after(key, 0.2)  # re-check until drained
+            return
+        # contents gone: drop the finalizer; the store completes deletion
+        def finalize(cur):
+            cur.spec.finalizers = [f for f in cur.spec.finalizers
+                                   if f != "kubernetes"]
+            cur.metadata.finalizers = [f for f in cur.metadata.finalizers
+                                       if f != "kubernetes"]
+            return cur
+        try:
+            self.client.namespaces().patch(name, finalize)
+        except Exception:
+            pass

@@ -1,0 +1,117 @@
+"""Pod GC + TTL-after-finished.
+
+Ref: pkg/controller/podgc/gc_controller.go (terminated-pod threshold,
+orphaned pods on deleted nodes) and pkg/controller/ttlafterfinished
+(finished Jobs removed ttlSecondsAfterFinished after completion).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Optional
+
+from ..api.batch import Job
+from ..api.core import Node, Pod
+from ..state.informer import SharedInformerFactory
+from ..utils.clock import Clock, REAL_CLOCK, parse_iso
+
+DEFAULT_TERMINATED_THRESHOLD = 12500  # --terminated-pod-gc-threshold
+
+
+class PodGCController:
+    """Periodic sweeps (the reference runs gc() every 20s)."""
+
+    name = "podgc"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 terminated_threshold: int = DEFAULT_TERMINATED_THRESHOLD,
+                 period: float = 20.0, clock: Clock = REAL_CLOCK):
+        self.client = client
+        self.clock = clock
+        self.terminated_threshold = terminated_threshold
+        self.period = period
+        self.pod_informer = informers.informer_for(Pod)
+        self.node_informer = informers.informer_for(Node)
+        self.job_informer = informers.informer_for(Job)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.gc_once()
+            except Exception:
+                traceback.print_exc()
+
+    # ------------------------------------------------------------- sweeps
+
+    def gc_once(self) -> int:
+        n = self._gc_terminated()
+        n += self._gc_orphaned()
+        n += self._gc_finished_jobs()
+        return n
+
+    def _delete_pod(self, pod: Pod) -> bool:
+        try:
+            self.client.pods(pod.metadata.namespace).delete(
+                pod.metadata.name)
+            return True
+        except Exception:
+            return False
+
+    def _gc_terminated(self) -> int:
+        """Oldest terminated pods beyond the threshold go (gcTerminated)."""
+        terminated = [p for p in self.pod_informer.indexer.list()
+                      if p.status.phase in ("Succeeded", "Failed")]
+        excess = len(terminated) - self.terminated_threshold
+        if excess <= 0:
+            return 0
+        terminated.sort(key=lambda p: p.metadata.creation_timestamp or "")
+        return sum(1 for p in terminated[:excess] if self._delete_pod(p))
+
+    def _gc_orphaned(self) -> int:
+        """Pods bound to nodes that no longer exist (gcOrphaned)."""
+        live = {n.metadata.name for n in self.node_informer.indexer.list()}
+        n = 0
+        for p in self.pod_informer.indexer.list():
+            if p.spec.node_name and p.spec.node_name not in live:
+                if self._delete_pod(p):
+                    n += 1
+        return n
+
+    def _gc_finished_jobs(self) -> int:
+        """ttlSecondsAfterFinished (pkg/controller/ttlafterfinished):
+        delete finished Jobs past their TTL; owner cascade removes pods."""
+        n = 0
+        now = self.clock.now()
+        for job in self.job_informer.indexer.list():
+            ttl = job.spec.ttl_seconds_after_finished
+            if ttl is None:
+                continue
+            done = next((c for c in job.status.conditions
+                         if c.type in ("Complete", "Failed")
+                         and c.status == "True"), None)
+            if done is None:
+                continue
+            finished_at = parse_iso(job.status.completion_time or
+                                    done.last_transition_time or "")
+            if finished_at is None or now - finished_at < ttl:
+                continue
+            try:
+                self.client.jobs(job.metadata.namespace).delete(
+                    job.metadata.name)
+                n += 1
+            except Exception:
+                pass
+        return n

@@ -1,0 +1,165 @@
+"""PersistentVolume binder controller — Immediate binding + reclaim.
+
+Ref: pkg/controller/volume/persistentvolume (pv_controller.go:
+syncUnboundClaim, syncVolume, findBestMatchForClaim): claims whose
+StorageClass binds immediately are matched to the smallest satisfying
+Available PV at claim time (WaitForFirstConsumer claims wait for the
+scheduler's volume binder); released volumes are reclaimed per policy.
+"""
+
+from __future__ import annotations
+
+from ..api.core import PersistentVolume, PersistentVolumeClaim
+from ..api.policy import StorageClass
+from ..api.wellknown import RESOURCE_STORAGE
+from ..scheduler.volumebinder import _pv_matches_claim
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+
+class PersistentVolumeBinder(Controller):
+    name = "persistentvolume-binder"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pvc_informer = informers.informer_for(PersistentVolumeClaim)
+        self.pv_informer = informers.informer_for(PersistentVolume)
+        self.sc_informer = informers.informer_for(StorageClass)
+        self.pvc_informer.add_event_handlers(EventHandlers(
+            on_add=lambda c: self.enqueue("pvc/" + c.metadata.key()),
+            on_update=lambda o, n: self.enqueue("pvc/" + n.metadata.key()),
+            on_delete=self._on_pvc_delete))
+        self.pv_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pv_event,
+            on_update=lambda o, n: self._on_pv_event(n),
+            on_delete=self._on_pv_event))
+
+    def _on_pvc_delete(self, pvc: PersistentVolumeClaim) -> None:
+        # the bound volume must be released (reclaim path)
+        if pvc.spec.volume_name:
+            self.enqueue("pv/" + pvc.spec.volume_name)
+        else:
+            # a delete may race the bind; sweep PVs claiming this pvc
+            for pv in self.pv_informer.indexer.list():
+                ref = pv.spec.claim_ref
+                if ref and ref.get("uid") == pvc.metadata.uid:
+                    self.enqueue("pv/" + pv.metadata.name)
+
+    def _on_pv_event(self, pv: PersistentVolume) -> None:
+        self.enqueue("pv/" + pv.metadata.name)
+        # a newly Available PV may satisfy pending claims
+        for pvc in self.pvc_informer.indexer.list():
+            if not pvc.spec.volume_name:
+                self.enqueue("pvc/" + pvc.metadata.key())
+
+    def _binds_immediately(self, pvc: PersistentVolumeClaim) -> bool:
+        sc_name = pvc.spec.storage_class_name
+        if not sc_name:
+            return True  # classless claims bind immediately
+        sc = self.sc_informer.indexer.get_by_key(sc_name)
+        mode = getattr(sc, "volume_binding_mode", None) if sc else None
+        return mode != "WaitForFirstConsumer"
+
+    def sync(self, key: str) -> None:
+        kind, _, rest = key.partition("/")
+        if kind == "pvc":
+            self._sync_claim(rest)
+        else:
+            self._sync_volume(rest)
+
+    def _sync_claim(self, key: str) -> None:
+        pvc = self.pvc_informer.indexer.get_by_key(key)
+        if pvc is None or pvc.metadata.deletion_timestamp is not None:
+            return
+        if pvc.spec.volume_name:
+            # pre-bound claim (user set spec.volumeName): complete the bind
+            # so the PV can't be stolen by another claim (ref:
+            # syncUnboundClaim's claim.Spec.VolumeName != "" arm)
+            if pvc.status.phase != "Bound":
+                best = self.pv_informer.indexer.get_by_key(
+                    pvc.spec.volume_name)
+                if best is not None and (
+                        best.spec.claim_ref is None or
+                        best.spec.claim_ref.get("uid") == pvc.metadata.uid):
+                    self._bind(pvc, best)
+            return
+        if not self._binds_immediately(pvc):
+            return  # the scheduler's volume binder owns delayed binding
+        # smallest satisfying Available PV (findBestMatchForClaim)
+        candidates = [pv for pv in self.pv_informer.indexer.list()
+                      if _pv_matches_claim(pv, pvc, None)]
+        if not candidates:
+            return
+
+        def size(pv):
+            q = pv.spec.capacity.get(RESOURCE_STORAGE)
+            return q.value() if q is not None else 0
+        best = min(candidates, key=size)
+        self._bind(pvc, best)
+
+    def _bind(self, pvc: PersistentVolumeClaim,
+              best: PersistentVolume) -> None:
+
+        def claim_pv(cur):
+            if cur.spec.claim_ref is not None and \
+                    cur.spec.claim_ref.get("uid") != pvc.metadata.uid:
+                from ..state.store import ConflictError
+                raise ConflictError("volume already claimed")
+            cur.spec.claim_ref = {
+                "kind": "PersistentVolumeClaim",
+                "namespace": pvc.metadata.namespace,
+                "name": pvc.metadata.name, "uid": pvc.metadata.uid}
+            cur.status.phase = "Bound"
+            return cur
+        try:
+            self.client.persistent_volumes().patch(best.metadata.name,
+                                                   claim_pv)
+        except Exception:
+            self.enqueue_after("pvc/" + pvc.metadata.key(), 0.2)
+            return
+
+        def bind_claim(cur):
+            cur.spec.volume_name = best.metadata.name
+            cur.status.phase = "Bound"
+            return cur
+        try:
+            self.client.persistent_volume_claims(
+                pvc.metadata.namespace).patch(pvc.metadata.name, bind_claim)
+        except Exception:
+            # claim vanished: release the volume
+            def release(cur):
+                cur.spec.claim_ref = None
+                cur.status.phase = "Available"
+                return cur
+            try:
+                self.client.persistent_volumes().patch(
+                    best.metadata.name, release)
+            except Exception:
+                pass
+
+    def _sync_volume(self, name: str) -> None:
+        """Reclaim: a bound PV whose claim is gone becomes Released, then
+        Available (Retain keeps data; Delete would deprovision)."""
+        from ..state.store import NotFoundError
+        pv = self.pv_informer.indexer.get_by_key(name)
+        if pv is None or pv.spec.claim_ref is None:
+            return
+        ref = pv.spec.claim_ref
+        try:
+            cur = self.client.persistent_volume_claims(
+                ref.get("namespace", "")).get(ref.get("name", ""))
+            if cur.metadata.uid == ref.get("uid"):
+                return  # claim alive
+        except NotFoundError:
+            pass
+
+        def release(cur):
+            cur.spec.claim_ref = None
+            cur.status.phase = "Available"
+            return cur
+        try:
+            self.client.persistent_volumes().patch(name, release)
+        except Exception:
+            pass

@@ -1,0 +1,22 @@
+"""Node runtime (L6) — the kubelet analog.
+
+Ref: pkg/kubelet (syncLoop :1802, syncPod :1462, podWorkers, PLEG,
+statusManager, nodestatus setters, nodelease) and pkg/kubemark (hollow
+nodes). The agent watches for pods bound to its node, drives them through
+a CRI-shaped runtime boundary, reports pod status and node heartbeats,
+and renews its node lease. The runtime is an interface exactly because
+the reference's is (CRI gRPC): the in-process FakeRuntime is the
+kubemark/hollow-node configuration, which is also what control-plane
+scale testing uses.
+
+    NodeAgent     agent.py    — register, heartbeat, pod sync loop
+    CRI shapes    runtime.py  — ContainerRuntime interface + FakeRuntime
+    HollowCluster hollow.py   — N hollow nodes in-process (pkg/kubemark)
+"""
+
+from .agent import NodeAgent
+from .hollow import HollowCluster
+from .runtime import ContainerRuntime, FakeRuntime, PodSandbox
+
+__all__ = ["ContainerRuntime", "FakeRuntime", "HollowCluster", "NodeAgent",
+           "PodSandbox"]

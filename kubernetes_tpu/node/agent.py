@@ -1,0 +1,267 @@
+"""NodeAgent — the kubelet's control-plane-facing core.
+
+Ref: pkg/kubelet/kubelet.go (Run :1379, syncLoop :1802, syncPod :1462),
+pod_workers.go (per-pod serialized sync), pleg/generic.go:188 (relist),
+status manager (status/), nodestatus setters + heartbeats, and
+pkg/kubelet/nodelease. The container-facing half lives behind the
+ContainerRuntime boundary (runtime.py, the CRI analog).
+
+The sync loop here is the reference's shape with the channels collapsed
+onto a workqueue: informer events for this node's pods enqueue keys, a
+worker drains them through sync_pod (desired vs runtime state), and a
+periodic PLEG-style relist surfaces container lifecycle changes (exits)
+back into pod status writes.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, Optional
+
+from ..api import helpers
+from ..api.core import (ContainerStatus, Node, NodeCondition, Pod,
+                        PodCondition)
+from ..api.meta import ObjectMeta
+from ..api.quantity import Quantity
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.workqueue import RateLimitingQueue
+from ..utils.clock import now_iso
+from .runtime import ContainerRuntime, FakeRuntime
+
+DEFAULT_CAPACITY = {"cpu": "4", "memory": "32Gi", "pods": "110"}
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+class NodeAgent:
+    def __init__(self, client, node_name: str,
+                 informers: SharedInformerFactory,
+                 capacity: Optional[Dict[str, str]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 runtime: Optional[ContainerRuntime] = None,
+                 heartbeat_period: float = 10.0,
+                 pleg_period: float = 1.0):
+        self.client = client
+        self.node_name = node_name
+        self.capacity = dict(capacity or DEFAULT_CAPACITY)
+        self.labels = dict(labels or {})
+        self.runtime = runtime or FakeRuntime()
+        self.heartbeat_period = heartbeat_period
+        self.pleg_period = pleg_period
+        self.queue = RateLimitingQueue()
+        self.pod_informer = informers.informer_for(Pod)
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_event,
+            on_update=lambda old, new: self._on_pod_event(new),
+            on_delete=self._on_pod_event))
+        self._stop = threading.Event()
+        self._threads = []
+        #: pod uid -> last written (phase, ready) to suppress no-op writes
+        self._reported: Dict[str, tuple] = {}
+
+    def _on_pod_event(self, pod: Pod) -> None:
+        if pod.spec.node_name == self.node_name:
+            self.queue.add(pod.metadata.key())
+
+    # ----------------------------------------------------------- register
+
+    def register(self) -> None:
+        """Create (or reclaim) the Node object (ref: kubelet registerWithAPIServer
+        + nodestatus setters) and its lease."""
+        caps = {k: Quantity(v) for k, v in self.capacity.items()}
+        node = Node(
+            metadata=ObjectMeta(name=self.node_name, labels={
+                "kubernetes.io/hostname": self.node_name, **self.labels}))
+        node.status.capacity = dict(caps)
+        node.status.allocatable = dict(caps)
+        node.status.conditions = [NodeCondition(
+            type="Ready", status="True", reason="KubeletReady",
+            last_heartbeat_time=now_iso())]
+        from ..state.store import AlreadyExistsError
+        try:
+            self.client.nodes().create(node)
+        except AlreadyExistsError:
+            def reclaim(cur):
+                cur.status.capacity = dict(caps)
+                cur.status.allocatable = dict(caps)
+                cur.status.conditions = node.status.conditions
+                return cur
+            self.client.nodes().patch(self.node_name, reclaim)
+        self._renew_lease()
+
+    def _renew_lease(self) -> None:
+        """Ref: pkg/kubelet/nodelease — a Lease in kube-node-lease renewed
+        each heartbeat."""
+        from ..api.policy import Lease, LeaseSpec
+        from ..state.store import NotFoundError
+        try:
+            def renew(cur):
+                cur.spec.holder_identity = self.node_name
+                cur.spec.renew_time = now_iso()
+                return cur
+            self.client.leases(LEASE_NAMESPACE).patch(self.node_name, renew)
+        except NotFoundError:
+            try:
+                self.client.leases(LEASE_NAMESPACE).create(Lease(
+                    metadata=ObjectMeta(name=self.node_name,
+                                        namespace=LEASE_NAMESPACE),
+                    spec=LeaseSpec(holder_identity=self.node_name,
+                                   lease_duration_seconds=40,
+                                   renew_time=now_iso())))
+            except Exception:
+                pass
+        except Exception:
+            pass
+
+    def heartbeat(self) -> None:
+        """Refresh the Ready condition's heartbeat (monitorNodeHealth's
+        staleness input) + the node lease."""
+        def beat(cur):
+            for cond in cur.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = "True"
+                    cond.reason = "KubeletReady"
+                    cond.last_heartbeat_time = now_iso()
+                    return cur
+            cur.status.conditions.append(NodeCondition(
+                type="Ready", status="True", reason="KubeletReady",
+                last_heartbeat_time=now_iso()))
+            return cur
+        try:
+            self.client.nodes().patch(self.node_name, beat)
+        except Exception:
+            pass
+        self._renew_lease()
+
+    # ---------------------------------------------------------- pod sync
+
+    def sync_pod(self, key: str) -> None:
+        """Ref: syncPod :1462 / kuberuntime SyncPod :609 — compute actions
+        from desired (API) vs actual (runtime) state."""
+        pod = self.pod_informer.indexer.get_by_key(key)
+        if pod is None or pod.spec.node_name != self.node_name or \
+                pod.metadata.deletion_timestamp is not None:
+            # deleted or rescheduled away: tear down
+            uid = self._uid_for(key, pod)
+            if uid is not None:
+                self.runtime.stop_pod_sandbox(uid)
+                self._reported.pop(uid, None)
+            return
+        if helpers.pod_is_terminal(pod):
+            self.runtime.stop_pod_sandbox(pod.metadata.uid)
+            self._reported.pop(pod.metadata.uid, None)
+            return
+        sb = self.runtime.pod_sandbox(pod.metadata.uid)
+        if sb is None:
+            sb = self.runtime.run_pod_sandbox(pod)
+            self.runtime.start_containers(sb, pod)
+            self._write_status(pod, "Running", ready=True)
+
+    def _uid_for(self, key: str, pod: Optional[Pod]) -> Optional[str]:
+        if pod is not None:
+            return pod.metadata.uid
+        for sb in self.runtime.list_sandboxes():
+            if f"{sb.namespace}/{sb.name}" == key:
+                return sb.pod_uid
+        return None
+
+    def pleg_relist(self) -> None:
+        """Ref: pleg/generic.go:188 — diff runtime container states and
+        surface exits as pod status (the Job completion path)."""
+        if hasattr(self.runtime, "tick"):
+            self.runtime.tick()
+        for sb in self.runtime.list_sandboxes():
+            if not sb.containers:
+                continue
+            if all(c.state == "exited" for c in sb.containers.values()):
+                pod = self.pod_informer.indexer.get_by_key(
+                    f"{sb.namespace}/{sb.name}")
+                if pod is None or pod.metadata.uid != sb.pod_uid:
+                    self.runtime.stop_pod_sandbox(sb.pod_uid)
+                    continue
+                failed = any((c.exit_code or 0) != 0
+                             for c in sb.containers.values())
+                phase = "Failed" if failed else "Succeeded"
+                self._write_status(pod, phase, ready=False)
+                self.runtime.stop_pod_sandbox(sb.pod_uid)
+                # terminal pods never report again; drop the suppressor
+                # entry or a kubemark churn run leaks one per pod uid
+                self._reported.pop(sb.pod_uid, None)
+
+    def _write_status(self, pod: Pod, phase: str, ready: bool) -> None:
+        uid = pod.metadata.uid
+        if self._reported.get(uid) == (phase, ready):
+            return
+        def mutate(cur):
+            cur.status.phase = phase
+            cur.status.host_ip = f"10.0.0.{hash(self.node_name) % 250 + 1}"
+            if cur.status.start_time is None:
+                cur.status.start_time = now_iso()
+            cur.status.container_statuses = [
+                ContainerStatus(name=c.name, ready=ready,
+                                restart_count=0, image=c.image)
+                for c in cur.spec.containers]
+            status = "True" if ready else "False"
+            for cond in cur.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = status
+                    break
+            else:
+                cur.status.conditions.append(PodCondition(
+                    type="Ready", status=status))
+            return cur
+        try:
+            self.client.pods(pod.metadata.namespace).patch(
+                pod.metadata.name, mutate)
+            self._reported[uid] = (phase, ready)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- run
+
+    def start(self) -> None:
+        self.register()
+        for pod in self.pod_informer.indexer.by_index("nodeName",
+                                                      self.node_name):
+            self.queue.add(pod.metadata.key())
+        for suffix, target in (("sync", self._sync_worker),
+                               ("heartbeat", self._heartbeat_loop),
+                               ("pleg", self._pleg_loop)):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"kubelet-{self.node_name}-{suffix}")
+            t.start()
+            self._threads.append(t)
+
+    def _sync_worker(self) -> None:
+        while True:
+            key, shutdown = self.queue.get()
+            if shutdown:
+                return
+            if key is None:
+                continue
+            try:
+                self.sync_pod(key)
+            except Exception:
+                traceback.print_exc()
+                self.queue.add_rate_limited(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_period):
+            self.heartbeat()
+
+    def _pleg_loop(self) -> None:
+        while not self._stop.wait(self.pleg_period):
+            try:
+                self.pleg_relist()
+            except Exception:
+                traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2)

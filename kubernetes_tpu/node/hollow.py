@@ -1,0 +1,55 @@
+"""Hollow nodes — the kubemark analog.
+
+Ref: pkg/kubemark/hollow_kubelet.go:44 + test/kubemark: REAL kubelet code
+wired to a fake CRI, many instances hosted in one process, so control-
+plane components are scale-tested against thousands of registered,
+heartbeating nodes without machines. Here: N NodeAgents sharing one
+informer factory (one watch stream per resource, not per node) with
+FakeRuntimes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..state.informer import SharedInformerFactory
+from .agent import NodeAgent
+from .runtime import FakeRuntime
+
+
+class HollowCluster:
+    def __init__(self, client, n_nodes: int,
+                 capacity: Optional[Dict[str, str]] = None,
+                 name_prefix: str = "hollow-node-",
+                 heartbeat_period: float = 10.0,
+                 pleg_period: float = 1.0,
+                 run_duration: Optional[float] = None):
+        self.client = client
+        self.informers = SharedInformerFactory(client)
+        self.agents: List[NodeAgent] = []
+        for i in range(n_nodes):
+            self.agents.append(NodeAgent(
+                client, f"{name_prefix}{i}", self.informers,
+                capacity=capacity,
+                labels={"kubernetes.io/role": "hollow"},
+                runtime=FakeRuntime(run_duration=run_duration),
+                heartbeat_period=heartbeat_period,
+                pleg_period=pleg_period))
+
+    def start(self) -> "HollowCluster":
+        self.informers.start()
+        self.informers.wait_for_cache_sync()
+        for a in self.agents:
+            a.start()
+        return self
+
+    def stop(self) -> None:
+        for a in self.agents:
+            a.stop()
+        self.informers.stop()
+
+    def agent(self, node_name: str) -> Optional[NodeAgent]:
+        for a in self.agents:
+            if a.node_name == node_name:
+                return a
+        return None

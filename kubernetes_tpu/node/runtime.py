@@ -1,0 +1,125 @@
+"""Container runtime boundary — the CRI analog.
+
+Ref: the CRI gRPC surface (staging/src/k8s.io/cri-api api.proto: 27 rpcs —
+RunPodSandbox, CreateContainer, StartContainer, StopPodSandbox, ...),
+consumed by pkg/kubelet/kuberuntime SyncPod :609 through
+pkg/kubelet/remote. Reduced to the pod-granular calls the sync loop
+needs; a real runtime would sit across a process boundary exactly like
+containerd does.
+
+FakeRuntime is pkg/kubelet/container/testing's FakeRuntime crossed with
+kubemark's hollow configuration: containers "start" after a configurable
+latency and "run" until stopped (or exit on their own for run_to_completion
+workloads, the Job path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.core import Pod
+
+
+@dataclass
+class ContainerStatusInfo:
+    name: str
+    state: str = "created"      # created | running | exited
+    exit_code: Optional[int] = None
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+
+@dataclass
+class PodSandbox:
+    """One pod's runtime-side state (sandbox + containers)."""
+    pod_uid: str
+    namespace: str
+    name: str
+    state: str = "ready"        # ready | notready
+    containers: Dict[str, ContainerStatusInfo] = field(default_factory=dict)
+
+
+class ContainerRuntime:
+    """The boundary interface (CRI shape)."""
+
+    def run_pod_sandbox(self, pod: Pod) -> PodSandbox:  # pragma: no cover
+        raise NotImplementedError
+
+    def start_containers(self, sandbox: PodSandbox,
+                         pod: Pod) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def stop_pod_sandbox(self, pod_uid: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def pod_sandbox(self, pod_uid: str) -> Optional[PodSandbox]:
+        raise NotImplementedError  # pragma: no cover
+
+    def list_sandboxes(self) -> List[PodSandbox]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FakeRuntime(ContainerRuntime):
+    """Hollow runtime: containers become running after `start_latency`;
+    run_to_completion pods exit 0 after `run_duration`."""
+
+    def __init__(self, start_latency: float = 0.0,
+                 run_duration: Optional[float] = None):
+        self.start_latency = start_latency
+        #: None = run forever (the Deployment path); a duration makes every
+        #: container exit 0 after it (the Job path)
+        self.run_duration = run_duration
+        self._lock = threading.Lock()
+        self._sandboxes: Dict[str, PodSandbox] = {}
+        self.started_count = 0
+        self.stopped_count = 0
+
+    def run_pod_sandbox(self, pod: Pod) -> PodSandbox:
+        sb = PodSandbox(pod_uid=pod.metadata.uid,
+                        namespace=pod.metadata.namespace,
+                        name=pod.metadata.name)
+        with self._lock:
+            self._sandboxes[pod.metadata.uid] = sb
+        return sb
+
+    def start_containers(self, sandbox: PodSandbox, pod: Pod) -> None:
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        now = time.time()
+        with self._lock:
+            for c in pod.spec.containers:
+                sandbox.containers[c.name] = ContainerStatusInfo(
+                    name=c.name, state="running", started_at=now)
+            self.started_count += 1
+
+    def tick(self) -> None:
+        """Advance fake container lifecycles (the PLEG relist analog calls
+        this): run_to_completion containers exit once their time is up."""
+        if self.run_duration is None:
+            return
+        now = time.time()
+        with self._lock:
+            for sb in self._sandboxes.values():
+                for cs in sb.containers.values():
+                    if cs.state == "running" and \
+                            now - (cs.started_at or now) >= self.run_duration:
+                        cs.state = "exited"
+                        cs.exit_code = 0
+                        cs.finished_at = now
+
+    def stop_pod_sandbox(self, pod_uid: str) -> None:
+        with self._lock:
+            sb = self._sandboxes.pop(pod_uid, None)
+            if sb is not None:
+                self.stopped_count += 1
+
+    def pod_sandbox(self, pod_uid: str) -> Optional[PodSandbox]:
+        with self._lock:
+            return self._sandboxes.get(pod_uid)
+
+    def list_sandboxes(self) -> List[PodSandbox]:
+        with self._lock:
+            return list(self._sandboxes.values())

@@ -41,14 +41,19 @@ REAL_CLOCK = Clock()
 
 
 def now_iso(clock: Clock = REAL_CLOCK) -> str:
+    """RFC3339 with microseconds (the reference's MicroTime precision —
+    plain second granularity makes sub-second grace periods flap)."""
     return datetime.fromtimestamp(clock.now(), tz=timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%SZ")
+        "%Y-%m-%dT%H:%M:%S.%fZ")
 
 
 def parse_iso(ts: str):
-    """RFC3339 -> unix seconds, or None on malformed input."""
-    try:
-        return datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ") \
-            .replace(tzinfo=timezone.utc).timestamp()
-    except (ValueError, TypeError):
-        return None
+    """RFC3339 (with or without fractional seconds) -> unix seconds, or
+    None on malformed input."""
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.strptime(ts, fmt) \
+                .replace(tzinfo=timezone.utc).timestamp()
+        except (ValueError, TypeError):
+            continue
+    return None
