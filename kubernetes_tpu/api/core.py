@@ -192,6 +192,8 @@ class PodSpec:
     host_network: Optional[bool] = None
     service_account_name: str = ""
     overhead: Dict[str, Quantity] = field(default_factory=dict)
+    hostname: str = ""     # stable identity (StatefulSet pods)
+    subdomain: str = ""    # headless service domain
 
 
 @dataclass

@@ -18,8 +18,38 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kube-apiserver")
     p.add_argument("--bind-address", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--data-dir", default=None,
+                   help="enable WAL persistence (replayed on restart)")
+    p.add_argument("--wal-sync", action="store_true",
+                   help="fdatasync each transaction")
+    p.add_argument("--wal-compact-bytes", type=int, default=64 << 20,
+                   help="compact the WAL when it exceeds this size")
     args = p.parse_args(argv)
-    srv = APIServer(host=args.bind_address, port=args.port).start()
+    store = None
+    wal_file = None
+    if args.data_dir:
+        import os
+
+        from ..state.store import Store
+        os.makedirs(args.data_dir, exist_ok=True)
+        wal_file = os.path.join(args.data_dir, "store.wal")
+        store = Store(wal_path=wal_file, wal_sync=args.wal_sync)
+    srv = APIServer(store=store, host=args.bind_address,
+                    port=args.port).start()
+    compactor = None
+    if store is not None:
+        import os
+
+        def compact_loop():
+            # size-triggered compaction bounds replay time by live objects,
+            # not total write history (the etcd snapshot analog)
+            while not stop.wait(30.0):
+                try:
+                    if os.path.getsize(wal_file) > args.wal_compact_bytes:
+                        store.compact()
+                except Exception:
+                    pass
+        compactor = threading.Thread(target=compact_loop, daemon=True)
     print(f"serving on {srv.address}", flush=True)
     stop = threading.Event()
 
@@ -27,8 +57,12 @@ def main(argv=None) -> int:
         stop.set()
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
+    if compactor is not None:
+        compactor.start()
     stop.wait()
     srv.stop()
+    if store is not None:
+        store.close()
     return 0
 
 

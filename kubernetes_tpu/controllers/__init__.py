@@ -24,6 +24,8 @@ device round trip has nothing to amortize.
 """
 
 from .base import Controller
+from .cronjob import CronJobController
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
@@ -33,10 +35,12 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
-__all__ = ["Controller", "ControllerManager", "DeploymentController",
+__all__ = ["Controller", "ControllerManager", "CronJobController",
+           "DaemonSetController", "DeploymentController",
            "EndpointsController", "GarbageCollector", "JobController",
            "NamespaceController", "NodeLifecycleController",
            "PersistentVolumeBinder", "PodGCController",
-           "ReplicaSetController"]
+           "ReplicaSetController", "StatefulSetController"]

@@ -1,0 +1,172 @@
+"""CronJob controller.
+
+Ref: pkg/controller/cronjob/cronjob_controller.go (syncOne, getRecentUnmetScheduleTimes):
+a 10s poll evaluates each CronJob's schedule; due schedules spawn Jobs
+(respecting concurrencyPolicy and suspend) and finished Jobs beyond the
+history limits are pruned. The cron expression support covers the
+5-field subset (minute hour dom month dow with *, */n, and lists).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import List, Optional
+
+from ..api import serde
+from ..api.batch import CronJob, Job
+from ..api.meta import ObjectMeta, controller_ref, new_controller_ref
+from ..state.informer import SharedInformerFactory
+from ..utils.clock import Clock, REAL_CLOCK, parse_iso, now_iso
+
+
+def _field_matches(expr: str, value: int, min_value: int = 0) -> bool:
+    for part in expr.split(","):
+        if part == "*":
+            return True
+        if part.startswith("*/"):
+            step = int(part[2:])
+            # steps anchor at the field's range start (cron semantics):
+            # */2 on day-of-month means 1,3,5,... not 2,4,6,...
+            if step and (value - min_value) % step == 0:
+                return True
+        elif "-" in part:
+            lo, hi = part.split("-", 1)
+            if int(lo) <= value <= int(hi):
+                return True
+        elif part and int(part) == value:
+            return True
+    return False
+
+
+def schedule_due(expr: str, ts: float) -> bool:
+    """True when the 5-field cron expression matches the minute of ts."""
+    import datetime
+    dt = datetime.datetime.fromtimestamp(ts, tz=datetime.timezone.utc)
+    fields = expr.split()
+    if len(fields) != 5:
+        return False
+    minute, hour, dom, month, dow = fields
+    return (_field_matches(minute, dt.minute)
+            and _field_matches(hour, dt.hour)
+            and _field_matches(dom, dt.day, min_value=1)
+            and _field_matches(month, dt.month, min_value=1)
+            and _field_matches(dow, dt.weekday() + 1 if dt.weekday() < 6
+                               else 0))
+
+
+class CronJobController:
+    name = "cronjob"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 period: float = 10.0, clock: Clock = REAL_CLOCK):
+        self.client = client
+        self.period = period
+        self.clock = clock
+        self.informer = informers.informer_for(CronJob)
+        self.job_informer = informers.informer_for(Job)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self.sync_all()
+            except Exception:
+                traceback.print_exc()
+
+    # ------------------------------------------------------------- sync
+
+    def _owned_jobs(self, cj: CronJob) -> List[Job]:
+        out = []
+        for job in self.job_informer.indexer.list(cj.metadata.namespace):
+            ref = controller_ref(job.metadata)
+            if ref is not None and ref.uid == cj.metadata.uid:
+                out.append(job)
+        return out
+
+    def _job_finished(self, job: Job) -> bool:
+        return any(c.type in ("Complete", "Failed") and c.status == "True"
+                   for c in job.status.conditions)
+
+    def sync_all(self) -> None:
+        for cj in self.informer.indexer.list():
+            try:
+                self.sync_one(cj)
+            except Exception:
+                traceback.print_exc()
+
+    def sync_one(self, cj: CronJob) -> None:
+        if cj.spec.suspend or cj.metadata.deletion_timestamp is not None:
+            return
+        now = self.clock.now()
+        owned = self._owned_jobs(cj)
+        active = [j for j in owned if not self._job_finished(j)]
+        if schedule_due(cj.spec.schedule, now) and not self._fired_this_minute(cj, now):
+            if active and cj.spec.concurrency_policy == "Forbid":
+                pass
+            else:
+                if active and cj.spec.concurrency_policy == "Replace":
+                    for j in active:
+                        try:
+                            self.client.jobs(j.metadata.namespace).delete(
+                                j.metadata.name)
+                        except Exception:
+                            pass
+                self._spawn_job(cj, now)
+        self._prune_history(cj, owned)
+
+    def _fired_this_minute(self, cj: CronJob, now: float) -> bool:
+        last = parse_iso(cj.status.last_schedule_time or "")
+        return last is not None and int(last // 60) == int(now // 60)
+
+    def _spawn_job(self, cj: CronJob, now: float) -> None:
+        tmpl = cj.spec.job_template or {}
+        job_spec = tmpl.get("spec", {})
+        name = f"{cj.metadata.name}-{int(now // 60)}"
+        data = {"apiVersion": "batch/v1", "kind": "Job",
+                "metadata": {"name": name,
+                             "namespace": cj.metadata.namespace},
+                "spec": job_spec}
+        job = serde.decode(Job, data)
+        job.metadata.owner_references = [new_controller_ref(
+            "CronJob", cj.api_version, cj.metadata)]
+        try:
+            self.client.jobs(cj.metadata.namespace).create(job)
+        except Exception:
+            return
+        def stamp(cur):
+            cur.status.last_schedule_time = now_iso(self.clock)
+            return cur
+        try:
+            self.client.resource(CronJob, cj.metadata.namespace).patch(
+                cj.metadata.name, stamp, namespace=cj.metadata.namespace)
+        except Exception:
+            pass
+
+    def _prune_history(self, cj: CronJob, owned: List[Job]) -> None:
+        done = [j for j in owned if self._job_finished(j)]
+        ok = [j for j in done if any(
+            c.type == "Complete" and c.status == "True"
+            for c in j.status.conditions)]
+        ok_uids = {j.metadata.uid for j in ok}
+        failed = [j for j in done if j.metadata.uid not in ok_uids]
+        for jobs, limit in ((ok, cj.spec.successful_jobs_history_limit),
+                            (failed, cj.spec.failed_jobs_history_limit)):
+            jobs.sort(key=lambda j: j.metadata.creation_timestamp or "")
+            for j in jobs[:max(0, len(jobs) - limit)]:
+                try:
+                    self.client.jobs(j.metadata.namespace).delete(
+                        j.metadata.name)
+                except Exception:
+                    pass

@@ -1,0 +1,152 @@
+"""DaemonSet controller — one pod per eligible node.
+
+Ref: pkg/controller/daemon/daemon_controller.go (2,577 LoC; syncDaemonSet,
+podsShouldBeOnNode): every node whose taints the daemon pod tolerates (and
+whose nodeSelector/affinity it matches) gets exactly one daemon pod, pinned
+via spec.nodeName (this snapshot predates the default-scheduler migration
+for daemons, so the controller binds directly — daemon_controller.go's
+nodeName assignment). Node add/delete reconciles the set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import helpers, serde
+from ..api.apps import DaemonSet
+from ..api.core import Node, Pod
+from ..api.meta import ObjectMeta, controller_ref, new_controller_ref
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+from .replicaset import pod_is_active, pod_is_ready
+
+
+class DaemonSetController(Controller):
+    name = "daemonset"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.informer = informers.informer_for(DaemonSet)
+        self.pod_informer = informers.informer_for(Pod)
+        self.node_informer = informers.informer_for(Node)
+        self.informer.add_event_handlers(EventHandlers(
+            on_add=lambda d: self.enqueue(d.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key()),
+            on_delete=lambda d: self.enqueue(d.metadata.key())))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._enqueue_owner,
+            on_update=lambda o, n: self._enqueue_owner(n),
+            on_delete=self._enqueue_owner))
+        # node churn re-reconciles every daemon set
+        self.node_informer.add_event_handlers(EventHandlers(
+            on_add=lambda n: self._enqueue_all(),
+            on_update=lambda o, n: self._enqueue_all(),
+            on_delete=lambda n: self._enqueue_all()))
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        ref = controller_ref(pod.metadata)
+        if ref is not None and ref.kind == "DaemonSet":
+            self.enqueue(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _enqueue_all(self) -> None:
+        for ds in self.informer.indexer.list():
+            self.enqueue(ds.metadata.key())
+
+    # ------------------------------------------------------------- sync
+
+    def _node_eligible(self, ds: DaemonSet, node: Node) -> bool:
+        """Ref: podsShouldBeOnNode/nodeShouldRunDaemonPod — selector match
+        + taints tolerated (NoSchedule/NoExecute)."""
+        tmpl = ds.spec.template
+        shell = Pod(metadata=ObjectMeta(
+            labels=dict(tmpl.metadata.labels),
+            namespace=ds.metadata.namespace))
+        shell.spec = tmpl.spec
+        if not helpers.pod_matches_node_selector_and_affinity(shell, node):
+            return False
+        return helpers.tolerates_taints(
+            tmpl.spec.tolerations, node.spec.taints,
+            effects=["NoSchedule", "NoExecute"])
+
+    def sync(self, key: str) -> None:
+        ds = self.informer.indexer.get_by_key(key)
+        if ds is None or ds.metadata.deletion_timestamp is not None:
+            return
+        ns = ds.metadata.namespace
+        by_node: Dict[str, List[Pod]] = {}
+        for pod in self.pod_informer.indexer.list(ns):
+            ref = controller_ref(pod.metadata)
+            if ref is not None and ref.uid == ds.metadata.uid \
+                    and pod_is_active(pod):
+                by_node.setdefault(pod.spec.node_name, []).append(pod)
+        nodes = self.node_informer.indexer.list()
+        desired = ready = 0
+        for node in nodes:
+            name = node.metadata.name
+            have = by_node.pop(name, [])
+            if self._node_eligible(ds, node):
+                desired += 1
+                if not have:
+                    self._create_pod(ds, name)
+                else:
+                    for extra in have[1:]:  # duplicates: keep one
+                        self._delete_pod(extra)
+                    if pod_is_ready(have[0]):
+                        ready += 1
+            else:
+                for pod in have:
+                    self._delete_pod(pod)
+        # pods on vanished/unknown nodes
+        for pods in by_node.values():
+            for pod in pods:
+                self._delete_pod(pod)
+        self._update_status(ds, desired, ready)
+
+    def _create_pod(self, ds: DaemonSet, node_name: str) -> None:
+        tmpl = ds.spec.template
+        spec = serde.deepcopy_obj(tmpl.spec)
+        spec.node_name = node_name  # controller-bound, not scheduled
+        try:
+            self.client.pods(ds.metadata.namespace).create(Pod(
+                metadata=ObjectMeta(
+                    generate_name=f"{ds.metadata.name}-",
+                    namespace=ds.metadata.namespace,
+                    labels=dict(tmpl.metadata.labels),
+                    owner_references=[new_controller_ref(
+                        "DaemonSet", ds.api_version, ds.metadata)]),
+                spec=spec))
+        except Exception:
+            pass
+
+    def _delete_pod(self, pod: Pod) -> None:
+        try:
+            self.client.pods(pod.metadata.namespace).delete(
+                pod.metadata.name)
+        except Exception:
+            pass
+
+    def _update_status(self, ds: DaemonSet, desired: int,
+                       ready: int) -> None:
+        st = ds.status
+        scheduled = desired  # created pods are node-pinned immediately
+        if (st.desired_number_scheduled == desired
+                and st.number_ready == ready
+                and st.current_number_scheduled == scheduled
+                and st.observed_generation == ds.metadata.generation):
+            return
+        observed = ds.metadata.generation
+        def mutate(cur):
+            cur.status.desired_number_scheduled = desired
+            cur.status.current_number_scheduled = scheduled
+            cur.status.number_ready = ready
+            cur.status.number_available = ready
+            cur.status.observed_generation = max(
+                cur.status.observed_generation, observed)
+            return cur
+        try:
+            self.client.daemon_sets(ds.metadata.namespace).patch(
+                ds.metadata.name, mutate)
+        except Exception:
+            pass

@@ -11,6 +11,8 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..state.informer import SharedInformerFactory
+from .cronjob import CronJobController
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
@@ -19,6 +21,7 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
 
@@ -29,12 +32,17 @@ class ControllerManager:
                  node_grace_period: float = 40.0,
                  pod_eviction_timeout: float = 300.0,
                  terminated_pod_gc_threshold: int = 12500,
-                 podgc_period: float = 20.0):
+                 podgc_period: float = 20.0,
+                 cronjob_period: float = 10.0):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
         self.replicaset = ReplicaSetController(client, self.informers)
         self.deployment = DeploymentController(client, self.informers)
         self.job = JobController(client, self.informers)
+        self.statefulset = StatefulSetController(client, self.informers)
+        self.daemonset = DaemonSetController(client, self.informers)
+        self.cronjob = CronJobController(client, self.informers,
+                                         period=cronjob_period)
         self.endpoints = EndpointsController(client, self.informers)
         self.namespace = NamespaceController(client, self.informers)
         self.pv_binder = PersistentVolumeBinder(client, self.informers)
@@ -49,7 +57,8 @@ class ControllerManager:
             terminated_threshold=terminated_pod_gc_threshold,
             period=podgc_period)
         self.controllers: List = [
-            self.replicaset, self.deployment, self.job, self.endpoints,
+            self.replicaset, self.deployment, self.job, self.statefulset,
+            self.daemonset, self.cronjob, self.endpoints,
             self.namespace, self.pv_binder, self.nodelifecycle,
             self.garbagecollector, self.podgc]
 

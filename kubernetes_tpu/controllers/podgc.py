@@ -81,13 +81,28 @@ class PodGCController:
         return sum(1 for p in terminated[:excess] if self._delete_pod(p))
 
     def _gc_orphaned(self) -> int:
-        """Pods bound to nodes that no longer exist (gcOrphaned)."""
+        """Pods bound to nodes that no longer exist (gcOrphaned). The
+        informer miss is only a HINT: node absence is confirmed against
+        the store before deleting, exactly like the reference's apiserver
+        double-check — informer lag must never kill a healthy pod."""
+        from ..state.store import NotFoundError
         live = {n.metadata.name for n in self.node_informer.indexer.list()}
         n = 0
+        confirmed_gone: set = set()
         for p in self.pod_informer.indexer.list():
-            if p.spec.node_name and p.spec.node_name not in live:
-                if self._delete_pod(p):
-                    n += 1
+            node = p.spec.node_name
+            if not node or node in live:
+                continue
+            if node not in confirmed_gone:
+                try:
+                    self.client.nodes().get(node)
+                    continue  # informer lag; node is alive
+                except NotFoundError:
+                    confirmed_gone.add(node)
+                except Exception:
+                    continue  # fail safe on lookup errors
+            if self._delete_pod(p):
+                n += 1
         return n
 
     def _gc_finished_jobs(self) -> int:

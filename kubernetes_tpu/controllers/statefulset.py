@@ -1,0 +1,161 @@
+"""StatefulSet controller — ordered, identity-stable replicas.
+
+Ref: pkg/controller/statefulset (stateful_set.go + stateful_set_control.go,
+1,689 LoC): pods are named <set>-0..N-1, created in ordinal order with
+each waiting for its predecessor to be Running/Ready (OrderedReady), scaled
+down from the highest ordinal, and volumeClaimTemplates stamp one PVC per
+ordinal that survives pod replacement.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from ..api import serde
+from ..api.apps import StatefulSet
+from ..api.core import PersistentVolumeClaim, Pod
+from ..api.meta import ObjectMeta, controller_ref, new_controller_ref
+from ..runtime.scheme import SCHEME
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+from .replicaset import pod_is_active, pod_is_ready
+
+
+def ordinal_of(set_name: str, pod_name: str) -> Optional[int]:
+    m = re.fullmatch(re.escape(set_name) + r"-(\d+)", pod_name)
+    return int(m.group(1)) if m else None
+
+
+class StatefulSetController(Controller):
+    name = "statefulset"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.informer = informers.informer_for(StatefulSet)
+        self.pod_informer = informers.informer_for(Pod)
+        self.informer.add_event_handlers(EventHandlers(
+            on_add=lambda s: self.enqueue(s.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key()),
+            on_delete=lambda s: self.enqueue(s.metadata.key())))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._enqueue_owner,
+            on_update=lambda o, n: self._enqueue_owner(n),
+            on_delete=self._enqueue_owner))
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        ref = controller_ref(pod.metadata)
+        if ref is not None and ref.kind == "StatefulSet":
+            self.enqueue(f"{pod.metadata.namespace}/{ref.name}")
+
+    def sync(self, key: str) -> None:
+        st = self.informer.indexer.get_by_key(key)
+        if st is None or st.metadata.deletion_timestamp is not None:
+            return
+        ns = st.metadata.namespace
+        owned: Dict[int, Pod] = {}
+        for pod in self.pod_informer.indexer.list(ns):
+            ref = controller_ref(pod.metadata)
+            if ref is None or ref.uid != st.metadata.uid:
+                continue
+            o = ordinal_of(st.metadata.name, pod.metadata.name)
+            if o is not None and pod_is_active(pod):
+                owned[o] = pod
+        replicas = st.spec.replicas
+        ordered = st.spec.pod_management_policy != "Parallel"
+        # scale down: highest ordinal first, one at a time (OrderedReady)
+        excess = sorted((o for o in owned if o >= replicas), reverse=True)
+        if excess:
+            victim = owned[excess[0]]
+            try:
+                self.client.pods(ns).delete(victim.metadata.name)
+            except Exception:
+                pass
+            self._update_status(st, owned)
+            return
+        # scale up / replace: lowest missing ordinal; OrderedReady waits for
+        # every predecessor to be Running/Ready first
+        for o in range(replicas):
+            if o in owned:
+                if ordered and not pod_is_ready(owned[o]):
+                    break  # wait for this ordinal before creating the next
+                continue
+            self._create_pod(st, o)
+            if ordered:
+                break
+        self._update_status(st, owned)
+
+    def _create_pod(self, st: StatefulSet, ordinal: int) -> None:
+        name = f"{st.metadata.name}-{ordinal}"
+        tmpl = st.spec.template
+        labels = dict(tmpl.metadata.labels)
+        labels["statefulset.kubernetes.io/pod-name"] = name
+        spec = serde.deepcopy_obj(tmpl.spec)
+        spec.hostname = name
+        spec.subdomain = st.spec.service_name
+        self._ensure_claims(st, ordinal, spec)
+        try:
+            self.client.pods(st.metadata.namespace).create(Pod(
+                metadata=ObjectMeta(
+                    name=name, namespace=st.metadata.namespace,
+                    labels=labels,
+                    owner_references=[new_controller_ref(
+                        "StatefulSet", st.api_version, st.metadata)]),
+                spec=spec))
+        except Exception:
+            pass
+
+    def _ensure_claims(self, st: StatefulSet, ordinal: int, spec) -> None:
+        """volumeClaimTemplates -> one PVC per ordinal, named
+        <tmpl>-<set>-<ordinal>, reattached across pod replacement (the
+        identity property). PVCs are NOT owned by the set: they survive
+        scale-down (ref: stateful_set_utils.go getPersistentVolumeClaims)."""
+        from ..state.store import AlreadyExistsError
+        for t in st.spec.volume_claim_templates:
+            tmpl_name = t.get("metadata", {}).get("name", "data")
+            claim_name = f"{tmpl_name}-{st.metadata.name}-{ordinal}"
+            pvc_data = {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                        "metadata": {"name": claim_name,
+                                     "namespace": st.metadata.namespace},
+                        "spec": t.get("spec", {})}
+            try:
+                self.client.persistent_volume_claims(
+                    st.metadata.namespace).create(
+                        serde.decode(PersistentVolumeClaim, pvc_data))
+            except AlreadyExistsError:
+                pass
+            except Exception:
+                pass
+            for v in spec.volumes:
+                if v.name == tmpl_name and v.persistent_volume_claim:
+                    v.persistent_volume_claim.claim_name = claim_name
+                    break
+            else:
+                from ..api.core import (PersistentVolumeClaimVolumeSource,
+                                        Volume)
+                spec.volumes.append(Volume(
+                    name=tmpl_name,
+                    persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                        claim_name=claim_name)))
+
+    def _update_status(self, st: StatefulSet, owned: Dict[int, Pod]) -> None:
+        ready = sum(1 for p in owned.values() if pod_is_ready(p))
+        observed = st.metadata.generation
+        if (st.status.replicas == len(owned)
+                and st.status.ready_replicas == ready
+                and st.status.observed_generation == observed):
+            return
+        def mutate(cur):
+            cur.status.replicas = len(owned)
+            cur.status.ready_replicas = ready
+            cur.status.current_replicas = len(owned)
+            cur.status.observed_generation = max(
+                cur.status.observed_generation, observed)
+            return cur
+        try:
+            self.client.stateful_sets(st.metadata.namespace).patch(
+                st.metadata.name, mutate)
+        except Exception:
+            pass

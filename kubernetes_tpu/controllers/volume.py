@@ -49,9 +49,14 @@ class PersistentVolumeBinder(Controller):
 
     def _on_pv_event(self, pv: PersistentVolume) -> None:
         self.enqueue("pv/" + pv.metadata.name)
-        # a newly Available PV may satisfy pending claims
+        # only an AVAILABLE volume can satisfy pending claims, and only
+        # claims it actually matches are worth a sync — a blanket re-enqueue
+        # would make mass binding O(N^2) syncs (each bind's own MODIFIED
+        # event re-waking every pending claim)
+        if pv.spec.claim_ref is not None or pv.status.phase != "Available":
+            return
         for pvc in self.pvc_informer.indexer.list():
-            if not pvc.spec.volume_name:
+            if not pvc.spec.volume_name and _pv_matches_claim(pv, pvc, None):
                 self.enqueue("pvc/" + pvc.metadata.key())
 
     def _binds_immediately(self, pvc: PersistentVolumeClaim) -> bool:

@@ -1,0 +1,15 @@
+"""Native components (C++, loaded via ctypes).
+
+The compute path is JAX/XLA/Pallas; the runtime around it uses C++ where
+the reference's equivalent is native. Currently:
+
+    walcore.cc   — the store's WAL appender (etcd's wal/ analog)
+
+Builds are lazy and optional: `build.load(name)` compiles with g++ on
+first use and caches the .so next to the source; every consumer carries a
+pure-python fallback so a missing toolchain only costs speed.
+"""
+
+from .build import load
+
+__all__ = ["load"]

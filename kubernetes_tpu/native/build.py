@@ -1,0 +1,40 @@
+"""Lazy g++ builds for the native components (ctypes loading; the image
+ships no pybind11, and the CPython API would be overkill for these C
+surfaces). A build failure returns None and consumers fall back to their
+python implementations."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (once) and dlopen native/<name>.cc -> <name>.so."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_SRC_DIR, f"{name}.cc")
+        so = os.path.join(_SRC_DIR, f"{name}.so")
+        lib: Optional[ctypes.CDLL] = None
+        try:
+            if not os.path.exists(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src):
+                tmp = so + ".tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+        except Exception:
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,133 @@
+// walcore — buffered write-ahead-log appender.
+//
+// The native half of the store's durability path (state/wal.py). The
+// reference's L0 is etcd: a separate native-code process whose own WAL
+// (etcd wal/ package) makes writes durable before they are acknowledged;
+// here the equivalent boundary is this small C core doing the hot
+// append/flush path — length-prefixed records, a userspace buffer sized
+// for the store's bulk-bind transactions, fdatasync on flush — loaded
+// via ctypes (no pybind11 in the image). state/wal.py carries a pure
+// python fallback so the build is optional.
+//
+// Record format (little endian): u32 length | payload bytes.
+//
+// Build: see kubernetes_tpu/native/build.py (g++ -O2 -shared -fPIC).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Wal {
+  int fd;
+  uint8_t* buf;
+  size_t cap;
+  size_t len;
+};
+
+// Flush the userspace buffer to the kernel. Returns 0 on success.
+int drain(Wal* w) {
+  size_t off = 0;
+  while (off < w->len) {
+    ssize_t n = ::write(w->fd, w->buf + off, w->len - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    off += static_cast<size_t>(n);
+  }
+  w->len = 0;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (append mode, create if missing). Returns an opaque handle or null.
+void* wal_open(const char* path, size_t buffer_cap) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (fd < 0) return nullptr;
+  Wal* w = static_cast<Wal*>(std::malloc(sizeof(Wal)));
+  if (!w) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (buffer_cap < 4096) buffer_cap = 4096;
+  w->fd = fd;
+  w->cap = buffer_cap;
+  w->len = 0;
+  w->buf = static_cast<uint8_t*>(std::malloc(buffer_cap));
+  if (!w->buf) {
+    ::close(fd);
+    std::free(w);
+    return nullptr;
+  }
+  return w;
+}
+
+// Append one length-prefixed record to the buffer (draining as needed).
+// Returns 0 on success.
+int wal_append(void* handle, const uint8_t* data, uint32_t n) {
+  Wal* w = static_cast<Wal*>(handle);
+  if (!w) return -1;
+  uint8_t hdr[4] = {
+      static_cast<uint8_t>(n & 0xff),
+      static_cast<uint8_t>((n >> 8) & 0xff),
+      static_cast<uint8_t>((n >> 16) & 0xff),
+      static_cast<uint8_t>((n >> 24) & 0xff),
+  };
+  if (w->len + sizeof(hdr) + n > w->cap && drain(w) != 0) return -1;
+  if (sizeof(hdr) + n > w->cap) {
+    // oversized record: write through directly
+    if (::write(w->fd, hdr, sizeof(hdr)) != static_cast<ssize_t>(sizeof(hdr)))
+      return -1;
+    size_t off = 0;
+    while (off < n) {
+      ssize_t m = ::write(w->fd, data + off, n - off);
+      if (m < 0) {
+        if (errno == EINTR) continue;
+        return -1;
+      }
+      off += static_cast<size_t>(m);
+    }
+    return 0;
+  }
+  std::memcpy(w->buf + w->len, hdr, sizeof(hdr));
+  w->len += sizeof(hdr);
+  std::memcpy(w->buf + w->len, data, n);
+  w->len += n;
+  return 0;
+}
+
+// Drain the buffer and make it durable (fdatasync). Returns 0 on success.
+int wal_flush(void* handle, int sync) {
+  Wal* w = static_cast<Wal*>(handle);
+  if (!w) return -1;
+  if (drain(w) != 0) return -1;
+  if (sync) {
+#if defined(__APPLE__)
+    if (::fsync(w->fd) != 0) return -1;
+#else
+    if (::fdatasync(w->fd) != 0) return -1;
+#endif
+  }
+  return 0;
+}
+
+void wal_close(void* handle) {
+  Wal* w = static_cast<Wal*>(handle);
+  if (!w) return;
+  drain(w);
+  ::close(w->fd);
+  std::free(w->buf);
+  std::free(w);
+}
+
+}  // extern "C"

@@ -192,9 +192,19 @@ class NodeAgent:
         uid = pod.metadata.uid
         if self._reported.get(uid) == (phase, ready):
             return
+        import hashlib
+
+        def stable_ip(seed: str, prefix: str) -> str:
+            h = int(hashlib.md5(seed.encode()).hexdigest(), 16)
+            return f"{prefix}.{(h >> 8) % 250 + 1}.{h % 250 + 1}"
+
         def mutate(cur):
             cur.status.phase = phase
-            cur.status.host_ip = f"10.0.0.{hash(self.node_name) % 250 + 1}"
+            # deterministic fake IPs (hash() is seed-randomized per process
+            # and would churn Endpoints across restarts); pod_ip is per-pod
+            # so service endpoints are distinct addresses
+            cur.status.host_ip = stable_ip(self.node_name, "10.0")
+            cur.status.pod_ip = stable_ip(cur.metadata.uid, "10.128")
             if cur.status.start_time is None:
                 cur.status.start_time = now_iso()
             cur.status.container_statuses = [

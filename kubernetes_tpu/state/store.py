@@ -27,6 +27,7 @@ interface; this python implementation is the semantic reference.
 from __future__ import annotations
 
 import queue
+import os
 import threading
 from collections import deque
 from dataclasses import dataclass
@@ -87,11 +88,17 @@ class Watch:
 
 
 class Store:
-    """The cluster state store. Keys are (resource, namespace, name)."""
+    """The cluster state store. Keys are (resource, namespace, name).
+
+    `wal_path` enables durability: every committed mutation is journaled
+    to a write-ahead log (state/wal.py; native append path in
+    native/walcore.cc) and replayed on construction — the etcd analog of
+    L0 persistence. `wal_sync=True` fdatasyncs per transaction."""
 
     HISTORY_WINDOW = 4096  # retained events for watch resume (watchCache capacity)
 
-    def __init__(self):
+    def __init__(self, wal_path: Optional[str] = None,
+                 wal_sync: bool = False):
         self._lock = threading.RLock()
         self._rv = 0
         # resource -> {(namespace, name) -> (obj, rv)}
@@ -102,6 +109,76 @@ class Store:
         self._watches: Dict[int, Tuple[str, Optional[str], Watch]] = {}
         self._next_watch_id = 0
         self._uid_counter = 0
+        self._wal = None
+        if wal_path is not None:
+            self._replay_wal(wal_path)
+            from .wal import WalWriter
+            self._wal = WalWriter(wal_path, sync=wal_sync)
+
+    # ---------------------------------------------------------------- wal
+
+    def _replay_wal(self, path: str) -> None:
+        from ..runtime.scheme import SCHEME
+        from .wal import load_wal
+        records, clean_offset = load_wal(path)
+        for rec in records:
+            cls = SCHEME.type_for_resource(rec["resource"])
+            if cls is None:
+                continue
+            obj = serde.decode(cls, rec["object"])
+            key = (obj.metadata.namespace, obj.metadata.name)
+            bucket = self._data.setdefault(rec["resource"], {})
+            if rec["op"] == "DELETE":
+                bucket.pop(key, None)
+            else:
+                bucket[key] = (obj, rec["rv"])
+            self._rv = max(self._rv, rec["rv"])
+            self._uid_counter = max(self._uid_counter, rec.get("uc", 0))
+        # drop any torn tail BEFORE the writer opens in append mode, or
+        # post-restart records hide behind the torn bytes and the next
+        # replay loses them
+        if os.path.exists(path) and os.path.getsize(path) > clean_offset:
+            with open(path, "rb+") as f:
+                f.truncate(clean_offset)
+
+    def _journal(self, op: str, resource: str, obj: Any, rv: int) -> None:
+        """Called under the lock after a committed mutation."""
+        if self._wal is not None:
+            self._wal.append(op, resource, rv, serde.encode(obj),
+                             uid_counter=self._uid_counter)
+
+    def _wal_commit(self) -> None:
+        if self._wal is not None:
+            self._wal.flush()
+
+    def compact(self) -> None:
+        """Rewrite the log as one PUT per live object (snapshot analog)."""
+        if self._wal is None:
+            return
+        from .wal import WalWriter
+        with self._lock:
+            path = self._wal.path
+            sync = self._wal.sync
+            self._wal.close()
+            tmp = path + ".compact"
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            w = WalWriter(tmp, sync=True)
+            for resource, bucket in self._data.items():
+                for (ns, name), (obj, rv) in bucket.items():
+                    w.append("PUT", resource, rv, serde.encode(obj),
+                             uid_counter=self._uid_counter)
+            w.flush()
+            w.close()
+            os.replace(tmp, path)
+            self._wal = WalWriter(path, sync=sync)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                self._wal.close()
+                self._wal = None
 
     # ------------------------------------------------------------- writes
 
@@ -131,6 +208,8 @@ class Store:
                 meta.generation = 1  # ref: registry strategies PrepareForCreate
             meta.resource_version = str(self._rv)
             bucket[key] = (stored, self._rv)
+            self._journal("PUT", resource, stored, self._rv)
+            self._wal_commit()
             self._publish(resource, WatchEvent(ADDED, stored, self._rv))
             return stored
 
@@ -168,9 +247,13 @@ class Store:
             if stored.metadata.deletion_timestamp is not None and \
                     not stored.metadata.finalizers:
                 del bucket[key]
+                self._journal("DELETE", resource, stored, self._rv)
+                self._wal_commit()
                 self._publish(resource, WatchEvent(DELETED, stored, self._rv))
                 return stored
             bucket[key] = (stored, self._rv)
+            self._journal("PUT", resource, stored, self._rv)
+            self._wal_commit()
             self._publish(resource, WatchEvent(MODIFIED, stored, self._rv))
             return stored
 
@@ -194,12 +277,16 @@ class Store:
                 self._rv += 1
                 marked.metadata.resource_version = str(self._rv)
                 bucket[key] = (marked, self._rv)
+                self._journal("PUT", resource, marked, self._rv)
+                self._wal_commit()
                 self._publish(resource, WatchEvent(MODIFIED, marked, self._rv))
                 return marked
             del bucket[key]
             self._rv += 1
             final = serde.deepcopy_obj(cur_obj)
             final.metadata.resource_version = str(self._rv)
+            self._journal("DELETE", resource, final, self._rv)
+            self._wal_commit()
             self._publish(resource, WatchEvent(DELETED, final, self._rv))
             return final
 
@@ -237,13 +324,16 @@ class Store:
                 if updated.metadata.deletion_timestamp is not None and \
                         not updated.metadata.finalizers:
                     del bucket[key]
+                    self._journal("DELETE", resource, updated, self._rv)
                     events.append((resource,
                                    WatchEvent(DELETED, updated, self._rv)))
                 else:
                     bucket[key] = (updated, self._rv)
+                    self._journal("PUT", resource, updated, self._rv)
                     events.append((resource,
                                    WatchEvent(MODIFIED, updated, self._rv)))
                 out.append(updated)
+            self._wal_commit()  # one durability point per transaction
             for res, ev in events:
                 self._publish(res, ev)
         return out

@@ -1,0 +1,203 @@
+"""Third controllers slice: StatefulSet, DaemonSet, CronJob."""
+
+import time
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.cronjob import schedule_due
+from kubernetes_tpu.node import HollowCluster
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def pod_spec(cpu="50m"):
+    return api.PodSpec(containers=[api.Container(
+        name="c", image="img",
+        resources=api.ResourceRequirements(
+            requests={"cpu": Quantity(cpu), "memory": Quantity("32Mi")}))])
+
+
+def wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return fn()
+
+
+class TestStatefulSetController:
+    def test_ordered_creation_and_identity(self):
+        client = Client()
+        # backing volumes for the per-ordinal claims (Immediate binding)
+        for i in range(4):
+            client.persistent_volumes().create(api.PersistentVolume(
+                metadata=api.ObjectMeta(name=f"disk-{i}"),
+                spec=api.PersistentVolumeSpec(
+                    capacity={"storage": Quantity("2Gi")},
+                    access_modes=["ReadWriteOnce"])))
+        hollow = HollowCluster(client, n_nodes=3)
+        sched = Scheduler(client, batch_size=8)
+        mgr = ControllerManager(client)
+        hollow.start()
+        mgr.start()
+        sched.start()
+        try:
+            client.stateful_sets("default").create(api.StatefulSet(
+                metadata=api.ObjectMeta(name="db", namespace="default"),
+                spec=api.StatefulSetSpec(
+                    replicas=3, service_name="db",
+                    selector=api.LabelSelector(match_labels={"app": "db"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "db"}),
+                        spec=pod_spec()),
+                    volume_claim_templates=[{
+                        "metadata": {"name": "data"},
+                        "spec": {"accessModes": ["ReadWriteOnce"],
+                                 "resources": {"requests": {
+                                     "storage": "1Gi"}}}}])))
+            def all_up():
+                names = sorted(p.metadata.name
+                               for p in client.pods("default").list())
+                return names == ["db-0", "db-1", "db-2"]
+            assert wait_for(all_up, timeout=60)
+            # stable identity: hostname + per-ordinal PVC
+            p0 = client.pods("default").get("db-0")
+            assert p0.spec.hostname == "db-0"
+            assert p0.spec.subdomain == "db"
+            claims = sorted(c.metadata.name for c in
+                            client.persistent_volume_claims("default").list())
+            assert claims == ["data-db-0", "data-db-1", "data-db-2"]
+            # scale down removes the HIGHEST ordinal, keeps its PVC
+            def scale(cur):
+                cur.spec.replicas = 2
+                return cur
+            client.stateful_sets("default").patch("db", scale)
+            assert wait_for(lambda: sorted(
+                p.metadata.name for p in client.pods("default").list())
+                == ["db-0", "db-1"], timeout=30)
+            assert len(client.persistent_volume_claims(
+                "default").list()) == 3  # claims survive scale-down
+            # a deleted pod is recreated with the SAME name and claim
+            client.pods("default").delete("db-1")
+            assert wait_for(lambda: any(
+                p.metadata.name == "db-1" and p.status.phase == "Running"
+                for p in client.pods("default").list()), timeout=30)
+        finally:
+            sched.stop()
+            mgr.stop()
+            hollow.stop()
+
+
+class TestDaemonSetController:
+    def test_one_pod_per_eligible_node(self):
+        client = Client()
+        hollow = HollowCluster(client, n_nodes=3)
+        mgr = ControllerManager(client)
+        hollow.start()
+        mgr.start()
+        try:
+            assert wait_for(lambda: len(client.nodes().list()) == 3)
+            client.daemon_sets("default").create(api.DaemonSet(
+                metadata=api.ObjectMeta(name="agent", namespace="default"),
+                spec=api.DaemonSetSpec(
+                    selector=api.LabelSelector(match_labels={"d": "agent"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"d": "agent"}),
+                        spec=pod_spec()))))
+            def one_per_node():
+                pods = client.pods("default").list()
+                nodes = sorted(p.spec.node_name for p in pods)
+                return len(pods) == 3 and len(set(nodes)) == 3
+            assert wait_for(one_per_node, timeout=30)
+            ds = client.daemon_sets("default").get("agent")
+            assert wait_for(lambda: client.daemon_sets("default")
+                            .get("agent").status.number_ready == 3,
+                            timeout=30)
+            # a NEW node gets a daemon pod
+            agent = HollowCluster(client, n_nodes=1,
+                                  name_prefix="late-node-")
+            agent.start()
+            try:
+                assert wait_for(lambda: any(
+                    p.spec.node_name == "late-node-0"
+                    for p in client.pods("default").list()), timeout=30)
+            finally:
+                agent.stop()
+            # a tainted node the daemon does not tolerate loses its pod
+            def taint(cur):
+                cur.spec.taints.append(api.Taint(
+                    key="dedicated", value="x", effect="NoSchedule"))
+                return cur
+            client.nodes().patch("hollow-node-0", taint)
+            assert wait_for(lambda: not any(
+                p.spec.node_name == "hollow-node-0"
+                for p in client.pods("default").list()), timeout=30)
+        finally:
+            mgr.stop()
+            hollow.stop()
+
+
+class TestCronJobController:
+    def test_schedule_matching(self):
+        ts = 1_900_000_000  # 2030-03-17 17:46:40 UTC (Sunday)
+        import datetime
+        dt = datetime.datetime.fromtimestamp(
+            ts, tz=datetime.timezone.utc)
+        assert schedule_due("* * * * *", ts)
+        assert schedule_due(f"{dt.minute} {dt.hour} * * *", ts)
+        assert not schedule_due(f"{(dt.minute + 1) % 60} * * * *", ts)
+        assert schedule_due("*/2 * * * *", ts) == (dt.minute % 2 == 0)
+
+    def test_due_cronjob_spawns_job_and_prunes(self):
+        client = Client()
+        clock = FakeClock(start=1_900_000_000)
+        mgr = ControllerManager(client)
+        mgr.cronjob.clock = clock
+        mgr.start()
+        try:
+            client.resource(api.CronJob, "default").create(api.CronJob(
+                metadata=api.ObjectMeta(name="tick", namespace="default"),
+                spec=api.CronJobSpec(
+                    schedule="* * * * *",
+                    successful_jobs_history_limit=1,
+                    job_template={"spec": {
+                        "completions": 1,
+                        "template": {
+                            "metadata": {"labels": {"cj": "tick"}},
+                            "spec": {"containers": [{
+                                "name": "c", "image": "i"}]}}}})))
+            mgr.cronjob.sync_all()
+            assert wait_for(lambda: len(client.jobs("default").list()) == 1)
+            job = client.jobs("default").list()[0]
+            ref = api.controller_ref(job.metadata)
+            assert ref is not None and ref.kind == "CronJob"
+            # same minute: no duplicate
+            mgr.cronjob.sync_all()
+            time.sleep(0.2)
+            assert len(client.jobs("default").list()) == 1
+            # next minute fires again
+            clock.step(60)
+            mgr.cronjob.sync_all()
+            assert wait_for(lambda: len(client.jobs("default").list()) == 2)
+            # finish both jobs; history limit 1 prunes the older
+            for j in client.jobs("default").list():
+                def finish(cur):
+                    cur.status.conditions.append(api.JobCondition(
+                        type="Complete", status="True"))
+                    return cur
+                client.jobs("default").patch(j.metadata.name, finish)
+            clock.step(60)
+            # wait for the informer to see both Complete conditions, then
+            # let one more pass prune history (fires a 3rd job too)
+            def pruned():
+                mgr.cronjob.sync_all()
+                done = [j for j in client.jobs("default").list()
+                        if any(c.type == "Complete"
+                               for c in j.status.conditions)]
+                return len(done) <= 1
+            assert wait_for(pruned, timeout=20)
+        finally:
+            mgr.stop()

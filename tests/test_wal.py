@@ -1,0 +1,153 @@
+"""WAL durability tests (L0): journal + replay + compaction + torn tails.
+
+Ref: etcd's wal/ package semantics — the reference's L0 durability that
+the in-process store previously lacked.
+"""
+
+import os
+import struct
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.state.store import Store
+from kubernetes_tpu.state.wal import WalWriter, read_wal
+
+
+def make_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("100m")}))]))
+
+
+class TestWal:
+    def test_replay_restores_state(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        client.pods("default").create(make_pod("p1"))
+        client.pods("default").create(make_pod("p2"))
+        got = client.pods("default").get("p1")
+        got.metadata.labels["x"] = "y"
+        client.pods("default").update(got)
+        client.pods("default").delete("p2")
+        client.nodes().create(api.Node(metadata=api.ObjectMeta(name="n1")))
+        rv_before = store._rv
+        store.close()
+
+        # a fresh process replays the log
+        store2 = Store(wal_path=path)
+        client2 = Client(store2)
+        pods = client2.pods("default").list()
+        assert [p.metadata.name for p in pods] == ["p1"]
+        assert pods[0].metadata.labels["x"] == "y"
+        assert client2.nodes().get("n1").metadata.name == "n1"
+        assert store2._rv == rv_before
+        # new writes continue the version sequence + uid uniqueness
+        p3 = client2.pods("default").create(make_pod("p3"))
+        assert int(p3.metadata.resource_version) > rv_before
+        assert p3.metadata.uid != pods[0].metadata.uid
+        store2.close()
+
+    def test_generate_name_survives_restart(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        pod = make_pod("")
+        pod.metadata.generate_name = "web-"
+        first = client.pods("default").create(pod)
+        store.close()
+        store2 = Store(wal_path=path)
+        pod2 = make_pod("")
+        pod2.metadata.generate_name = "web-"
+        second = Client(store2).pods("default").create(pod2)
+        assert first.metadata.name != second.metadata.name
+        store2.close()
+
+    def test_torn_tail_is_truncated(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        client.pods("default").create(make_pod("ok"))
+        store.close()
+        # simulate a crash mid-append: a record header with half a payload
+        with open(path, "ab") as f:
+            f.write(struct.pack("<I", 1000))
+            f.write(b"{half")
+        store2 = Store(wal_path=path)
+        pods = Client(store2).pods("default").list()
+        assert [p.metadata.name for p in pods] == ["ok"]
+        store2.close()
+
+    def test_records_after_torn_tail_survive_next_restart(self, tmp_path):
+        """Regression: the torn tail must be TRUNCATED before appending, or
+        records written after a crash-recovery restart hide behind the torn
+        bytes and the NEXT replay loses them."""
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        Client(store).pods("default").create(make_pod("before-crash"))
+        store.close()
+        with open(path, "ab") as f:  # crash mid-append
+            f.write(struct.pack("<I", 500))
+            f.write(b"{torn")
+        store2 = Store(wal_path=path)  # restart 1: truncates + appends
+        Client(store2).pods("default").create(make_pod("after-crash"))
+        store2.close()
+        store3 = Store(wal_path=path)  # restart 2 must see BOTH
+        names = sorted(p.metadata.name
+                       for p in Client(store3).pods("default").list())
+        assert names == ["after-crash", "before-crash"]
+        store3.close()
+
+    def test_compaction_bounds_replay(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        for i in range(20):
+            client.pods("default").create(make_pod(f"p{i}"))
+        for i in range(19):
+            client.pods("default").delete(f"p{i}")
+        size_before = os.path.getsize(path)
+        store.compact()
+        assert os.path.getsize(path) < size_before
+        records = list(read_wal(path))
+        assert all(r["op"] == "PUT" for r in records)
+        store.close()
+        store2 = Store(wal_path=path)
+        pods = Client(store2).pods("default").list()
+        assert [p.metadata.name for p in pods] == ["p19"]
+        store2.close()
+
+    def test_native_appender_builds_and_matches(self, tmp_path):
+        """The C appender must produce the exact format the reader and the
+        python fallback use."""
+        from kubernetes_tpu.native import load
+        native_path = str(tmp_path / "native.wal")
+        w = WalWriter(native_path)
+        w.append("PUT", "pods", 1, {"metadata": {"name": "x"}})
+        w.flush()
+        w.close()
+        recs = list(read_wal(native_path))
+        assert recs == [{"op": "PUT", "resource": "pods", "rv": 1, "uc": 0,
+                         "object": {"metadata": {"name": "x"}}}]
+        # the toolchain is present in this image: assert the native path
+        # actually built (fallback correctness is covered either way)
+        assert load("walcore") is not None
+        assert w.native
+
+    def test_bulk_bind_is_journaled(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        client.pods("default").create(make_pod("p1"))
+        client.pods("default").bind_bulk([api.Binding(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1"))])
+        store.close()
+        store2 = Store(wal_path=path)
+        assert Client(store2).pods("default").get(
+            "p1").spec.node_name == "n1"
+        store2.close()
