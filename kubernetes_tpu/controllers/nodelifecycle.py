@@ -103,7 +103,9 @@ class NodeLifecycleController:
         else:
             not_ready, taint_key = False, ""
         if not_ready:
-            self._ensure_taints(node, taint_key)
+            from ..utils.features import DEFAULT_FEATURE_GATE
+            if DEFAULT_FEATURE_GATE.enabled("TaintBasedEvictions"):
+                self._ensure_taints(node, taint_key)
             since = self._not_ready_since.setdefault(name, self.clock.now())
             if self.clock.now() - since >= self.eviction_timeout:
                 self._evict_pods(name)

@@ -108,6 +108,9 @@ class PodGCController:
     def _gc_finished_jobs(self) -> int:
         """ttlSecondsAfterFinished (pkg/controller/ttlafterfinished):
         delete finished Jobs past their TTL; owner cascade removes pods."""
+        from ..utils.features import DEFAULT_FEATURE_GATE
+        if not DEFAULT_FEATURE_GATE.enabled("TTLAfterFinished"):
+            return 0
         n = 0
         now = self.clock.now()
         for job in self.job_informer.indexer.list():

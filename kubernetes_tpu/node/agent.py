@@ -91,7 +91,10 @@ class NodeAgent:
 
     def _renew_lease(self) -> None:
         """Ref: pkg/kubelet/nodelease — a Lease in kube-node-lease renewed
-        each heartbeat."""
+        each heartbeat (the NodeLease feature gate)."""
+        from ..utils.features import DEFAULT_FEATURE_GATE
+        if not DEFAULT_FEATURE_GATE.enabled("NodeLease"):
+            return
         from ..api.policy import Lease, LeaseSpec
         from ..state.store import NotFoundError
         try:

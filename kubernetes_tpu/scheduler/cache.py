@@ -57,6 +57,17 @@ class Cache:
         self._assumed_deadline: Dict[str, float] = {}
         self._node_tree = NodeTree()
 
+    def node_names(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def pod_keys(self, include_assumed: bool = True) -> List[str]:
+        """Cached pod keys (debugger/comparer introspection)."""
+        with self._lock:
+            if include_assumed:
+                return list(self._pod_states)
+            return [k for k in self._pod_states if k not in self._assumed]
+
     def _bump(self, ni: NodeInfo) -> None:
         ni.generation = next(self._generation)
         # monotonic mutation counter: the pipelined drain chains device usage

@@ -538,9 +538,11 @@ class BatchScheduler:
         relaunch unchained."""
         if not pods:
             return None
+        from ..utils.features import DEFAULT_FEATURE_GATE
         from .kernels.batch import pack_results, schedule_batch
         dirty = self.cache.update_snapshot(self.snapshot)
         chaining = (chain is not None and chain.residual_free
+                    and DEFAULT_FEATURE_GATE.enabled("SchedulerDeviceChaining")
                     and chain_seq is not None
                     and self.cache.mutation_seq == chain_seq
                     and not self._static_likely
@@ -645,6 +647,9 @@ class BatchScheduler:
         reservation to the wrong node. Nominations are rare so the
         rebuild+upload almost never runs. Nominees already assumed into
         the cache are excluded — their usage is real, not phantom."""
+        from ..utils.features import DEFAULT_FEATURE_GATE
+        if not DEFAULT_FEATURE_GATE.enabled("SchedulerNominatedReservations"):
+            return None
         ver = self.nominated.version
         shape = (self.mirror.t.capacity, self.mirror.t.n_cols)
         key = (ver, self.mirror.epoch, shape)

@@ -1,0 +1,87 @@
+"""Cache debugger — dump + cache-vs-informer comparer.
+
+Ref: pkg/scheduler/internal/cache/debugger (CacheComparer compares the
+scheduler cache's nodes/pods against the informer's truth; CacheDumper
+writes a snapshot of cached state + the pending queue on SIGUSR2). The
+comparer is the structural race-detection defense: a divergence means an
+event was dropped or double-applied somewhere between informer and cache.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class ComparisonResult:
+    missing_pods: List[str] = field(default_factory=list)    # informer only
+    redundant_pods: List[str] = field(default_factory=list)  # cache only
+    missing_nodes: List[str] = field(default_factory=list)
+    redundant_nodes: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.missing_pods or self.redundant_pods
+                    or self.missing_nodes or self.redundant_nodes)
+
+
+class CacheDebugger:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    def compare(self) -> ComparisonResult:
+        """Ref: debugger/comparer.go CompareNodes/ComparePods. Assumed pods
+        are cache-only BY DESIGN (in-flight binds) and excluded."""
+        from ..api.core import Node, Pod
+        sched = self.scheduler
+        res = ComparisonResult()
+        informer_nodes = {n.metadata.name for n in
+                          sched.informers.informer_for(Node).indexer.list()}
+        cache_nodes = set(sched.cache.node_names())
+        res.missing_nodes = sorted(informer_nodes - cache_nodes)
+        res.redundant_nodes = sorted(cache_nodes - informer_nodes)
+        informer_pods = {p.metadata.key() for p in
+                         sched.informers.informer_for(Pod).indexer.list()
+                         if p.spec.node_name
+                         and not _terminal(p)}
+        cache_pods = set(sched.cache.pod_keys(include_assumed=False))
+        assumed = set(sched.cache.pod_keys(include_assumed=True)) - cache_pods
+        res.missing_pods = sorted(informer_pods - cache_pods - assumed)
+        res.redundant_pods = sorted(cache_pods - informer_pods)
+        return res
+
+    def dump(self) -> str:
+        """Ref: debugger/dumper.go — cached nodes with usage, assumed pods,
+        pending queue."""
+        sched = self.scheduler
+        lines = ["Dump of cached NodeInfo:"]
+        # snapshot the dict: a SIGUSR2 handler races the scheduler thread's
+        # update_snapshot, and a mid-iteration resize would raise INTO
+        # whatever main-thread code the signal interrupted
+        infos = dict(sched.algorithm.snapshot.node_infos)
+        for name, ni in sorted(infos.items()):
+            lines.append(
+                f"  {name}: pods={len(ni.pods)} "
+                f"cpu={ni.requested.milli_cpu}/{ni.allocatable.milli_cpu}m "
+                f"mem={ni.requested.memory}/{ni.allocatable.memory}")
+        lines.append("Dump of scheduling queue:")
+        for pod in sched.queue.pending_pods():
+            lines.append(f"  {pod.metadata.key()}")
+        return "\n".join(lines)
+
+    def install(self, signum: int = signal.SIGUSR2) -> None:
+        """SIGUSR2 -> dump + comparison to stderr (ref: debugger.go
+        ListenForSignal)."""
+        def handler(_sig, _frame):
+            print(self.dump(), file=sys.stderr)
+            cmp = self.compare()
+            if not cmp.ok:
+                print(f"cache comparison FAILED: {cmp}", file=sys.stderr)
+        signal.signal(signum, handler)
+
+
+def _terminal(pod) -> bool:
+    return pod.status.phase in ("Succeeded", "Failed")

@@ -21,7 +21,7 @@ import threading
 from typing import Callable, List, Optional
 
 from ..api import helpers, serde
-from ..api.core import Binding, Event, ObjectReference, Pod
+from ..api.core import Binding, ObjectReference, Pod
 from ..api.meta import ObjectMeta
 from ..state.client import Client
 from ..state.informer import EventHandlers, SharedInformerFactory
@@ -76,6 +76,14 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
+        from ..state.record import EventRecorder
+        from .debugger import CacheDebugger
+        #: correlating recorder (ref: client-go tools/record): dedup by
+        #: count-bumping, aggregation, spam filtering
+        self.recorder = EventRecorder(client, component=scheduler_name,
+                                      clock=clock)
+        #: SIGUSR2 dump + cache-vs-informer comparer (install() to arm)
+        self.debugger = CacheDebugger(self)
         self.scheduled_count = 0
         self.unschedulable_count = 0
         self.preemption_count = 0
@@ -202,11 +210,18 @@ class Scheduler:
     def _schedule_batch_locked(self, pods: List[Pod], cycle: int
                                ) -> List[ScheduleResult]:
         import time as _time
+        from ..utils.trace import Trace
+        trace = Trace("schedule_batch", pods=len(pods), cycle=cycle)
         t0 = _time.perf_counter()
         results = self.algorithm.schedule(pods)
+        trace.step("batch decided (tensorize + kernel + repair)")
         t1 = _time.perf_counter()
         self._commit_results(results, cycle)
+        trace.step("results committed (volumes + plugins + bind + assume)")
         t2 = _time.perf_counter()
+        # per-attempt step tracing, logged only when slow (ref: utiltrace
+        # in generic_scheduler.go:185 with the same 100ms threshold)
+        trace.log_if_long(100.0)
         m = self.metrics
         m.scheduling_duration.observe(t1 - t0, operation="algorithm")
         m.scheduling_duration.observe(t2 - t1, operation="commit")
@@ -489,17 +504,11 @@ class Scheduler:
         self.preemption_count += 1
 
     def _record_event(self, pod: Pod, reason: str, message: str) -> None:
-        """Ref: client-go tools/record EventRecorder -> apiserver Events."""
-        ev = Event(
-            metadata=ObjectMeta(
-                generate_name=f"{pod.metadata.name}.",
-                namespace=pod.metadata.namespace or "default"),
-            involved_object=ObjectReference(
-                kind="Pod", namespace=pod.metadata.namespace,
-                name=pod.metadata.name, uid=pod.metadata.uid),
-            reason=reason, message=message, type="Warning", count=1)
+        """Ref: client-go tools/record EventRecorder -> apiserver Events;
+        the recorder correlates (count-bump + aggregation + spam filter) so
+        a hot failure loop cannot flood the store with Event objects."""
         try:
-            self.client.events(pod.metadata.namespace).create(ev)
+            self.recorder.event(pod, "Warning", reason, message)
         except Exception:
             pass
 

@@ -1,0 +1,54 @@
+"""Step tracing — the k8s.io/utils/trace analog.
+
+Ref: utiltrace.Trace as used per scheduling attempt
+(generic_scheduler.go:185-186 creates one, steps at :204,223,246, and the
+whole trace logs only when total time exceeds a threshold — 100ms there).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: List[Tuple[float, str]] = []
+        self._nested: List["Trace"] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.perf_counter(), msg))
+
+    def nest(self, name: str, **fields) -> "Trace":
+        t = Trace(name, **fields)
+        self._nested.append(t)
+        return t
+
+    def total_ms(self) -> float:
+        return (time.perf_counter() - self.start) * 1000.0
+
+    def log_if_long(self, threshold_ms: float = 100.0,
+                    out=None) -> Optional[str]:
+        """Render + emit when total exceeds the threshold (ref:
+        Trace.LogIfLong); returns the rendering (tests) or None."""
+        if self.total_ms() < threshold_ms:
+            return None
+        text = self.render()
+        print(text, file=out or sys.stderr)
+        return text
+
+    def render(self) -> str:
+        kv = ",".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}" {kv} '
+                 f"(total {self.total_ms():.1f}ms):"]
+        prev = self.start
+        for ts, msg in self.steps:
+            lines.append(f"  step {((ts - prev) * 1000):.1f}ms: {msg}")
+            prev = ts
+        for t in self._nested:
+            lines.extend("  " + line for line in t.render().splitlines())
+        return "\n".join(lines)

@@ -1,0 +1,151 @@
+"""Auxiliary subsystems: event recording (correlation/aggregation/spam),
+feature gates, step tracing, cache debugger/comparer. Ref:
+client-go tools/record events_cache tests, feature_gate tests,
+utils/trace tests, scheduler internal/cache/debugger.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.state.record import EventRecorder
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.features import (DEFAULT_FEATURE_GATE, FeatureGate,
+                                           FeatureSpec)
+from kubernetes_tpu.utils.trace import Trace
+
+
+def make_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                uid=f"uid-{name}"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+
+
+class TestEventRecorder:
+    def test_identical_events_bump_count(self):
+        client = Client()
+        rec = EventRecorder(client, component="test")
+        pod = make_pod("p1")
+        for _ in range(5):
+            rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes fit")
+        events = client.events("default").list()
+        assert len(events) == 1
+        assert events[0].count == 5
+        assert events[0].reason == "FailedScheduling"
+        assert events[0].source["component"] == "test"
+
+    def test_similar_events_aggregate(self):
+        client = Client()
+        rec = EventRecorder(client, component="test")
+        pod = make_pod("p1")
+        # 30 distinct messages for one (object, reason): after the
+        # threshold they collapse into one aggregated event
+        for i in range(30):
+            rec.event(pod, "Warning", "FailedScheduling", f"variant {i}")
+        events = client.events("default").list()
+        assert len(events) < 30
+        assert any("combined from similar events" in e.message
+                   for e in events)
+
+    def test_spam_filter_rate_limits(self):
+        client = Client()
+        clock = FakeClock()
+        rec = EventRecorder(client, component="test", clock=clock)
+        pod = make_pod("p1")
+        # 100 distinct reasons exhaust the per-object burst (25)
+        for i in range(100):
+            rec.event(pod, "Normal", f"Reason{i}", "m")
+        assert rec.dropped > 0
+        assert len(client.events("default").list()) <= 25
+
+    def test_different_objects_do_not_correlate(self):
+        client = Client()
+        rec = EventRecorder(client)
+        rec.event(make_pod("a"), "Normal", "Started", "up")
+        rec.event(make_pod("b"), "Normal", "Started", "up")
+        assert len(client.events("default").list()) == 2
+
+
+class TestFeatureGate:
+    def test_defaults_override_and_parse(self):
+        g = FeatureGate({"Alpha": FeatureSpec(default=False),
+                         "Beta": FeatureSpec(default=True)})
+        assert not g.enabled("Alpha")
+        assert g.enabled("Beta")
+        g.parse("Alpha=true,Beta=false")
+        assert g.enabled("Alpha")
+        assert not g.enabled("Beta")
+        with pytest.raises(KeyError):
+            g.enabled("NoSuch")
+        with pytest.raises(KeyError):
+            g.set("NoSuch", True)
+
+    def test_ga_features_locked(self):
+        with pytest.raises(ValueError):
+            DEFAULT_FEATURE_GATE.set("PodPriority", False)
+
+    def test_gate_disables_device_chaining(self):
+        """The SchedulerDeviceChaining gate actually gates the drain's
+        chained launches."""
+        from kubernetes_tpu.scheduler import Scheduler
+        from tests.test_scheduler import make_node
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        sched = Scheduler(client, batch_size=8)
+        sched.algorithm.refresh()
+        first = sched.algorithm.schedule_launch([make_pod("a")])
+        assert first is not None
+        sched.algorithm.schedule_finish(first)
+        DEFAULT_FEATURE_GATE.set("SchedulerDeviceChaining", False)
+        try:
+            chained = sched.algorithm.schedule_launch(
+                [make_pod("b")], chain=first,
+                chain_seq=sched.cache.mutation_seq)
+            assert chained is None  # chain refused while gated off
+        finally:
+            DEFAULT_FEATURE_GATE.set("SchedulerDeviceChaining", True)
+
+
+class TestTrace:
+    def test_steps_and_threshold(self):
+        t = Trace("unit", pods=3)
+        t.step("phase one")
+        t.step("phase two")
+        assert t.log_if_long(10_000.0) is None  # fast: silent
+        text = t.log_if_long(0.0)
+        assert 'Trace "unit" pods=3' in text
+        assert "phase one" in text and "phase two" in text
+
+    def test_nested(self):
+        t = Trace("outer")
+        n = t.nest("inner", part=1)
+        n.step("sub-step")
+        assert "inner" in t.render() and "sub-step" in t.render()
+
+
+class TestCacheDebugger:
+    def test_compare_and_dump(self):
+        from kubernetes_tpu.scheduler import Scheduler
+        from tests.test_scheduler import make_node, make_pod as mp
+        client = Client()
+        client.nodes().create(make_node("n1"))
+        sched = Scheduler(client, batch_size=8)
+        sched.informers.start()
+        sched.informers.wait_for_cache_sync()
+        time.sleep(0.3)
+        try:
+            assert sched.debugger.compare().ok
+            # inject a divergence: a node the informer never saw
+            sched.cache.add_node(make_node("ghost"))
+            cmp = sched.debugger.compare()
+            assert not cmp.ok
+            assert cmp.redundant_nodes == ["ghost"]
+            sched.algorithm.refresh()
+            dump = sched.debugger.dump()
+            assert "ghost" in dump and "n1" in dump
+        finally:
+            sched.informers.stop()
