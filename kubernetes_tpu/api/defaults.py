@@ -85,9 +85,26 @@ def default(obj):
         if "kubernetes" not in obj.metadata.finalizers:
             obj.metadata.finalizers.append("kubernetes")
         return obj
+    if getattr(obj, "kind", "") == "Service":
+        if not obj.metadata.namespace:
+            obj.metadata.namespace = "default"  # BEFORE the ip hash
+        # ClusterIP allocation (ref: the service REST's ipallocator); a
+        # stable hash-derived address from the 10.96/12 service range.
+        # Collisions are resolved at create time (client.create salts).
+        if obj.spec.type == "ClusterIP" and not obj.spec.cluster_ip:
+            obj.spec.cluster_ip = service_cluster_ip(
+                obj.metadata.namespace, obj.metadata.name)
     meta = getattr(obj, "metadata", None)
     if meta is not None and not meta.namespace and getattr(obj, "kind", "") in (
             "Service", "Endpoints", "PersistentVolumeClaim", "Job", "CronJob",
             "PodDisruptionBudget", "Event", "ConfigMap", "Lease", "ReplicationController"):
         meta.namespace = "default"
     return obj
+
+
+def service_cluster_ip(namespace: str, name: str, salt: int = 0) -> str:
+    """Deterministic address in the 10.96/12 service range."""
+    import hashlib
+    h = int(hashlib.md5(
+        f"{namespace}/{name}/{salt}".encode()).hexdigest(), 16)
+    return f"10.{96 + (h >> 16) % 16}.{(h >> 8) % 256}.{h % 254 + 1}"

@@ -1,0 +1,35 @@
+"""kube-proxy entry point.
+
+Ref: cmd/kube-proxy — ProxyServer against the hub; the dataplane here is
+the inspectable fake (no kernel netfilter in scope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..apiserver.httpclient import HTTPClient
+from ..node.proxy import ProxyServer
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-proxy")
+    p.add_argument("--master", required=True)
+    args = p.parse_args(argv)
+    proxy = ProxyServer(HTTPClient(args.master)).start()
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,12 @@ class ControllerManager:
                  cronjob_period: float = 10.0):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
+        from ..api.core import ReplicationController
         self.replicaset = ReplicaSetController(client, self.informers)
+        # the rc controller is the same logic over ReplicationControllers
+        # (ref: pkg/controller/replication/conversion.go)
+        self.replication = ReplicaSetController(
+            client, self.informers, kind=ReplicationController)
         self.deployment = DeploymentController(client, self.informers)
         self.job = JobController(client, self.informers)
         self.statefulset = StatefulSetController(client, self.informers)
@@ -57,7 +62,8 @@ class ControllerManager:
             terminated_threshold=terminated_pod_gc_threshold,
             period=podgc_period)
         self.controllers: List = [
-            self.replicaset, self.deployment, self.job, self.statefulset,
+            self.replicaset, self.replication,
+            self.deployment, self.job, self.statefulset,
             self.daemonset, self.cronjob, self.endpoints,
             self.namespace, self.pv_binder, self.nodelifecycle,
             self.garbagecollector, self.podgc]

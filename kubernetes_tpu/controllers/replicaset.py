@@ -109,8 +109,19 @@ class ReplicaSetController(Controller):
         if rs is None:
             self.expectations.delete(key)
             return
-        sel = rs.spec.selector or LabelSelector(
-            match_labels=dict(rs.spec.template.metadata.labels))
+        if rs.spec.template is None:
+            # an RC without a template manages nothing, but its status must
+            # still observe the generation (rollout waiters poll it)
+            self._update_status(rs, [])
+            return
+        sel = rs.spec.selector
+        if isinstance(sel, dict):
+            # ReplicationController selectors are plain maps (the rc
+            # controller wraps the same logic, ref: replication/conversion.go)
+            sel = LabelSelector(match_labels=dict(sel)) if sel else None
+        if sel is None:
+            sel = LabelSelector(
+                match_labels=dict(rs.spec.template.metadata.labels))
         pods = self._claim_pods(rs, sel)
         active = [p for p in pods if pod_is_active(p)]
         if self.expectations.satisfied(key):
@@ -209,20 +220,24 @@ class ReplicaSetController(Controller):
         """Ref: updateReplicaSetStatus (replica_set_utils.go)."""
         ready = sum(1 for p in active if pod_is_ready(p))
         available = ready  # minReadySeconds elided: no per-pod ready clocks
+        tmpl_labels = rs.spec.template.metadata.labels \
+            if rs.spec.template is not None else {}
         fully_labeled = sum(
             1 for p in active
             if all(p.metadata.labels.get(k) == v
-                   for k, v in rs.spec.template.metadata.labels.items()))
+                   for k, v in tmpl_labels.items()))
         st = rs.status
+        has_fl = hasattr(st, "fully_labeled_replicas")  # RC status lacks it
         observed = rs.metadata.generation  # the generation THIS sync saw
         if (st.replicas == len(active) and st.ready_replicas == ready
                 and st.available_replicas == available
-                and st.fully_labeled_replicas == fully_labeled
+                and (not has_fl or st.fully_labeled_replicas == fully_labeled)
                 and st.observed_generation == observed):
             return
         def mutate(cur):
             cur.status.replicas = len(active)
-            cur.status.fully_labeled_replicas = fully_labeled
+            if has_fl:
+                cur.status.fully_labeled_replicas = fully_labeled
             cur.status.ready_replicas = ready
             cur.status.available_replicas = available
             cur.status.observed_generation = max(

@@ -12,11 +12,13 @@ scale testing uses.
     NodeAgent     agent.py    — register, heartbeat, pod sync loop
     CRI shapes    runtime.py  — ContainerRuntime interface + FakeRuntime
     HollowCluster hollow.py   — N hollow nodes in-process (pkg/kubemark)
+    ProxyServer   proxy.py    — service routing (pkg/proxy analog)
 """
 
 from .agent import NodeAgent
 from .hollow import HollowCluster
+from .proxy import FakeDataplane, ProxyServer
 from .runtime import ContainerRuntime, FakeRuntime, PodSandbox
 
-__all__ = ["ContainerRuntime", "FakeRuntime", "HollowCluster", "NodeAgent",
-           "PodSandbox"]
+__all__ = ["ContainerRuntime", "FakeDataplane", "FakeRuntime",
+           "HollowCluster", "NodeAgent", "PodSandbox", "ProxyServer"]

@@ -1,0 +1,161 @@
+"""Service proxy — the kube-proxy analog.
+
+Ref: pkg/proxy (iptables/proxier.go syncProxyRules :649): service and
+endpoints change trackers feed a bounded-frequency full-state rebuild
+that is handed to the dataplane in one shot (iptables-restore). The
+dataplane is an interface because the reference's is the kernel: the
+FakeDataplane configuration is pkg/kubemark's hollow proxy
+(hollow_proxy.go), and `route()` resolves a virtual service address to a
+backend endpoint the way the kernel DNAT would, with round-robin
+balancing across ready endpoints.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.core import Endpoints, Service
+from ..state.informer import EventHandlers, SharedInformerFactory
+
+
+@dataclass(frozen=True)
+class ServicePortRule:
+    namespace: str
+    name: str
+    port_name: str
+    protocol: str
+    cluster_ip: str
+    port: int
+    endpoints: Tuple[Tuple[str, int], ...]  # (ip, target port)
+
+
+class Dataplane:
+    """The kernel boundary (iptables-restore shape): receives the FULL
+    desired rule set each sync."""
+
+    def sync(self, rules: List[ServicePortRule]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FakeDataplane(Dataplane):
+    """Hollow dataplane: records the rule set (hollow_proxy.go's no-op
+    backend, but inspectable)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: List[ServicePortRule] = []
+        self.sync_count = 0
+
+    def sync(self, rules: List[ServicePortRule]) -> None:
+        with self._lock:
+            self.rules = rules
+            self.sync_count += 1
+
+
+class ProxyServer:
+    def __init__(self, client, informers: Optional[SharedInformerFactory] = None,
+                 dataplane: Optional[Dataplane] = None,
+                 min_sync_interval: float = 0.05):
+        from ..state.informer import SharedInformerFactory as SIF
+        self.client = client
+        self.informers = informers or SIF(client)
+        self.dataplane = dataplane or FakeDataplane()
+        self.min_sync_interval = min_sync_interval
+        self._own_informers = informers is None
+        self.svc_informer = self.informers.informer_for(Service)
+        self.ep_informer = self.informers.informer_for(Endpoints)
+        self._pending = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._rr: Dict[Tuple[str, str, str], int] = {}
+        self._rules: List[ServicePortRule] = []
+        mark = lambda *a: self._pending.set()
+        for inf in (self.svc_informer, self.ep_informer):
+            inf.add_event_handlers(EventHandlers(
+                on_add=mark, on_update=mark, on_delete=mark))
+
+    # -------------------------------------------------------------- sync
+
+    def sync_proxy_rules(self) -> List[ServicePortRule]:
+        """Full desired-state rebuild (ref: syncProxyRules — the whole
+        rule text is regenerated and swapped atomically)."""
+        rules: List[ServicePortRule] = []
+        for svc in self.svc_informer.indexer.list():
+            ep = self.ep_informer.indexer.get_by_key(svc.metadata.key())
+            for sp in svc.spec.ports:
+                backends: List[Tuple[str, int]] = []
+                if ep is not None:
+                    for subset in ep.subsets:
+                        port = next(
+                            (p.port for p in subset.ports
+                             if p.name == sp.name or not sp.name), None)
+                        if port is None:
+                            continue
+                        for addr in subset.addresses:
+                            backends.append((addr.ip, port))
+                rules.append(ServicePortRule(
+                    namespace=svc.metadata.namespace,
+                    name=svc.metadata.name,
+                    port_name=sp.name, protocol=sp.protocol,
+                    cluster_ip=svc.spec.cluster_ip or "",
+                    port=sp.port,
+                    endpoints=tuple(sorted(backends))))
+        with self._lock:
+            self._rules = rules
+        self.dataplane.sync(rules)
+        return rules
+
+    def route(self, namespace: str, service: str, port: int
+              ) -> Optional[Tuple[str, int]]:
+        """Resolve a virtual service port to one backend, round-robin over
+        ready endpoints (the DNAT + probability-match behavior)."""
+        with self._lock:
+            for r in self._rules:
+                if (r.namespace, r.name, r.port) == (namespace, service,
+                                                     port):
+                    if not r.endpoints:
+                        return None
+                    key = (namespace, service, r.port_name)
+                    i = self._rr.get(key, 0)
+                    self._rr[key] = i + 1
+                    return r.endpoints[i % len(r.endpoints)]
+        return None
+
+    # --------------------------------------------------------------- run
+
+    def start(self) -> "ProxyServer":
+        if self._own_informers:
+            self.informers.start()
+            self.informers.wait_for_cache_sync()
+        self.sync_proxy_rules()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kube-proxy")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        """BoundedFrequencyRunner shape: coalesce bursts of change events
+        into one full rebuild per interval."""
+        while not self._stop.is_set():
+            if not self._pending.wait(timeout=0.2):
+                continue
+            if self._stop.is_set():
+                return
+            self._pending.clear()
+            self._stop.wait(self.min_sync_interval)  # coalesce burst
+            try:
+                self.sync_proxy_rules()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pending.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if self._own_informers:
+            self.informers.stop()

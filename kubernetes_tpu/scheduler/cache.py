@@ -68,6 +68,15 @@ class Cache:
                 return list(self._pod_states)
             return [k for k in self._pod_states if k not in self._assumed]
 
+    def pod_keys_snapshot(self):
+        """(confirmed, assumed) under ONE lock acquisition — the comparer
+        needs both from the same instant or a bind between two calls makes
+        the race detector itself report a phantom divergence."""
+        with self._lock:
+            assumed = set(self._assumed)
+            confirmed = {k for k in self._pod_states if k not in assumed}
+            return confirmed, assumed
+
     def _bump(self, ni: NodeInfo) -> None:
         ni.generation = next(self._generation)
         # monotonic mutation counter: the pipelined drain chains device usage

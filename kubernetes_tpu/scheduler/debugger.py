@@ -43,12 +43,12 @@ class CacheDebugger:
         cache_nodes = set(sched.cache.node_names())
         res.missing_nodes = sorted(informer_nodes - cache_nodes)
         res.redundant_nodes = sorted(cache_nodes - informer_nodes)
+        from ..api import helpers
         informer_pods = {p.metadata.key() for p in
                          sched.informers.informer_for(Pod).indexer.list()
                          if p.spec.node_name
-                         and not _terminal(p)}
-        cache_pods = set(sched.cache.pod_keys(include_assumed=False))
-        assumed = set(sched.cache.pod_keys(include_assumed=True)) - cache_pods
+                         and not helpers.pod_is_terminal(p)}
+        cache_pods, assumed = sched.cache.pod_keys_snapshot()
         res.missing_pods = sorted(informer_pods - cache_pods - assumed)
         res.redundant_pods = sorted(cache_pods - informer_pods)
         return res
@@ -83,5 +83,3 @@ class CacheDebugger:
         signal.signal(signum, handler)
 
 
-def _terminal(pod) -> bool:
-    return pod.status.phase in ("Succeeded", "Failed")

@@ -45,9 +45,25 @@ class ResourceClient:
         if self._namespaced and not obj.metadata.namespace:
             obj.metadata.namespace = self._effective_ns()
         apply_defaults(obj)
+        if isinstance(obj, corev1.Service) and obj.spec.cluster_ip:
+            self._resolve_cluster_ip_collision(obj)
         if self._validate:
             validate_obj(obj)
         return self._store.create(self._resource, obj)
+
+    def _resolve_cluster_ip_collision(self, svc) -> None:
+        """The ipallocator's uniqueness guarantee: the hash-derived default
+        is salted until it collides with no existing service."""
+        from ..api.defaults import service_cluster_ip
+        taken = {s.spec.cluster_ip
+                 for s, _ in ((o, None) for o in
+                              self._store.list("services")[0])
+                 if s.metadata.key() != svc.metadata.key()}
+        salt = 0
+        while svc.spec.cluster_ip in taken and salt < 64:
+            salt += 1
+            svc.spec.cluster_ip = service_cluster_ip(
+                svc.metadata.namespace, svc.metadata.name, salt)
 
     def get(self, name: str, namespace: Optional[str] = None):
         ns = namespace if namespace is not None else self._effective_ns()

@@ -75,9 +75,10 @@ class EventRecorder:
         meta = getattr(obj, "metadata", None)
         ref = ObjectReference(
             kind=getattr(obj, "kind", ""),
-            namespace=meta.namespace if meta else "",
+            namespace=meta.namespace if meta
+            else getattr(obj, "namespace", ""),
             name=meta.name if meta else getattr(obj, "name", ""),
-            uid=meta.uid if meta else "")
+            uid=meta.uid if meta else getattr(obj, "uid", ""))
         ns = ref.namespace or "default"
         spam_key = (ns, ref.uid or ref.name)
         agg_key = (ns, ref.uid or ref.name, reason)

@@ -1,0 +1,112 @@
+"""Service proxy (kube-proxy analog) + ReplicationController tests."""
+
+import time
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.node import HollowCluster
+from kubernetes_tpu.node.proxy import FakeDataplane, ProxyServer
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Client
+
+
+def pod_spec():
+    return api.PodSpec(containers=[api.Container(
+        name="c", image="img",
+        resources=api.ResourceRequirements(
+            requests={"cpu": Quantity("50m"), "memory": Quantity("32Mi")}))])
+
+
+def wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return fn()
+
+
+class TestProxy:
+    def test_rules_follow_service_and_endpoints(self):
+        client = Client()
+        hollow = HollowCluster(client, n_nodes=2)
+        sched = Scheduler(client, batch_size=8)
+        mgr = ControllerManager(client)
+        proxy = ProxyServer(client, dataplane=FakeDataplane())
+        hollow.start()
+        mgr.start()
+        sched.start()
+        proxy.start()
+        try:
+            svc = client.services("default").create(api.Service(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ServiceSpec(selector={"app": "web"},
+                                     ports=[api.ServicePort(port=80)])))
+            assert svc.spec.cluster_ip.startswith("10.")  # allocated
+            client.replica_sets("default").create(api.ReplicaSet(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=3,
+                    selector=api.LabelSelector(match_labels={"app": "web"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=pod_spec()))))
+
+            def three_backends():
+                rule = next((r for r in proxy.dataplane.rules
+                             if r.name == "web"), None)
+                return rule is not None and len(rule.endpoints) == 3
+            assert wait_for(three_backends, timeout=60)
+            # round-robin over distinct backends
+            picks = {proxy.route("default", "web", 80) for _ in range(9)}
+            assert len(picks) == 3
+            # scale down: the rule set follows
+            def scale(cur):
+                cur.spec.replicas = 1
+                return cur
+            client.replica_sets("default").patch("web", scale)
+            assert wait_for(lambda: len(next(
+                r for r in proxy.dataplane.rules
+                if r.name == "web").endpoints) == 1, timeout=30)
+            # delete the service: rule disappears
+            client.services("default").delete("web")
+            assert wait_for(lambda: not any(
+                r.name == "web" for r in proxy.dataplane.rules), timeout=30)
+        finally:
+            proxy.stop()
+            sched.stop()
+            mgr.stop()
+            hollow.stop()
+
+
+class TestReplicationController:
+    def test_rc_reconciles_with_map_selector(self):
+        client = Client()
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.replication_controllers("default").create(
+                api.ReplicationController(
+                    metadata=api.ObjectMeta(name="legacy",
+                                            namespace="default"),
+                    spec=api.core.ReplicationControllerSpec(
+                        replicas=2, selector={"app": "legacy"},
+                        template=api.PodTemplateSpec(
+                            metadata=api.ObjectMeta(
+                                labels={"app": "legacy"}),
+                            spec=pod_spec()))))
+            assert wait_for(
+                lambda: len(client.pods("default").list()) == 2)
+            pod = client.pods("default").list()[0]
+            ref = api.controller_ref(pod.metadata)
+            assert ref is not None and ref.kind == "ReplicationController"
+            # status reconciled
+            assert wait_for(lambda: client.replication_controllers(
+                "default").get("legacy").status.replicas == 2)
+            # delete -> GC cascade
+            client.replication_controllers("default").delete("legacy")
+            assert wait_for(lambda: not client.pods("default").list(),
+                            timeout=20)
+        finally:
+            mgr.stop()
