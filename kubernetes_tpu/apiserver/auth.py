@@ -1,0 +1,100 @@
+"""Authentication + authorization for the API server.
+
+Ref: apiserver/pkg/authentication (bearer-token authenticator,
+user.Info), apiserver/pkg/authorization + plugin/pkg/auth/authorizer/rbac
+(rules resolved from Role/ClusterRole bindings; here the policy objects
+are plain config entries rather than stored API objects, the static-file
+authorizer shape), and the handler chain's authn->authz slots
+(server/config.go:543-557). Anonymous requests map to system:anonymous,
+which a policy may or may not grant (same default-deny as RBAC).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    """Ref: k8s.io/apiserver/pkg/authentication/user.Info."""
+    name: str
+    groups: Tuple[str, ...] = ()
+
+
+ANONYMOUS = UserInfo("system:anonymous", ("system:unauthenticated",))
+
+
+class TokenAuthenticator:
+    """Static bearer tokens (the --token-auth-file shape)."""
+
+    def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None):
+        self._tokens = dict(tokens or {})
+
+    def add(self, token: str, user: UserInfo) -> None:
+        self._tokens[token] = user
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        """Returns the user, ANONYMOUS for no credentials, or None for BAD
+        credentials (401)."""
+        if not authorization_header:
+            return ANONYMOUS
+        scheme, _, token = authorization_header.partition(" ")
+        if scheme.lower() != "bearer" or not token:
+            return None
+        return self._tokens.get(token.strip())
+
+
+@dataclass
+class PolicyRule:
+    """Ref: rbac.PolicyRule — verbs x resources (+ optional namespace
+    scoping, the RoleBinding analog). '*' wildcards."""
+    verbs: Tuple[str, ...]
+    resources: Tuple[str, ...]
+    namespaces: Tuple[str, ...] = ("*",)
+
+    def matches(self, verb: str, resource: str, namespace: str) -> bool:
+        return (("*" in self.verbs or verb in self.verbs)
+                and ("*" in self.resources or resource in self.resources)
+                and ("*" in self.namespaces
+                     or (namespace or "*") in self.namespaces))
+
+
+class RBACAuthorizer:
+    """Subject (user or group) -> rules; default deny (ref: rbac's
+    RuleResolver + the union authorizer's NoOpinion fallthrough)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subject_rules: Dict[str, List[PolicyRule]] = {}
+
+    def grant(self, subject: str, verbs, resources,
+              namespaces=("*",)) -> None:
+        """subject is a user name or 'group:<name>'."""
+        rule = PolicyRule(tuple(verbs), tuple(resources), tuple(namespaces))
+        with self._lock:
+            self._subject_rules.setdefault(subject, []).append(rule)
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str) -> bool:
+        with self._lock:
+            subjects = [user.name] + [f"group:{g}" for g in user.groups]
+            for s in subjects:
+                for rule in self._subject_rules.get(s, ()):
+                    if rule.matches(verb, resource, namespace):
+                        return True
+        return False
+
+
+#: HTTP method -> RBAC verb (ref: endpoints/request RequestInfo verbs)
+VERB_OF = {"GET": "get", "POST": "create", "PUT": "update",
+           "DELETE": "delete", "PATCH": "patch"}
+
+
+def request_verb(method: str, is_watch: bool, has_name: bool) -> str:
+    if method == "GET":
+        if is_watch:
+            return "watch"
+        return "get" if has_name else "list"
+    return VERB_OF.get(method, method.lower())

@@ -31,6 +31,10 @@ def _raise_for(status: int, body: str) -> None:
         msg = json.loads(body).get("message", body)
     except Exception:
         msg = body
+    if status == 401:
+        raise PermissionError(f"Unauthorized: {msg}")
+    if status == 403:
+        raise PermissionError(f"Forbidden: {msg}")
     if status == 404:
         raise NotFoundError(msg)
     if status == 410:
@@ -92,10 +96,12 @@ class _HTTPWatch:
 
 class HTTPResourceClient:
     def __init__(self, base_url: str, scheme: Scheme, cls: Type,
-                 namespace: Optional[str] = None):
+                 namespace: Optional[str] = None,
+                 token: Optional[str] = None):
         self._base = base_url.rstrip("/")
         self._scheme = scheme
         self._cls = cls
+        self._token = token
         self._resource = scheme.resource_for(cls)
         self._namespaced = scheme.is_namespaced(cls)
         self._ns = namespace if self._namespaced else ""
@@ -120,10 +126,16 @@ class HTTPResourceClient:
             path += f"?{query}"
         return self._base + path
 
+    def _headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        return headers
+
     def _request(self, method: str, url: str, body: Any = None):
         data = serde.to_json_str(body).encode() if body is not None else None
         req = urlrequest.Request(url, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
+                                 headers=self._headers())
         try:
             with urlrequest.urlopen(req) as resp:
                 return json.loads(resp.read())
@@ -206,7 +218,7 @@ class HTTPResourceClient:
         if resource_version is not None:
             query += f"&resourceVersion={resource_version}"
         url = self._url(namespace=ns or "", query=query)
-        req = urlrequest.Request(url)
+        req = urlrequest.Request(url, headers=self._headers())
         try:
             resp = urlrequest.urlopen(req)
         except urlerror.HTTPError as e:
@@ -234,16 +246,21 @@ class HTTPPodClient(HTTPResourceClient):
 
 
 class HTTPClient:
-    """Drop-in for state.client.Client over REST."""
+    """Drop-in for state.client.Client over REST. `token` sends bearer
+    credentials (the kubeconfig token shape)."""
 
-    def __init__(self, base_url: str, scheme: Scheme = SCHEME):
+    def __init__(self, base_url: str, scheme: Scheme = SCHEME,
+                 token: Optional[str] = None):
         self.base_url = base_url
         self.scheme = scheme
+        self.token = token
 
     def resource(self, cls: Type, namespace: Optional[str] = None):
         if cls is corev1.Pod:
-            return HTTPPodClient(self.base_url, self.scheme, cls, namespace)
-        return HTTPResourceClient(self.base_url, self.scheme, cls, namespace)
+            return HTTPPodClient(self.base_url, self.scheme, cls, namespace,
+                                 token=self.token)
+        return HTTPResourceClient(self.base_url, self.scheme, cls, namespace,
+                                  token=self.token)
 
     def __getattr__(self, name):
         """Convenience accessors (pods(), nodes(), ...) mirror Client's by

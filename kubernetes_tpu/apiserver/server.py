@@ -83,6 +83,10 @@ class APIServer:
         self.store = self.client.store
         self.scheme = scheme
         self.admission = AdmissionChain()
+        #: optional authn/authz (ref: DefaultBuildHandlerChain slots at
+        #: config.go:543-557); None = open hub (the insecure port shape)
+        self.authenticator = None
+        self.authorizer = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -170,6 +174,8 @@ class APIServer:
                 self._error(h, 404, "NotFound",
                             f"unknown resource {req.resource}")
                 return
+            if not self._authorized(h, method, req):
+                return  # 401/403 already written
             self._handle(h, method, req, cls)
         except ExpiredError as e:
             # 410 Gone: the reflector must relist (reflector.go:159)
@@ -192,6 +198,35 @@ class APIServer:
                 pass
 
     # ------------------------------------------------------------- handlers
+
+    def _authorized(self, h, method: str, req: _Request) -> bool:
+        """authn then authz (ref: the chain's ordering — a bad token is 401
+        before any authorization opinion; default deny once enabled)."""
+        if self.authenticator is None:
+            return True
+        from .auth import request_verb
+        user = self.authenticator.authenticate(
+            h.headers.get("Authorization", ""))
+        if user is None:
+            self._error(h, 401, "Unauthorized", "invalid bearer token")
+            return False
+        if self.authorizer is not None:
+            verb = request_verb(method, req.query.get("watch") in
+                                ("true", "1"), bool(req.name))
+            # subresources authorize as resource/subresource (the RBAC
+            # model: pods/binding and pods/status are distinct privileges)
+            resource = req.resource
+            if req.subresource:
+                resource = f"{req.resource}/{req.subresource}"
+            if not self.authorizer.authorize(user, verb, resource,
+                                             req.namespace):
+                self._error(
+                    h, 403, "Forbidden",
+                    f'user "{user.name}" cannot {verb} {resource}'
+                    + (f' in namespace "{req.namespace}"'
+                       if req.namespace else ""))
+                return False
+        return True
 
     def _rc(self, cls, namespace: str):
         return self.client.resource(cls, namespace or None)

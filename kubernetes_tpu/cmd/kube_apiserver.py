@@ -24,6 +24,9 @@ def main(argv=None) -> int:
                    help="fdatasync each transaction")
     p.add_argument("--wal-compact-bytes", type=int, default=64 << 20,
                    help="compact the WAL when it exceeds this size")
+    p.add_argument("--token-auth-file", default=None,
+                   help="CSV token,user[,uid],group1;group2 — enables authn "
+                        "(+ default-deny RBAC; system:masters gets all)")
     args = p.parse_args(argv)
     store = None
     wal_file = None
@@ -35,7 +38,34 @@ def main(argv=None) -> int:
         wal_file = os.path.join(args.data_dir, "store.wal")
         store = Store(wal_path=wal_file, wal_sync=args.wal_sync)
     srv = APIServer(store=store, host=args.bind_address,
-                    port=args.port).start()
+                    port=args.port)
+    if args.token_auth_file:
+        from ..apiserver.auth import (RBACAuthorizer, TokenAuthenticator,
+                                      UserInfo)
+        authn = TokenAuthenticator()
+        with open(args.token_auth_file) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                fields = [x.strip() for x in line.split(",")]
+                if len(fields) < 2:
+                    print(f"skipping malformed token line: {line!r}",
+                          flush=True)
+                    continue
+                token, user = fields[0], fields[1]
+                # 3 fields = token,user,groups; 4+ = token,user,uid,groups
+                # (the reference's --token-auth-file CSV)
+                groups_field = fields[3] if len(fields) >= 4 else (
+                    fields[2] if len(fields) == 3 else "")
+                authn.add(token, UserInfo(
+                    user, tuple(g for g in groups_field.split(";") if g)))
+        authz = RBACAuthorizer()
+        # the bootstrap superuser binding (ref: system:masters)
+        authz.grant("group:system:masters", ["*"], ["*"])
+        srv.authenticator = authn
+        srv.authorizer = authz
+    srv.start()
     compactor = None
     if store is not None:
         import os

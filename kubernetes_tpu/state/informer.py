@@ -139,11 +139,23 @@ class SharedInformer:
                 self._watch.stop()
 
     def _run(self) -> None:
+        auth_error_logged = False
         while not self._stop.is_set():
             try:
                 self._list_and_watch()
             except ExpiredError:
                 continue  # relist (ref: reflector resourceVersion-too-old path)
+            except PermissionError as e:
+                # credential failures are not transient: surface once and
+                # back off hard instead of hammering the hub at 20 req/s
+                if not auth_error_logged:
+                    import sys
+                    print(f"informer auth failure (will retry): {e}",
+                          file=sys.stderr)
+                    auth_error_logged = True
+                if self._stop.is_set():
+                    return
+                self._stop.wait(5.0)
             except Exception:
                 if self._stop.is_set():
                     return

@@ -187,3 +187,82 @@ class TestHubAndSpoke:
             time.sleep(0.05)
         assert "via-http" in seen
         factory.stop()
+
+
+class TestAuth:
+    def _secure_server(self):
+        from kubernetes_tpu.apiserver.auth import (RBACAuthorizer,
+                                                   TokenAuthenticator,
+                                                   UserInfo)
+        srv = APIServer()
+        authn = TokenAuthenticator({
+            "admin-token": UserInfo("admin", ("system:masters",)),
+            "sched-token": UserInfo("system:kube-scheduler", ()),
+            "viewer-token": UserInfo("viewer", ("readers",)),
+        })
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        authz.grant("system:kube-scheduler",
+                    ["get", "list", "watch", "create", "update", "patch"],
+                    ["pods", "pods/binding", "pods/status", "nodes",
+                     "events"])
+        authz.grant("group:readers", ["get", "list", "watch"], ["pods"],
+                    namespaces=("default",))
+        srv.authenticator = authn
+        srv.authorizer = authz
+        return srv.start()
+
+    def test_authn_and_rbac(self):
+        srv = self._secure_server()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            admin.nodes().create(make_node("n1"))
+            admin.pods("default").create(make_pod("p1"))
+            # bad token -> 401
+            with pytest.raises(PermissionError) as e:
+                HTTPClient(srv.address, token="wrong").pods("default").list()
+            assert "Unauthorized" in str(e.value)
+            # anonymous -> default deny (403)
+            with pytest.raises(PermissionError) as e:
+                HTTPClient(srv.address).pods("default").list()
+            assert "Forbidden" in str(e.value)
+            # scoped user: reads allowed, writes denied
+            viewer = HTTPClient(srv.address, token="viewer-token")
+            assert [p.metadata.name
+                    for p in viewer.pods("default").list()] == ["p1"]
+            with pytest.raises(PermissionError):
+                viewer.pods("default").delete("p1")
+            with pytest.raises(PermissionError):
+                viewer.nodes().get("n1")  # resource outside the grant
+            # the scheduler's service account can bind
+            sched = HTTPClient(srv.address, token="sched-token")
+            sched.pods("default").bind(api.Binding(
+                metadata=api.ObjectMeta(name="p1", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="n1")))
+            assert admin.pods("default").get("p1").spec.node_name == "n1"
+        finally:
+            srv.stop()
+
+    def test_scheduler_runs_with_credentials(self):
+        """The full scheduler works against a locked-down hub using its
+        token (the kubeconfig shape)."""
+        srv = self._secure_server()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            admin.nodes().create(make_node("n1"))
+            sched = Scheduler(HTTPClient(srv.address, token="sched-token"),
+                              batch_size=8)
+            sched.start()
+            try:
+                admin.pods("default").create(make_pod("w1"))
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if admin.pods("default").get("w1").spec.node_name:
+                        break
+                    time.sleep(0.05)
+                assert admin.pods("default").get(
+                    "w1").spec.node_name == "n1"
+            finally:
+                sched.stop()
+        finally:
+            srv.stop()
