@@ -87,6 +87,8 @@ class APIServer:
         #: config.go:543-557); None = open hub (the insecure port shape)
         self.authenticator = None
         self.authorizer = None
+        self._bootstrap_namespaces()
+        self.admission.validators.append(self._namespace_lifecycle)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -110,6 +112,40 @@ class APIServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+
+    def _bootstrap_namespaces(self) -> None:
+        """The system namespaces every cluster has (ref: the apiserver's
+        bootstrap controller creating default/kube-system/kube-public)."""
+        from ..api.core import Namespace
+        from ..api.meta import ObjectMeta
+        for name in ("default", "kube-system", "kube-node-lease",
+                     "kube-public"):
+            try:
+                self.client.namespaces().create(
+                    Namespace(metadata=ObjectMeta(name=name)))
+            except AlreadyExistsError:
+                pass  # WAL replay already restored it
+
+    def _namespace_lifecycle(self, operation: str, resource: str,
+                             obj) -> None:
+        """The NamespaceLifecycle admission plugin (ref: plugin/pkg/
+        admission/namespace/lifecycle): creates into a terminating or
+        missing namespace are rejected."""
+        if operation != "CREATE" or resource == "namespaces":
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns:
+            return  # cluster-scoped
+        try:
+            cur = self.client.namespaces().get(ns)
+        except NotFoundError:
+            raise AdmissionDenied(
+                f'namespace "{ns}" not found')
+        if cur.metadata.deletion_timestamp is not None or \
+                cur.status.phase == "Terminating":
+            raise AdmissionDenied(
+                f'unable to create new content in namespace "{ns}" because '
+                f"it is being terminated")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -266,6 +302,13 @@ class APIServer:
                 return
             obj = self.scheme.decode_any(data) if "kind" in data \
                 else serde.decode(cls, data)
+            # the URL's namespace is authoritative when the body omits it
+            # (ref: admission.Attributes carries request-info, not body);
+            # admission must see the effective namespace or a namespace-
+            # scoped policy is bypassed by simply omitting the field
+            if req.namespace and hasattr(obj, "metadata") \
+                    and not obj.metadata.namespace:
+                obj.metadata.namespace = req.namespace
             if not isinstance(obj, cls):
                 # a body of the wrong kind must not land in this resource's
                 # bucket (it would poison every watcher of the resource)
@@ -286,6 +329,15 @@ class APIServer:
                 out = rc.update(obj)
             self._respond(h, 200, out)
         elif method == "DELETE":
+            if req.resource == "namespaces" and req.name in (
+                    "default", "kube-system", "kube-node-lease",
+                    "kube-public"):
+                # the immortal namespaces (ref: the lifecycle plugin's
+                # immortalNamespaces set): deleting one would terminate it
+                # forever — bootstrap can't resurrect a Terminating object
+                self._error(h, 403, "Forbidden",
+                            f'namespace "{req.name}" cannot be deleted')
+                return
             out = rc.delete(req.name, namespace=req.namespace or None,
                             resource_version=req.query.get("resourceVersion"))
             self._respond(h, 200, out)

@@ -189,6 +189,29 @@ class TestHubAndSpoke:
         factory.stop()
 
 
+class TestNamespaceLifecycle:
+    def test_bootstrap_and_terminating_rejection(self, server):
+        client = HTTPClient(server.address)
+        # system namespaces bootstrapped (apiserver bootstrap controller)
+        names = {n.metadata.name for n in client.namespaces().list()}
+        assert {"default", "kube-system", "kube-node-lease"} <= names
+        # creating into a missing namespace is denied
+        pod = make_pod("lost")
+        pod.metadata.namespace = "no-such-ns"
+        with pytest.raises(Exception) as e:
+            client.pods("no-such-ns").create(pod)
+        assert "not found" in str(e.value)
+        # creating into a terminating namespace is denied
+        client.namespaces().create(api.Namespace(
+            metadata=api.ObjectMeta(name="dying")))
+        client.namespaces().delete("dying")  # finalizer -> Terminating
+        pod2 = make_pod("late")
+        pod2.metadata.namespace = "dying"
+        with pytest.raises(Exception) as e:
+            client.pods("dying").create(pod2)
+        assert "terminated" in str(e.value)
+
+
 class TestAuth:
     def _secure_server(self):
         from kubernetes_tpu.apiserver.auth import (RBACAuthorizer,
