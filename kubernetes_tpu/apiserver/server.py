@@ -302,12 +302,19 @@ class APIServer:
                 return
             obj = self.scheme.decode_any(data) if "kind" in data \
                 else serde.decode(cls, data)
-            # the URL's namespace is authoritative when the body omits it
-            # (ref: admission.Attributes carries request-info, not body);
-            # admission must see the effective namespace or a namespace-
-            # scoped policy is bypassed by simply omitting the field
-            if req.namespace and hasattr(obj, "metadata") \
-                    and not obj.metadata.namespace:
+            # the URL's namespace is authoritative (ref: the apiserver
+            # rejects URL/body disagreement with 400): a body targeting a
+            # different namespace than the one the request was authorized
+            # and lifecycle-checked under must not win
+            if req.namespace and hasattr(obj, "metadata"):
+                if obj.metadata.namespace and \
+                        obj.metadata.namespace != req.namespace:
+                    self._error(
+                        h, 422, "Invalid",
+                        f"the namespace of the object "
+                        f"({obj.metadata.namespace}) does not match the "
+                        f"namespace on the request ({req.namespace})")
+                    return
                 obj.metadata.namespace = req.namespace
             if not isinstance(obj, cls):
                 # a body of the wrong kind must not land in this resource's

@@ -101,8 +101,16 @@ class ResourceClient:
         return self._store.guaranteed_update(
             self._resource, ns if self._namespaced else "", name, mutate)
 
+    #: ref: the lifecycle plugin's immortalNamespaces — a finalizer-gated
+    #: Terminating system namespace would be unrecoverable
+    IMMORTAL_NAMESPACES = ("default", "kube-system", "kube-node-lease",
+                           "kube-public")
+
     def delete(self, name: str, namespace: Optional[str] = None,
                resource_version: Optional[str] = None):
+        if self._resource == "namespaces" and name in self.IMMORTAL_NAMESPACES:
+            raise PermissionError(
+                f'namespace "{name}" cannot be deleted')
         ns = namespace if namespace is not None else self._effective_ns()
         return self._store.delete(self._resource, ns if self._namespaced else "",
                                   name, resource_version=resource_version)
