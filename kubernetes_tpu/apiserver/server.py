@@ -210,9 +210,10 @@ class APIServer:
                 self._error(h, 404, "NotFound",
                             f"unknown resource {req.resource}")
                 return
-            if not self._authorized(h, method, req):
+            ok, user = self._authorized(h, method, req)
+            if not ok:
                 return  # 401/403 already written
-            self._handle(h, method, req, cls)
+            self._handle(h, method, req, cls, user)
         except ExpiredError as e:
             # 410 Gone: the reflector must relist (reflector.go:159)
             self._error(h, 410, "Expired", str(e))
@@ -235,17 +236,18 @@ class APIServer:
 
     # ------------------------------------------------------------- handlers
 
-    def _authorized(self, h, method: str, req: _Request) -> bool:
+    def _authorized(self, h, method: str, req: _Request):
         """authn then authz (ref: the chain's ordering — a bad token is 401
-        before any authorization opinion; default deny once enabled)."""
+        before any authorization opinion; default deny once enabled).
+        Returns (ok, user); user is None in open-hub mode."""
         if self.authenticator is None:
-            return True
+            return True, None
         from .auth import request_verb
         user = self.authenticator.authenticate(
             h.headers.get("Authorization", ""))
         if user is None:
             self._error(h, 401, "Unauthorized", "invalid bearer token")
-            return False
+            return False, None
         if self.authorizer is not None:
             verb = request_verb(method, req.query.get("watch") in
                                 ("true", "1"), bool(req.name))
@@ -254,14 +256,38 @@ class APIServer:
             resource = req.resource
             if req.subresource:
                 resource = f"{req.resource}/{req.subresource}"
-            if not self.authorizer.authorize(user, verb, resource,
-                                             req.namespace):
+            if not self._check_authz(h, user, verb, resource, req.namespace):
+                return False, user
+        return True, user
+
+    def _check_authz(self, h, user, verb: str, resource: str,
+                     namespace: str) -> bool:
+        if self.authorizer is None or user is None:
+            return True
+        if not self.authorizer.authorize(user, verb, resource, namespace):
+            self._error(
+                h, 403, "Forbidden",
+                f'user "{user.name}" cannot {verb} {resource}'
+                + (f' in namespace "{namespace}"' if namespace else ""))
+            return False
+        return True
+
+    def _enforce_namespace(self, h, req: _Request, obj) -> bool:
+        """The URL's namespace is authoritative on every write verb (ref:
+        the apiserver rejects URL/body disagreement): a body naming another
+        namespace than the one the request was authorized and
+        lifecycle-checked under must not win. Returns False after writing
+        the 422."""
+        if req.namespace and hasattr(obj, "metadata"):
+            if obj.metadata.namespace and \
+                    obj.metadata.namespace != req.namespace:
                 self._error(
-                    h, 403, "Forbidden",
-                    f'user "{user.name}" cannot {verb} {resource}'
-                    + (f' in namespace "{req.namespace}"'
-                       if req.namespace else ""))
+                    h, 422, "Invalid",
+                    f"the namespace of the object "
+                    f"({obj.metadata.namespace}) does not match the "
+                    f"namespace on the request ({req.namespace})")
                 return False
+            obj.metadata.namespace = req.namespace
         return True
 
     def _rc(self, cls, namespace: str):
@@ -271,7 +297,7 @@ class APIServer:
         length = int(h.headers.get("Content-Length", 0))
         return json.loads(h.rfile.read(length)) if length else None
 
-    def _handle(self, h, method: str, req: _Request, cls) -> None:
+    def _handle(self, h, method: str, req: _Request, cls, user=None) -> None:
         rc = self._rc(cls, req.namespace)
         if method == "GET":
             if req.name:
@@ -293,29 +319,35 @@ class APIServer:
             if data is None:
                 self._error(h, 422, "Invalid", "empty request body")
                 return
-            if req.subresource == "binding" or (
+            if (req.resource == "pods" and req.subresource == "binding") or (
                     req.resource == "pods" and not req.name and
                     data and data.get("kind") == "Binding"):
                 binding = serde.decode(Binding, data)
+                if req.name and binding.metadata.name and \
+                        binding.metadata.name != req.name:
+                    # the URL's name is as authoritative as its namespace:
+                    # a stale body must not silently bind a different pod
+                    self._error(h, 422, "Invalid",
+                                f"the name of the object "
+                                f"({binding.metadata.name}) does not match "
+                                f"the name on the request ({req.name})")
+                    return
+                if not req.subresource:
+                    # a Binding posted to the bare pods collection is still
+                    # the bind privilege: authorize as pods/binding, not
+                    # pods create (RBAC treats them as distinct)
+                    if not self._check_authz(h, user, "create",
+                                             "pods/binding", req.namespace):
+                        return
+                if not self._enforce_namespace(h, req, binding):
+                    return
                 out = self.client.pods(req.namespace or None).bind(binding)
                 self._respond(h, 201, out)
                 return
             obj = self.scheme.decode_any(data) if "kind" in data \
                 else serde.decode(cls, data)
-            # the URL's namespace is authoritative (ref: the apiserver
-            # rejects URL/body disagreement with 400): a body targeting a
-            # different namespace than the one the request was authorized
-            # and lifecycle-checked under must not win
-            if req.namespace and hasattr(obj, "metadata"):
-                if obj.metadata.namespace and \
-                        obj.metadata.namespace != req.namespace:
-                    self._error(
-                        h, 422, "Invalid",
-                        f"the namespace of the object "
-                        f"({obj.metadata.namespace}) does not match the "
-                        f"namespace on the request ({req.namespace})")
-                    return
-                obj.metadata.namespace = req.namespace
+            if not self._enforce_namespace(h, req, obj):
+                return
             if not isinstance(obj, cls):
                 # a body of the wrong kind must not land in this resource's
                 # bucket (it would poison every watcher of the resource)
@@ -328,7 +360,19 @@ class APIServer:
             self._respond(h, 201, out)
         elif method == "PUT":
             data = self._read_body(h)
+            if data is None:
+                self._error(h, 422, "Invalid", "empty request body")
+                return
             obj = serde.decode(cls, data)
+            if req.name and getattr(obj.metadata, "name", "") and \
+                    obj.metadata.name != req.name:
+                self._error(h, 422, "Invalid",
+                            f"the name of the object ({obj.metadata.name}) "
+                            f"does not match the name on the request "
+                            f"({req.name})")
+                return
+            if not self._enforce_namespace(h, req, obj):
+                return
             if req.subresource == "status":
                 out = rc.update_status(obj)
             else:

@@ -122,6 +122,13 @@ class Store:
         from .wal import load_wal
         records, clean_offset = load_wal(path)
         for rec in records:
+            if rec["op"] == "META":
+                # compaction high-water marker: restores the true _rv even
+                # when the highest-rv writes were deletes or compacted away
+                # (etcd revisions never regress across snapshot+restart)
+                self._rv = max(self._rv, rec["rv"])
+                self._uid_counter = max(self._uid_counter, rec.get("uc", 0))
+                continue
             cls = SCHEME.type_for_resource(rec["resource"])
             if cls is None:
                 continue
@@ -164,6 +171,12 @@ class Store:
             if os.path.exists(tmp):
                 os.remove(tmp)
             w = WalWriter(tmp, sync=True)
+            # persist the resourceVersion high-water mark FIRST: the live
+            # objects' max rv undercounts whenever the newest writes were
+            # deletes, and a regressed counter would reissue rvs that
+            # watchers/CAS callers already observed
+            w.append("META", "", self._rv, None,
+                     uid_counter=self._uid_counter)
             for resource, bucket in self._data.items():
                 for (ns, name), (obj, rv) in bucket.items():
                     w.append("PUT", resource, rv, serde.encode(obj),

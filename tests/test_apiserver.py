@@ -266,6 +266,99 @@ class TestAuth:
         finally:
             srv.stop()
 
+    def test_put_cannot_cross_namespaces(self):
+        """A subject granted update only in namespace A must not mutate B
+        via a PUT to A's URL whose body names B (the URL namespace is what
+        authorization ran against)."""
+        from kubernetes_tpu.apiserver.auth import (RBACAuthorizer,
+                                                   TokenAuthenticator,
+                                                   UserInfo)
+        srv = APIServer()
+        authn = TokenAuthenticator({
+            "admin-token": UserInfo("admin", ("system:masters",)),
+            "a-token": UserInfo("a-user", ()),
+        })
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        authz.grant("a-user", ["get", "update"], ["pods"],
+                    namespaces=("ns-a",))
+        srv.authenticator = authn
+        srv.authorizer = authz
+        srv.start()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            admin.namespaces().create(api.Namespace(
+                metadata=api.ObjectMeta(name="ns-a")))
+            admin.namespaces().create(api.Namespace(
+                metadata=api.ObjectMeta(name="ns-b")))
+            pa = make_pod("p")
+            pa.metadata.namespace = "ns-a"
+            admin.pods("ns-a").create(pa)
+            pb = make_pod("p")
+            pb.metadata.namespace = "ns-b"
+            pb.metadata.labels["victim"] = "true"
+            admin.pods("ns-b").create(pb)
+            # hand-craft the attack: PUT to ns-a URL, body names ns-b
+            cur = admin.pods("ns-b").get("p")
+            body = json.dumps({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p", "namespace": "ns-b",
+                             "resourceVersion":
+                                 cur.metadata.resource_version,
+                             "labels": {"owned": "yes"}},
+                "spec": {"containers": [{"name": "c", "image": "evil"}]},
+            }).encode()
+            req = urllib.request.Request(
+                srv.address + "/api/v1/namespaces/ns-a/pods/p",
+                data=body, method="PUT",
+                headers={"Authorization": "Bearer a-token",
+                         "Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 422
+            # ns-b untouched
+            assert admin.pods("ns-b").get("p").metadata.labels.get(
+                "victim") == "true"
+            assert "owned" not in admin.pods("ns-b").get("p").metadata.labels
+        finally:
+            srv.stop()
+
+    def test_collection_post_binding_needs_bind_privilege(self):
+        """kind=Binding POSTed to the bare pods collection authorizes as
+        pods/binding, not pod create (they are distinct RBAC privileges)."""
+        from kubernetes_tpu.apiserver.auth import (RBACAuthorizer,
+                                                   TokenAuthenticator,
+                                                   UserInfo)
+        srv = APIServer()
+        authn = TokenAuthenticator({
+            "admin-token": UserInfo("admin", ("system:masters",)),
+            "creator-token": UserInfo("creator", ()),
+        })
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        authz.grant("creator", ["create", "get"], ["pods"])  # NOT pods/binding
+        srv.authenticator = authn
+        srv.authorizer = authz
+        srv.start()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            admin.pods("default").create(make_pod("p1"))
+            body = json.dumps({
+                "apiVersion": "v1", "kind": "Binding",
+                "metadata": {"name": "p1", "namespace": "default"},
+                "target": {"kind": "Node", "name": "n1"}}).encode()
+            req = urllib.request.Request(
+                srv.address + "/api/v1/namespaces/default/pods",
+                data=body, method="POST",
+                headers={"Authorization": "Bearer creator-token",
+                         "Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 403
+            assert admin.pods("default").get("p1").spec.node_name == ""
+        finally:
+            srv.stop()
+
     def test_scheduler_runs_with_credentials(self):
         """The full scheduler works against a locked-down hub using its
         token (the kubeconfig shape)."""

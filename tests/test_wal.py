@@ -114,11 +114,33 @@ class TestWal:
         store.compact()
         assert os.path.getsize(path) < size_before
         records = list(read_wal(path))
-        assert all(r["op"] == "PUT" for r in records)
+        assert records[0]["op"] == "META"  # rv high-water marker leads
+        assert all(r["op"] == "PUT" for r in records[1:])
         store.close()
         store2 = Store(wal_path=path)
         pods = Client(store2).pods("default").list()
         assert [p.metadata.name for p in pods] == ["p19"]
+        store2.close()
+
+    def test_compaction_preserves_rv_high_water(self, tmp_path):
+        """Deletes carry the highest rvs; compaction must not let the
+        counter regress below them or restarted stores reissue
+        resourceVersions watchers already observed (etcd revisions never
+        regress across snapshot+restart)."""
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        for i in range(5):
+            client.pods("default").create(make_pod(f"p{i}"))
+        for i in range(4):
+            client.pods("default").delete(f"p{i}")  # deletes own the top rvs
+        rv_before = store.resource_version
+        store.compact()
+        store.close()
+        store2 = Store(wal_path=path)
+        assert store2.resource_version >= rv_before
+        new = Client(store2).pods("default").create(make_pod("fresh"))
+        assert int(new.metadata.resource_version) > rv_before
         store2.close()
 
     def test_native_appender_builds_and_matches(self, tmp_path):
