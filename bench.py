@@ -122,6 +122,20 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
         p = make_pod(1_000_000 + i, variant="uniform")
         p.spec.node_name = f"node-{i % n_nodes}"
         sched.cache.add_pod(p)
+    if variant in ("pod-affinity", "pod-anti-affinity"):
+        # bound variant pods make the cluster affinity-carrying from the
+        # start, so warmup compiles the SAME kernel shapes the drain hits
+        # after its first batch binds: the static-score bucket S flips once
+        # affinity pods exist, and the unique-mask bucket U collapses to 1
+        # when every template's mask row is trivially all-true (no term has
+        # matches yet) — either way the drain would recompile in the timed
+        # region. One pod per anti-affinity color / one affine pod gives
+        # every warm template a non-trivial row.
+        n_seed_variant = 100 if variant == "pod-anti-affinity" else 1
+        for i in range(min(n_seed_variant, n_nodes)):
+            p = make_pod(3_000_000 + i, variant)
+            p.spec.node_name = f"node-{i}"
+            sched.cache.add_pod(p)
     pods = [client.pods().create(make_pod(i, variant))
             for i in range(n_pods)]
     for pod in pods:

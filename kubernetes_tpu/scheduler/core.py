@@ -14,15 +14,16 @@ No node sampling: the reference trades decision quality for speed via
 numFeasibleNodesToFind (50%, :434-453); the batch kernel evaluates every node
 for every pod in one shot, so sampling is unnecessary.
 
-Predicates the kernel does not evaluate natively yet (MatchInterPodAffinity,
-NoDiskConflict) run on the host in two places, both skipped entirely when the
-cluster has no such constraints:
-  - pre-kernel: a per-pod extra mask over nodes (the reference's same
-    predicate fns, vectorized by the term compiler's caching)
-  - post-kernel: in-batch repair — the scan's serial usage tracking covers
-    resources/pod-count, but host ports and (anti-)affinity created by
-    EARLIER WINNERS IN THE SAME BATCH are validated on the host; a conflict
-    demotes the pod to retry (next cycle sees the winner via assume).
+MatchInterPodAffinity runs through the incremental topology index
+(topology.py — the M3 sparse topologyPairsMaps analog): per batch, every
+constraint template's node mask is one vectorized evaluation over [T, N]
+term-presence matrices (device matmuls for large T), fed into the kernel's
+unique-mask rows. Volume predicates (NoDiskConflict, Max*VolumeCount,
+zone/binding) still run per-node on the host, only for pods that carry
+volumes. In-batch interactions are validated post-kernel by the repair
+pass: ports/disk/attach against overlay NodeInfos, (anti-)affinity against
+a BatchOverlay of winner term counts; a conflict demotes the pod to retry
+(the next cycle sees the winner via assume).
 
 Failure diagnosis (`explain`) reruns the python predicates to produce the
 reference's per-node FitError reasons (:598-664) — off the hot path, only for
@@ -43,6 +44,7 @@ from .cache import Cache, Snapshot
 from .nodeinfo import NodeInfo, pod_has_affinity_constraints
 from . import predicates as preds
 from .tensorize import PodBatchTensors, TensorMirror, TermCompiler
+from .topology import AffinityProfile, BatchOverlay, TopologyIndex
 
 
 @dataclass
@@ -82,12 +84,28 @@ class PendingBatch:
     """A dispatched-but-unfetched batch (schedule_launch output): the device
     scan runs while the host commits the previous batch."""
     pods: List[Pod]
-    metas: Dict[int, "preds.PredicateMetadata"]
+    profiles: Dict[int, AffinityProfile]
     batch: PodBatchTensors
     packed: object                    # [2, P] device handle (assign+scores)
     new_usage: dict                   # device usage after this batch
     residual_free: bool               # no repair possible -> usage chainable
     usage_epoch: int = 0              # mirror.usage_epoch at launch
+    #: residual was affinity-only (no volumes/extenders/static scores):
+    #: the NEXT batch may still chain usage on device — its stale affinity
+    #: mask is repaired via stale_winners (below)
+    affinity_chainable: bool = False
+    #: True when this batch launched chained on a predecessor whose results
+    #: were not yet committed; the drain fills stale_winners/phantom from
+    #: that predecessor's commit before this batch is finished
+    chained: bool = False
+    #: the predecessor batch's committed (pod, node) winners — absent from
+    #: this batch's snapshot/index/mask; repair validates against them via
+    #: the BatchOverlay exactly like same-batch winners
+    stale_winners: Optional[List[Tuple[Pod, str]]] = None
+    #: the predecessor lost winners after this batch's usage was chained
+    #: (repair demotions / commit drops): chained usage over-states, so
+    #: kernel-unassigned pods here must RETRY, not park as unschedulable
+    phantom: bool = False
 
 
 def _pod_has_conflict_volumes(pod: Pod) -> bool:
@@ -108,65 +126,6 @@ def _pod_has_attach_volumes(pod: Pod) -> bool:
 
 def _pod_has_pvc(pod: Pod) -> bool:
     return any(v.persistent_volume_claim for v in pod.spec.volumes)
-
-
-def _required_pod_terms(pod: Pod):
-    aff = pod.spec.affinity
-    if aff is None:
-        return []
-    out = []
-    if aff.pod_affinity:
-        out += (aff.pod_affinity
-                .required_during_scheduling_ignored_during_execution or [])
-    if aff.pod_anti_affinity:
-        out += (aff.pod_anti_affinity
-                .required_during_scheduling_ignored_during_execution or [])
-    return out
-
-
-class _WinnerIndex:
-    """Label-index prefilter for winner<->pod affinity interactions in the
-    in-batch repair. EXACT matching stays in PredicateMetadata.add_pod; the
-    index only prunes winners that provably cannot interact with a pod, so
-    repair cost drops from O(pods x winners) metadata updates to
-    O(pods x matching-winners) — the reference pays the same total via its
-    serial per-pod metadata recomputes. Selector subset logic: a
-    match_labels selector matches an object only if EVERY (k,v) appears in
-    the object's labels, so one (k,v) lookup yields a superset; selectors
-    with expressions (or empty) are never pruned."""
-
-    def __init__(self):
-        self.winners: List[Pod] = []
-        self._by_label: Dict[Tuple[str, str], List[int]] = {}
-        self._term_sel: Dict[Tuple[str, str], List[int]] = {}
-        self._unprunable: List[int] = []
-
-    def add(self, bound: Pod) -> None:
-        idx = len(self.winners)
-        self.winners.append(bound)
-        for kv in bound.metadata.labels.items():
-            self._by_label.setdefault(kv, []).append(idx)
-        for t in _required_pod_terms(bound):
-            sel = t.label_selector
-            if sel is None or sel.match_expressions or not sel.match_labels:
-                self._unprunable.append(idx)
-            else:
-                for kv in sel.match_labels.items():
-                    self._term_sel.setdefault(kv, []).append(idx)
-
-    def candidates(self, pod: Pod) -> List[Pod]:
-        cand = set(self._unprunable)
-        # winners whose own required terms might match this pod
-        for kv in pod.metadata.labels.items():
-            cand.update(self._term_sel.get(kv, ()))
-        # winners this pod's own required terms might match
-        for t in _required_pod_terms(pod):
-            sel = t.label_selector
-            if sel is None or sel.match_expressions or not sel.match_labels:
-                return list(self.winners)  # cannot prune for this pod
-            kv = next(iter(sel.match_labels.items()))
-            cand.update(self._by_label.get(kv, ()))
-        return [self.winners[i] for i in sorted(cand)]
 
 
 class BatchScheduler:
@@ -204,31 +163,40 @@ class BatchScheduler:
         self.snapshot = Snapshot()
         self.mirror = TensorMirror()
         self.terms = TermCompiler(self.mirror)
+        #: the M3 incremental topologyPairsMaps analog (topology.py)
+        self.topology = TopologyIndex(self.mirror)
         self.scorer = ScoreCompiler(
             self.mirror, self.terms, listers=listers, weights=weights,
             hard_pod_affinity_weight=(
                 hard_pod_affinity_weight if hard_pod_affinity_weight is not None
-                else prios_mod.HARD_POD_AFFINITY_WEIGHT))
+                else prios_mod.HARD_POD_AFFINITY_WEIGHT),
+            topology=self.topology)
         self._seq_base = 0  # selectHost round-robin state across batches
-        self._has_affinity_pods = False
         # True while host-computed static scores contribute (chain pre-check)
         self._static_likely = False
 
     def refresh(self) -> None:
         dirty = self.cache.update_snapshot(self.snapshot)
         self.mirror.apply(self.snapshot, dirty)
+        self.topology.apply(self.snapshot, dirty)
         if dirty:
-            self._has_affinity_pods = any(
-                ni.pods_with_affinity for ni in self.snapshot.node_infos.values())
-            self.scorer.set_cluster_has_affinity_pods(self._has_affinity_pods)
+            # precise score gating: required-anti-only clusters never
+            # produce an inter-pod priority contribution
+            self.scorer.set_cluster_has_affinity_pods(
+                self.topology.has_score_carriers())
 
     # ------------------------------------------------------- residual host path
 
     def _needs_residual(self, pod: Pod) -> bool:
         """MatchInterPodAffinity / NoDiskConflict / volume predicates need
-        the internal host path (extender filters are handled separately so
-        they don't drag every pod through the per-node predicate loop)."""
-        return (self._has_affinity_pods or pod_has_affinity_constraints(pod)
+        an extra mask row (extender filters are handled separately so they
+        don't drag every pod through the template path). Unconstrained pods
+        are masked only when some existing pod carries REQUIRED
+        anti-affinity — the one carried constraint that can exclude them
+        (preferred terms only score; carried required affinity only
+        credits)."""
+        return (pod_has_affinity_constraints(pod)
+                or self.topology.has_required_anti_carriers()
                 or _pod_has_conflict_volumes(pod) or _pod_has_pvc(pod)
                 or _pod_has_attach_volumes(pod))
 
@@ -261,15 +229,36 @@ class BatchScheduler:
                 return False
         return True
 
+    @staticmethod
+    def _canon_pod_aff_term(t) -> Tuple:
+        from ..api import labels as labelsmod
+        return (labelsmod.canonical_selector(t.label_selector),
+                t.topology_key, tuple(sorted(t.namespaces)))
+
     def _residual_sig(self, pod: Pod) -> Tuple:
-        """Everything the residual per-node evaluation can depend on:
-        controller-stamped pods share it, so the O(N) python predicate pass
-        and the cluster-wide PredicateMetadata scan run once per TEMPLATE
-        per batch, not once per pod (the affinity analog of the mask-row
-        dedupe in PodBatchTensors)."""
+        """Everything the residual evaluation can depend on:
+        controller-stamped pods share it, so profile resolution, the
+        vectorized affinity mask, and the volume per-node pass run once per
+        TEMPLATE per batch, not once per pod (the affinity analog of the
+        mask-row dedupe in PodBatchTensors). Structured canon, not repr() —
+        a deep dataclass repr per pod per batch was the residual path's
+        largest host cost."""
         aff = pod.spec.affinity
-        # dataclass repr is deep and deterministic: a faithful canon
-        aff_canon = repr(aff) if aff is not None else ""
+        aff_canon: Tuple = ()
+        if aff is not None:
+            parts = []
+            for pa in (aff.pod_affinity, aff.pod_anti_affinity):
+                if pa is None:
+                    parts.append(None)
+                    continue
+                parts.append((
+                    tuple(self._canon_pod_aff_term(t) for t in
+                          pa.required_during_scheduling_ignored_during_execution or ()),
+                    tuple((wt.weight,
+                           self._canon_pod_aff_term(wt.pod_affinity_term))
+                          for wt in
+                          pa.preferred_during_scheduling_ignored_during_execution or ())))
+            aff_canon = tuple(parts)
         vols = tuple(sorted(
             (v.name,
              v.persistent_volume_claim.claim_name
@@ -282,8 +271,8 @@ class BatchScheduler:
                 aff_canon, vols)
 
     def _residual_mask(self, pods: List[Pod]
-                       ) -> Tuple[Optional[np.ndarray], Dict[int, preds.PredicateMetadata]]:
-        metas: Dict[int, preds.PredicateMetadata] = {}
+                       ) -> Tuple[Optional[np.ndarray], Dict[int, AffinityProfile]]:
+        profiles: Dict[int, AffinityProfile] = {}
         extra: Optional[np.ndarray] = None
         filter_extenders = [e for e in self.extenders
                             if e.config.filter_verb]
@@ -291,8 +280,11 @@ class BatchScheduler:
         enc_nodes: Optional[list] = None
         if filter_extenders:
             live_nodes, enc_nodes = self._encoded_live_nodes()
-        #: sig -> (row_mask, meta) computed once per template per batch
-        row_cache: Dict[Tuple, Tuple[np.ndarray, preds.PredicateMetadata]] = {}
+        # pass 1: group internal-path pods by template signature; extenders
+        # apply per pod (their masks are pod-addressed)
+        sig_index: Dict[Tuple, int] = {}
+        sig_reps: List[Pod] = []
+        pod_sig = np.full((len(pods),), -1, np.int64)
         for i, pod in enumerate(pods):
             internal = self._needs_residual(pod)
             if not internal and not filter_extenders:
@@ -304,33 +296,62 @@ class BatchScheduler:
                 continue
             if internal:
                 sig = self._residual_sig(pod)
-                cached = row_cache.get(sig)
-                if cached is None:
-                    cached = self._residual_row(pod)
-                    row_cache[sig] = cached
-                row_mask, meta = cached
-                metas[i] = meta
-                extra[i] &= row_mask
+                u = sig_index.get(sig)
+                if u is None:
+                    u = len(sig_reps)
+                    sig_index[sig] = u
+                    sig_reps.append(pod)
+                pod_sig[i] = u
             if filter_extenders and not self._apply_filter_extenders(
                     filter_extenders, pod, live_nodes, extra, i, enc_nodes):
                 continue
-        return extra, metas
+        if not sig_reps:
+            return extra, profiles
+        # pass 2: one vectorized affinity evaluation for ALL templates
+        # (topology.required_masks — numpy or device matmuls by size), plus
+        # the per-node volume loop only for templates that carry volumes
+        sig_profiles = [self.topology.required_profile(p) for p in sig_reps]
+        constrained = [u for u, pr in enumerate(sig_profiles)
+                       if pr.constrained]
+        aff_rows: Dict[int, np.ndarray] = {}
+        if constrained:
+            rows = self.topology.required_masks(
+                [sig_profiles[u] for u in constrained])
+            for j, u in enumerate(constrained):
+                aff_rows[u] = rows[j]
+        vol_rows = [self._volume_row(rep) for rep in sig_reps]
+        for i in range(len(pods)):
+            u = int(pod_sig[i])
+            if u < 0:
+                continue
+            row = aff_rows.get(u)
+            if row is not None:
+                extra[i] &= row
+            if vol_rows[u] is not None:
+                extra[i] &= vol_rows[u]
+            if sig_profiles[u].constrained:
+                profiles[i] = sig_profiles[u]
+        return extra, profiles
 
-    def _residual_row(self, pod: Pod
-                      ) -> Tuple[np.ndarray, preds.PredicateMetadata]:
-        """One template's [capacity] residual-predicate mask + its metadata
-        (batch-start state; in-batch interactions are _repair_batch's job)."""
-        meta = preds.PredicateMetadata(pod, self.snapshot.node_infos)
-        row_mask = np.zeros((self.mirror.t.capacity,), bool)
+    def _volume_row(self, pod: Pod) -> Optional[np.ndarray]:
+        """One template's [capacity] volume-predicate mask (NoDiskConflict,
+        Max*VolumeCount, zone conflict, volume binding), or None when the
+        pod carries no volume constraints — the only predicates left on the
+        per-node host loop."""
         has_disk = _pod_has_conflict_volumes(pod)
         has_pvc = _pod_has_pvc(pod)
         has_attach = has_pvc or _pod_has_attach_volumes(pod)
+        if not (has_disk or has_pvc or has_attach):
+            return None
+        from types import SimpleNamespace
+        meta = SimpleNamespace(memo={})  # Max*VolumeCount wanted-set memo
+        row_mask = np.zeros((self.mirror.t.capacity,), bool)
         for name, ni in self.snapshot.node_infos.items():
             row = self.mirror.row_of.get(name)
             if row is None:
                 continue
-            ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
-            if ok and has_disk:
+            ok = True
+            if has_disk:
                 ok, _ = preds.no_disk_conflict(pod, meta, ni)
             if ok and has_attach:
                 for fn in self._volume_count_preds.values():
@@ -342,7 +363,7 @@ class BatchScheduler:
                 if ok and ni.node is not None:
                     ok = self.volume_binder.find_pod_volumes(pod, ni.node)
             row_mask[row] = ok
-        return row_mask, meta
+        return row_mask
 
     def _apply_filter_extenders(self, filter_extenders, pod: Pod,
                                 live_nodes, extra: np.ndarray,
@@ -402,26 +423,41 @@ class BatchScheduler:
             np.arange(len(pods), dtype=np.int32), base + ext)
 
     def _repair_batch(self, results: List[ScheduleResult],
-                      metas: Dict[int, preds.PredicateMetadata]) -> None:
+                      profiles: Dict[int, AffinityProfile],
+                      stale_winners=None) -> None:
         """Validate host-evaluated predicates against earlier winners in the
         same batch; losers are demoted to retry. Skipped when nothing in the
-        batch carries ports/affinity/disk constraints."""
-        needs_any = bool(metas) or any(
+        batch carries ports/affinity/disk constraints. Affinity interactions
+        run against a BatchOverlay of winner term counts (O(terms) dict
+        lookups per pod) — the batch analog of the serial reference's
+        cache.AssumePod visibility between scheduleOne iterations."""
+        # overlay NodeInfos (winner clones) are only consulted by the
+        # ports/disk/attach checks — skip their maintenance entirely for
+        # affinity-only batches (the deepcopy per winner is the cost)
+        track_nodes = any(
             helpers.pod_host_ports(r.pod) or _pod_has_conflict_volumes(r.pod)
-            or _pod_has_pvc(r.pod)
+            or _pod_has_pvc(r.pod) or _pod_has_attach_volumes(r.pod)
             for r in results)
-        if not needs_any:
+        if not track_nodes and not profiles and not stale_winners:
             return
         overlay: Dict[str, NodeInfo] = {}
-        winners: List[Pod] = []
-        windex = _WinnerIndex()
+        #: affinity tracking only matters when some pod validates it or a
+        #: chained predecessor's winners are invisible to this batch's mask
+        aff_overlay = BatchOverlay(self.topology) \
+            if profiles or stale_winners else None
+        any_winners = False
+        if aff_overlay is not None and stale_winners:
+            # a chained predecessor's committed winners: this batch's
+            # snapshot/index/mask predate them, so they participate in
+            # repair exactly like earlier same-batch winners
+            for w_pod, w_node in stale_winners:
+                aff_overlay.add_winner(w_pod, w_node)
+            any_winners = True
         # PV names earlier winners will reserve: two winners in one batch
         # must not both claim the single matching PV (the serial reference
         # reserves via AssumePodVolumes between scheduleOne iterations)
         taken_pvs: set = set()
-        # a winner with required anti-affinity constrains EVERY later pod in
-        # the batch, constrained or not
-        winners_have_anti = False
+        empty_profile = AffinityProfile()
 
         def overlay_node(name: str) -> Optional[NodeInfo]:
             ni = overlay.get(name)
@@ -438,8 +474,6 @@ class BatchScheduler:
                 continue
             pod = res.pod
             has_ports = bool(helpers.pod_host_ports(pod))
-            has_aff = (pod_has_affinity_constraints(pod) or i in metas
-                       or winners_have_anti)
             has_disk = _pod_has_conflict_volumes(pod)
             pvs: List[str] = []
             if _pod_has_pvc(pod):
@@ -456,7 +490,7 @@ class BatchScheduler:
                 # pod must not block these PVs for the rest of the batch
                 pvs = found
             has_attach = _pod_has_attach_volumes(pod) or _pod_has_pvc(pod)
-            if winners and (has_ports or has_aff or has_disk or has_attach):
+            if any_winners and (has_ports or has_disk or has_attach):
                 ni = overlay_node(res.node_name)
                 ok = ni is not None
                 if ok and has_ports:
@@ -469,41 +503,28 @@ class BatchScheduler:
                         ok, _ = fn(pod, None, ni)
                         if not ok:
                             break
-                if ok and has_aff:
-                    meta = metas.get(i)
-                    if meta is None:
-                        # snapshot pods only matter when the cluster has
-                        # affinity pods (then i would be in metas already);
-                        # here only in-batch winners can constrain
-                        base = self.snapshot.node_infos \
-                            if self._has_affinity_pods else {}
-                        meta = preds.PredicateMetadata(pod, base)
-                    else:
-                        # metas entries are SHARED across same-template pods
-                        # (row cache); mutate a private copy
-                        meta = meta.clone()
-                    for w in windex.candidates(pod):
-                        wni = overlay.get(w.spec.node_name)
-                        if wni is not None:
-                            meta.add_pod(w, wni)
-                    ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
                 if not ok:
                     res.node_name = None
                     res.retry = True
                     continue
-            # record the winner in the overlay; its PVs now block later pods
+            if aff_overlay is not None and any_winners and \
+                    (i in profiles or aff_overlay.has_anti):
+                if aff_overlay.conflicts(pod, profiles.get(i, empty_profile),
+                                         res.node_name):
+                    res.node_name = None
+                    res.retry = True
+                    continue
+            # record the winner in the overlays; its PVs block later pods
             taken_pvs.update(pvs)
-            bound = deepcopy_obj(pod)
-            bound.spec.node_name = res.node_name
-            ni = overlay_node(res.node_name)
-            if ni is not None:
-                ni.add_pod(bound)
-            winners.append(bound)
-            windex.add(bound)
-            aff = pod.spec.affinity
-            if aff and aff.pod_anti_affinity and \
-                    aff.pod_anti_affinity.required_during_scheduling_ignored_during_execution:
-                winners_have_anti = True
+            if track_nodes:
+                bound = deepcopy_obj(pod)
+                bound.spec.node_name = res.node_name
+                ni = overlay_node(res.node_name)
+                if ni is not None:
+                    ni.add_pod(bound)
+            if aff_overlay is not None:
+                aff_overlay.add_winner(pod, res.node_name)
+            any_winners = True
 
     # ------------------------------------------------------------- schedule
 
@@ -548,39 +569,48 @@ class BatchScheduler:
         from ..utils.features import DEFAULT_FEATURE_GATE
         from .kernels.batch import pack_results, schedule_batch
         dirty = self.cache.update_snapshot(self.snapshot)
-        chaining = (chain is not None and chain.residual_free
+        # volume predicates can NEVER ride a chain (PV reservations need
+        # committed state); affinity CAN — its stale mask (snapshot lacks
+        # the chain's uncommitted winners) is repaired post-kernel against
+        # stale_winners, the same overlay that validates same-batch winners
+        affinity_only = not self._has_filter_extenders() and all(
+            not (_pod_has_conflict_volumes(p) or _pod_has_pvc(p)
+                 or _pod_has_attach_volumes(p)) for p in pods)
+        chaining = (chain is not None
+                    and (chain.residual_free or chain.affinity_chainable)
                     and DEFAULT_FEATURE_GATE.enabled("SchedulerDeviceChaining")
                     and chain_seq is not None
                     and self.cache.mutation_seq == chain_seq
                     and not self._static_likely
                     and self.mirror.device_ready()
-                    # the NEW batch's residual predicates (anti-affinity /
-                    # disk / PVC) would be evaluated against a snapshot that
-                    # excludes the chain's uncommitted winners — sequential
-                    # path only for such batches; extender filters likewise
-                    # produce an extra mask every batch
-                    and not self._has_filter_extenders()
-                    and not any(self._needs_residual(p) for p in pods))
+                    and affinity_only)
         if chaining:
             self.mirror.apply_chained(self.snapshot, dirty)
+            self.topology.apply(self.snapshot, dirty)
+            if dirty:
+                # keep the scorer's gate fresh on the chained path too: if
+                # this drain's own commits introduced score-contributing
+                # carriers, static_scores below turns non-None and refuses
+                # the chain — matching the sequential path's scoring
+                self.scorer.set_cluster_has_affinity_pods(
+                    self.topology.has_score_carriers())
         else:
             # the dirty list is consumed either way — a chain refusal must
             # still apply it, or the mirror would never see these updates
             # (update_snapshot won't return them again)
             self.mirror.apply(self.snapshot, dirty)
+            self.topology.apply(self.snapshot, dirty)
             if dirty:
-                self._has_affinity_pods = any(
-                    ni.pods_with_affinity
-                    for ni in self.snapshot.node_infos.values())
-                self.scorer.set_cluster_has_affinity_pods(self._has_affinity_pods)
+                self.scorer.set_cluster_has_affinity_pods(
+                    self.topology.has_score_carriers())
             if chain is not None:
                 return None
-        extra_mask, metas = self._residual_mask(pods)
-        if chaining and extra_mask is not None:
-            return None  # unreachable given the _needs_residual guard; belt
+        extra_mask, profiles = self._residual_mask(pods)
         residual_free = extra_mask is None and not any(
             helpers.pod_host_ports(p) or _pod_has_conflict_volumes(p)
             for p in pods)
+        affinity_chainable = affinity_only and not any(
+            helpers.pod_host_ports(p) for p in pods)
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
@@ -619,10 +649,12 @@ class BatchScheduler:
         assign_d, scores_d, new_usage = schedule_batch(node_cfg, usage,
                                                        batch.device(),
                                                        self._nominated_device())
-        return PendingBatch(pods=pods, metas=metas, batch=batch,
+        return PendingBatch(pods=pods, profiles=profiles, batch=batch,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
                             residual_free=residual_free,
+                            affinity_chainable=affinity_chainable,
+                            chained=chaining,
                             usage_epoch=self.mirror.usage_epoch)
 
     def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
@@ -634,7 +666,15 @@ class BatchScheduler:
             row = int(assign[i])
             name = self.mirror.name_of.get(row) if row >= 0 else None
             out.append(ScheduleResult(pod, name, float(scores[i])))
-        self._repair_batch(out, pending.metas)
+        if pending.phantom:
+            # the chained-in usage counted winners the predecessor later
+            # lost: an unassigned pod may have been starved by that phantom
+            # space — retry instead of parking as unschedulable (the next
+            # cycle launches unchained from repaired host truth)
+            for r in out:
+                if r.node_name is None:
+                    r.retry = True
+        self._repair_batch(out, pending.profiles, pending.stale_winners)
         if not any(r.retry for r in out) and \
                 pending.usage_epoch == self.mirror.usage_epoch:
             # every surviving assignment flows through cache.assume_pod, so

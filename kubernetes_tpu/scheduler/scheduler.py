@@ -56,6 +56,10 @@ class Scheduler:
         #: scheduler.go:411 — extender bind wins when it manages the pod)
         self._bind_extender = next(
             (e for e in self.extenders if e.supports_bind()), None)
+        #: last committed batch's winners + phantom flag — handed to a
+        #: successor batch that chained on it (drain_pipelined)
+        self._last_commit_winners: list = []
+        self._last_commit_phantom = False
         self.cache = Cache(clock=clock)
         self.queue = SchedulingQueue(clock=clock)
         self.informers = informer_factory or SharedInformerFactory(client)
@@ -287,6 +291,20 @@ class Scheduler:
                 if prev is not None:
                     expected_seq = self._finish_and_commit(
                         prev[0], prev[1], expected_seq)
+                    if pending is not None and pending.chained:
+                        # the pending batch launched against prev's
+                        # UNCOMMITTED state: hand it prev's committed
+                        # winners (its repair validates against them) and
+                        # whether prev lost winners after the usage chain
+                        # was taken (phantom space in pending's input)
+                        pending.stale_winners = self._last_commit_winners
+                        pending.phantom = self._last_commit_phantom
+                        if pending.phantom:
+                            # the chained usage permanently carries the
+                            # lost winners; drop device usage so the next
+                            # launch re-uploads host truth (and pending's
+                            # own adopt is epoch-refused)
+                            self.algorithm.mirror.invalidate_usage()
                 prev = (pending, cycle) if pending is not None else None
         finally:
             self._in_flight = 0
@@ -298,8 +316,19 @@ class Scheduler:
         t0 = _time.perf_counter()
         results = self.algorithm.schedule_finish(pending)
         t1 = _time.perf_counter()
+        epoch_before = self.algorithm.mirror.usage_epoch
         n_assumed = self._commit_results(results, cycle)
         t2 = _time.perf_counter()
+        # bookkeeping for a successor batch chained on THIS batch's usage:
+        # its mask/index predate these winners (stale_winners) and any
+        # winner lost after the chain was taken (repair demotion or commit
+        # drop, the latter visible as a usage-epoch bump) leaves phantom
+        # space in the successor's usage input
+        self._last_commit_winners = [
+            (r.pod, r.node_name) for r in results if r.node_name is not None]
+        self._last_commit_phantom = (
+            any(r.retry for r in results)
+            or self.algorithm.mirror.usage_epoch != epoch_before)
         m = self.metrics
         m.scheduling_duration.observe(t1 - t0, operation="fetch")
         m.scheduling_duration.observe(t2 - t1, operation="commit")

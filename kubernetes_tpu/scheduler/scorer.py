@@ -98,9 +98,14 @@ class ScoreCompiler:
     def __init__(self, mirror: TensorMirror, terms: TermCompiler,
                  listers: Optional[prios.SpreadListers] = None,
                  weights: Optional[Dict[str, int]] = None,
-                 hard_pod_affinity_weight: int = prios.HARD_POD_AFFINITY_WEIGHT):
+                 hard_pod_affinity_weight: int = prios.HARD_POD_AFFINITY_WEIGHT,
+                 topology=None):
         self.mirror = mirror
         self.terms = terms
+        #: scheduler/topology.py TopologyIndex — when present, inter-pod
+        #: affinity scoring is count-matrix gathers instead of the
+        #: O(existing pods × terms) python scan per template
+        self.topology = topology
         self.listers = listers
         self.weights = dict(weights if weights is not None
                             else prios.DEFAULT_PRIORITY_WEIGHTS)
@@ -383,11 +388,16 @@ class ScoreCompiler:
 
     def _interpod_raw(self, pod: Pod) -> Optional[np.ndarray]:
         """Preferred inter-pod (anti-)affinity + symmetric hard credit.
-        Host python over the snapshot (O(existing pods)); only runs when the
-        pod or the cluster carries (anti-)affinity terms."""
+        Through the topology index when available (count-matrix gathers);
+        the O(existing pods × terms) python scan over the snapshot is the
+        fallback and the parity oracle. Only runs when the pod or the
+        cluster carries (anti-)affinity terms."""
         if not _has_preferred_pod_affinity(pod) and \
                 not self._cluster_has_affinity_pods:
             return None
+        if self.topology is not None:
+            return self.topology.score_vector(
+                pod, self.hard_pod_affinity_weight)
         node_infos = {name: self.mirror.infos[row]
                       for name, row in self.mirror.row_of.items()
                       if self.mirror.infos[row] is not None}

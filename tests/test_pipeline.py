@@ -135,3 +135,69 @@ def test_pipelined_drain_with_host_port_pods_falls_back():
     assert n == 3
     holders = [v for v in bind_map(client).values() if v]
     assert sorted(holders) == ["node-0", "node-1", "node-2"]
+
+
+class TestChainedAffinity:
+    """Cross-batch affinity over the chained pipeline: a batch launched
+    against its predecessor's UNCOMMITTED state must still honor the
+    predecessor's winners — repair validates against stale_winners via the
+    BatchOverlay (core.schedule_finish), never by flushing the pipeline."""
+
+    def _anti_pod(self, i):
+        pod = make_pod(i)
+        pod.metadata.labels["grp"] = "x"
+        pod.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"grp": "x"}),
+                        topology_key=api.wellknown.LABEL_HOSTNAME)]))
+        return pod
+
+    def test_cross_batch_anti_affinity_distinct_hosts(self):
+        client = Client(validate=False)
+        sched = Scheduler(client, batch_size=2)
+        for i in range(6):
+            node = make_node(i)
+            client.nodes().create(node)
+            sched.cache.add_node(node)
+        for i in range(4):
+            sched.queue.add(client.pods().create(self._anti_pod(i)))
+        sched.algorithm.refresh()
+        n = sched.drain_pipelined()
+        assert n == 4
+        binds = bind_map(client)
+        hosts = [binds[f"pod-{i}"] for i in range(4)]
+        assert all(hosts), binds
+        assert len(set(hosts)) == 4, f"anti-affinity violated: {binds}"
+
+    def test_cross_batch_waived_affinity_colocates(self):
+        """First pod of a self-affine group lands anywhere (waived term);
+        every later pod — including ones whose batch chained on the
+        first's uncommitted bind — must co-locate in its topology domain."""
+        client = Client(validate=False)
+        sched = Scheduler(client, batch_size=2)
+        for i in range(6):
+            node = make_node(i)
+            node.metadata.labels[api.wellknown.LABEL_ZONE] = f"zone-{i % 3}"
+            client.pods()  # no-op; keep structure clear
+            client.nodes().create(node)
+            sched.cache.add_node(node)
+        for i in range(4):
+            pod = make_pod(i)
+            pod.metadata.labels["grp"] = "y"
+            pod.spec.affinity = api.Affinity(pod_affinity=api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"grp": "y"}),
+                        topology_key=api.wellknown.LABEL_ZONE)]))
+            sched.queue.add(client.pods().create(pod))
+        sched.algorithm.refresh()
+        n = sched.drain_pipelined()
+        assert n == 4
+        binds = bind_map(client)
+        zones = {binds[f"pod-{i}"] for i in range(4)}
+        zone_labels = {f"node-{i}": f"zone-{i % 3}" for i in range(6)}
+        assert len({zone_labels[h] for h in zones if h}) == 1, binds

@@ -1,0 +1,254 @@
+"""Topology index (M3) tests: the incremental (term × domain) count
+matrices must agree bit-for-bit with the per-cycle PredicateMetadata /
+interpod_affinity_scores oracle (predicates.py / priorities.py — the
+reference semantics of metadata.go:71-94 + interpod_affinity.go), under
+randomized clusters and under incremental churn, and the device matmul
+kernel must equal the host numpy evaluation.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler import priorities as prios
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.tensorize import TensorMirror
+from kubernetes_tpu.scheduler.topology import TopologyIndex
+
+ZONES = ["z1", "z2", "z3"]
+APPS = ["web", "db", "cache", "batch"]
+NAMESPACES = ["default", "prod"]
+
+
+def rnd_node(rng, i):
+    labels = {api.wellknown.LABEL_HOSTNAME: f"n{i}"}
+    if rng.random() < 0.8:  # some nodes miss the zone label on purpose
+        labels[api.wellknown.LABEL_ZONE] = rng.choice(ZONES)
+    alloc = {"cpu": Quantity("8"), "memory": Quantity("16Gi"),
+             "pods": Quantity(110)}
+    return api.Node(
+        metadata=api.ObjectMeta(name=f"n{i}", labels=labels),
+        status=api.NodeStatus(capacity=dict(alloc), allocatable=dict(alloc),
+                              conditions=[api.NodeCondition(
+                                  type="Ready", status="True")]))
+
+
+def rnd_term(rng):
+    sel = api.LabelSelector(match_labels={"app": rng.choice(APPS)})
+    if rng.random() < 0.3:
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement(
+                key="app", operator="In",
+                values=sorted(rng.sample(APPS, 2)))])
+    tk = rng.choice([api.wellknown.LABEL_ZONE, api.wellknown.LABEL_HOSTNAME])
+    namespaces = []
+    if rng.random() < 0.25:
+        namespaces = [rng.choice(NAMESPACES)]
+    return api.PodAffinityTerm(label_selector=sel, topology_key=tk,
+                               namespaces=namespaces)
+
+
+def rnd_pod(rng, i, with_affinity=0.5):
+    pod = api.Pod(
+        metadata=api.ObjectMeta(
+            name=f"p{i}", namespace=rng.choice(NAMESPACES),
+            labels={"app": rng.choice(APPS)}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("100m")}))]))
+    if rng.random() < with_affinity:
+        aff = api.Affinity()
+        r = rng.random()
+        if r < 0.4:
+            aff.pod_affinity = api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    rnd_term(rng)])
+        elif r < 0.8:
+            aff.pod_anti_affinity = api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    rnd_term(rng)])
+        else:
+            aff.pod_affinity = api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    rnd_term(rng)])
+            aff.pod_anti_affinity = api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    rnd_term(rng)])
+        if rng.random() < 0.5:
+            wt = api.WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                             pod_affinity_term=rnd_term(rng))
+            if aff.pod_affinity is None:
+                aff.pod_affinity = api.PodAffinity()
+            aff.pod_affinity.preferred_during_scheduling_ignored_during_execution = [wt]
+        if rng.random() < 0.3:
+            wt = api.WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                             pod_affinity_term=rnd_term(rng))
+            if aff.pod_anti_affinity is None:
+                aff.pod_anti_affinity = api.PodAntiAffinity()
+            aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution = [wt]
+        pod.spec.affinity = aff
+    return pod
+
+
+def build_cluster(rng, n_nodes=24, n_pods=60):
+    """(cache, mirror, index, snapshot) with pods randomly placed."""
+    cache = Cache()
+    mirror = TensorMirror()
+    index = TopologyIndex(mirror)
+    snap = Snapshot()
+    for i in range(n_nodes):
+        cache.add_node(rnd_node(rng, i))
+    for i in range(n_pods):
+        p = rnd_pod(rng, i)
+        p.spec.node_name = f"n{rng.randrange(n_nodes)}"
+        cache.add_pod(p)
+    dirty = cache.update_snapshot(snap)
+    mirror.apply(snap, dirty)
+    index.apply(snap, dirty)
+    return cache, mirror, index, snap
+
+
+def oracle_mask(pod, snap, mirror):
+    """Per-node match_inter_pod_affinity over a fresh PredicateMetadata."""
+    meta = preds.PredicateMetadata(pod, snap.node_infos)
+    mask = {}
+    for name, ni in snap.node_infos.items():
+        ok, _ = preds.match_inter_pod_affinity(pod, meta, ni)
+        mask[name] = ok
+    return mask
+
+
+class TestRequiredParity:
+    def test_fuzz_masks_match_oracle(self):
+        rng = random.Random(7)
+        for trial in range(8):
+            _, mirror, index, snap = build_cluster(rng)
+            incoming = [rnd_pod(rng, 1000 + k, with_affinity=0.9)
+                        for k in range(12)]
+            profiles = [index.required_profile(p) for p in incoming]
+            rows = index.required_masks(profiles)
+            for p, row in zip(incoming, rows):
+                want = oracle_mask(p, snap, mirror)
+                for name, ok in want.items():
+                    r = mirror.row_of[name]
+                    assert bool(row[r]) == ok, (
+                        f"trial {trial}: pod {p.metadata.name} node {name}: "
+                        f"index {bool(row[r])} oracle {ok}")
+
+    def test_device_kernel_matches_numpy(self):
+        import kubernetes_tpu.scheduler.topology as topo
+        rng = random.Random(11)
+        _, mirror, index, snap = build_cluster(rng)
+        incoming = [rnd_pod(rng, 2000 + k, with_affinity=1.0)
+                    for k in range(10)]
+        profiles = [index.required_profile(p) for p in incoming]
+        host = index.required_masks(profiles)
+        old = topo.DEVICE_EVAL_THRESHOLD
+        topo.DEVICE_EVAL_THRESHOLD = 0  # force the matmul kernel
+        try:
+            dev = index.required_masks(profiles)
+        finally:
+            topo.DEVICE_EVAL_THRESHOLD = old
+        assert (host == dev).all()
+
+
+class TestScoreParity:
+    def test_fuzz_scores_match_oracle(self):
+        rng = random.Random(13)
+        for trial in range(6):
+            _, mirror, index, snap = build_cluster(rng)
+            hard_w = rng.choice([0, 1, 10])
+            for k in range(8):
+                p = rnd_pod(rng, 3000 + k, with_affinity=0.8)
+                want = prios.interpod_affinity_scores(
+                    p, hard_w, snap.node_infos)
+                got = index.score_vector(p, hard_w)
+                vec = np.zeros((mirror.t.capacity,), np.float32)
+                if got is not None:
+                    vec = got
+                for name, v in want.items():
+                    r = mirror.row_of[name]
+                    assert vec[r] == pytest.approx(v), (
+                        f"trial {trial}: pod {p.metadata.name} node {name}")
+
+
+class TestIncremental:
+    def test_churn_matches_rebuild(self):
+        """Random add/remove/rebind churn through the cache's dirty feed
+        must leave the index equal to one built from scratch."""
+        rng = random.Random(17)
+        cache, mirror, index, snap = build_cluster(rng, n_nodes=16,
+                                                   n_pods=30)
+        live = {}
+        for ni in snap.node_infos.values():
+            for p in ni.pods:
+                live[p.metadata.name] = p
+        for step in range(120):
+            r = rng.random()
+            if r < 0.4 and live:  # remove a pod
+                name = rng.choice(sorted(live))
+                cache.remove_pod(live.pop(name))
+            elif r < 0.8:  # add a pod
+                p = rnd_pod(rng, 10_000 + step)
+                p.spec.node_name = f"n{rng.randrange(16)}"
+                cache.add_pod(p)
+                live[p.metadata.name] = p
+            else:  # node label churn (zone move)
+                i = rng.randrange(16)
+                node = rnd_node(rng, i)
+                cache.update_node(node, node)
+            dirty = cache.update_snapshot(snap)
+            mirror.apply(snap, dirty)
+            index.apply(snap, dirty)
+            if step % 30 != 29:
+                continue
+            # compare against the oracle on fresh incoming pods
+            for k in range(4):
+                p = rnd_pod(rng, 20_000 + step * 10 + k, with_affinity=1.0)
+                prof = index.required_profile(p)
+                row = index.required_masks([prof])[0]
+                want = oracle_mask(p, snap, mirror)
+                for nm, ok in want.items():
+                    assert bool(row[mirror.row_of[nm]]) == ok, \
+                        f"step {step} node {nm}"
+                w = prios.interpod_affinity_scores(p, 1, snap.node_infos)
+                got = index.score_vector(p, 1)
+                vec = got if got is not None else \
+                    np.zeros((mirror.t.capacity,), np.float32)
+                for nm, v in w.items():
+                    assert vec[mirror.row_of[nm]] == pytest.approx(v)
+
+    def test_anti_carrier_flag(self):
+        rng = random.Random(19)
+        cache = Cache()
+        mirror = TensorMirror()
+        index = TopologyIndex(mirror)
+        snap = Snapshot()
+        cache.add_node(rnd_node(rng, 0))
+        dirty = cache.update_snapshot(snap)
+        mirror.apply(snap, dirty)
+        index.apply(snap, dirty)
+        assert not index.has_required_anti_carriers()
+        p = rnd_pod(rng, 0, with_affinity=0.0)
+        p.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels={"app": "web"}),
+                    topology_key=api.wellknown.LABEL_HOSTNAME)]))
+        p.spec.node_name = "n0"
+        cache.add_pod(p)
+        dirty = cache.update_snapshot(snap)
+        mirror.apply(snap, dirty)
+        index.apply(snap, dirty)
+        assert index.has_required_anti_carriers()
+        cache.remove_pod(p)
+        dirty = cache.update_snapshot(snap)
+        mirror.apply(snap, dirty)
+        index.apply(snap, dirty)
+        assert not index.has_required_anti_carriers()
