@@ -14,7 +14,9 @@ from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
                    PersistentVolumeSpec, Pod, PodAffinity,
                    PodAffinityTerm, PodAntiAffinity, PodCondition, PodSpec,
                    PodStatus, PodTemplateSpec, PreferredSchedulingTerm,
-                   ReplicationController, ResourceRequirements, Service,
+                   LimitRange, LimitRangeItem, LimitRangeSpec,
+                   ReplicationController, ResourceQuota, ResourceQuotaSpec,
+                   ResourceQuotaStatus, ResourceRequirements, Service,
                    ServicePort, ServiceSpec, Taint, Toleration, Volume,
                    WeightedPodAffinityTerm)
 from .defaults import default

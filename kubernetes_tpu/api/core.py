@@ -531,3 +531,54 @@ class ReplicationController:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
     status: ReplicationControllerStatus = field(default_factory=ReplicationControllerStatus)
+
+
+# ------------------------------------------------- quota & limits
+
+@dataclass
+class ResourceQuotaSpec:
+    """Ref: core/v1 ResourceQuotaSpec (types.go) — hard caps per resource
+    name ("pods", "requests.cpu", "limits.memory", "count/{resource}", ...)
+    plus the scope selectors restricting which pods the quota tracks."""
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    scopes: List[str] = field(default_factory=list)  # Terminating | NotTerminating | BestEffort | NotBestEffort
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    used: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    api_version: str = "v1"
+    kind: str = "ResourceQuota"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class LimitRangeItem:
+    """Ref: core/v1 LimitRangeItem — per-type (Container/Pod/
+    PersistentVolumeClaim) min/max bounds and container defaults."""
+    type: str = "Container"
+    max: Dict[str, Quantity] = field(default_factory=dict)
+    min: Dict[str, Quantity] = field(default_factory=dict)
+    default: Dict[str, Quantity] = field(default_factory=dict)
+    default_request: Dict[str, Quantity] = field(default_factory=dict)
+    max_limit_request_ratio: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    api_version: str = "v1"
+    kind: str = "LimitRange"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)

@@ -97,7 +97,8 @@ def default(obj):
     meta = getattr(obj, "metadata", None)
     if meta is not None and not meta.namespace and getattr(obj, "kind", "") in (
             "Service", "Endpoints", "PersistentVolumeClaim", "Job", "CronJob",
-            "PodDisruptionBudget", "Event", "ConfigMap", "Lease", "ReplicationController"):
+            "PodDisruptionBudget", "Event", "ConfigMap", "Lease", "ReplicationController",
+            "ResourceQuota", "LimitRange"):
         meta.namespace = "default"
     return obj
 

@@ -1,0 +1,288 @@
+"""Built-in admission plugins: ResourceQuota and LimitRanger.
+
+Ref: plugin/pkg/admission/resourcequota/admission.go (QuotaAdmission —
+Validate computes the incoming object's usage delta, checks it against every
+matching quota's hard limits, and commits the new used totals with CAS
+retries) and plugin/pkg/admission/limitranger/admission.go (LimitRanger —
+Admit defaults container requests/limits from the namespace's LimitRanges,
+Validate enforces min/max/ratio constraints).
+
+The usage evaluators mirror pkg/quota/evaluator/core/pods.go (PodUsageFunc:
+max(sum containers, init containers) per resource, requests.* and limits.*
+plus legacy bare names, count only while not terminal) and the generic
+object-count evaluator (count/{resource} for everything else).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..api.core import LimitRange, Pod, ResourceQuota
+from ..api.quantity import Quantity
+
+
+class QuotaExceeded(Exception):
+    """Maps to HTTP 403 Forbidden, like the reference's quota denial."""
+
+
+# ---------------------------------------------------------------- evaluators
+
+def _pod_compute(pod: Pod) -> Dict[str, Quantity]:
+    """Per-resource Quantities: sum over containers, elementwise max with
+    init containers (ref: pkg/quota/evaluator/core/pods.go podUsageHelper)."""
+    totals: Dict[str, Quantity] = {}
+    limits: Dict[str, Quantity] = {}
+    for c in pod.spec.containers:
+        for name, q in c.resources.requests.items():
+            totals[name] = totals.get(name, Quantity(0)) + q
+        for name, q in c.resources.limits.items():
+            limits[name] = limits.get(name, Quantity(0)) + q
+    for c in pod.spec.init_containers:
+        for name, q in c.resources.requests.items():
+            if q > totals.get(name, Quantity(0)):
+                totals[name] = Quantity(q)
+        for name, q in c.resources.limits.items():
+            if q > limits.get(name, Quantity(0)):
+                limits[name] = Quantity(q)
+    usage: Dict[str, Quantity] = {}
+    for name, q in totals.items():
+        usage[f"requests.{name}"] = q
+        if name in ("cpu", "memory", "ephemeral-storage"):
+            usage[name] = q  # legacy bare names alias requests
+    for name, q in limits.items():
+        usage[f"limits.{name}"] = q
+    return usage
+
+
+def pod_is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in ("Succeeded", "Failed")
+
+
+def evaluate_usage(resource: str, obj: Any) -> Dict[str, Quantity]:
+    """The quota-relevant usage of one object."""
+    usage: Dict[str, Quantity] = {f"count/{resource}": Quantity(1)}
+    if resource == "pods":
+        if pod_is_terminal(obj):
+            return {}
+        usage["pods"] = Quantity(1)
+        usage.update(_pod_compute(obj))
+    elif resource in ("services", "persistentvolumeclaims",
+                      "replicationcontrollers", "resourcequotas",
+                      "configmaps", "secrets"):
+        usage[resource] = Quantity(1)
+        if resource == "persistentvolumeclaims":
+            req = getattr(obj.spec, "resources", None)
+            storage = (req.requests.get("storage")
+                       if req is not None else None)
+            if storage is not None:
+                usage["requests.storage"] = storage
+    return usage
+
+
+def pod_qos_best_effort(pod: Pod) -> bool:
+    """BestEffort = no container carries any cpu/memory request or limit
+    (ref: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS)."""
+    for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+        for res in (c.resources.requests, c.resources.limits):
+            for name in res:
+                if name in ("cpu", "memory"):
+                    return False
+    return True
+
+
+def scope_matches(scope: str, pod: Pod) -> bool:
+    """Ref: pkg/quota/evaluator/core/pods.go podMatchesScopeFunc."""
+    if scope == "Terminating":
+        return pod.spec.active_deadline_seconds is not None
+    if scope == "NotTerminating":
+        return pod.spec.active_deadline_seconds is None
+    if scope == "BestEffort":
+        return pod_qos_best_effort(pod)
+    if scope == "NotBestEffort":
+        return not pod_qos_best_effort(pod)
+    return False
+
+
+# ----------------------------------------------------------- quota admission
+
+class ResourceQuotaAdmission:
+    """Validating plugin: on CREATE, charge the object's usage against every
+    matching quota in its namespace atomically (CAS on quota status), or
+    deny with QuotaExceeded -> 403.
+
+    Like the reference, replenishment on delete is the quota CONTROLLER's
+    job (full recalculation); admission only ever charges forward, so a
+    burst can never overshoot but transiently-stale `used` can under-admit
+    until the controller resyncs.
+    """
+
+    def __init__(self, client):
+        self.client = client
+
+    def validate(self, operation: str, resource: str, obj: Any) -> None:
+        if operation != "CREATE" or resource == "resourcequotas":
+            return
+        ns = getattr(getattr(obj, "metadata", None), "namespace", "")
+        if not ns:
+            return
+        quotas: List[ResourceQuota] = \
+            self.client.resource_quotas().list(namespace=ns)
+        if not quotas:
+            return
+        delta = evaluate_usage(resource, obj)
+        if not delta:
+            return
+        charged = []  # (quota, keys) already committed, for rollback
+        for quota in quotas:
+            if quota.spec.scopes:
+                if resource != "pods" or not all(
+                        scope_matches(s, obj) for s in quota.spec.scopes):
+                    continue
+            interesting = [k for k in quota.spec.hard
+                           if k in delta and not delta[k].is_zero()]
+            if not interesting:
+                continue
+            try:
+                self._charge(quota, delta, interesting)
+            except QuotaExceeded:
+                # un-charge quotas already committed this request so a
+                # denial leaves no phantom usage behind (the controller
+                # would eventually fix it, but until its resync the
+                # namespace would be falsely throttled)
+                for q, keys in charged:
+                    self._refund(q, delta, keys)
+                raise
+            charged.append((quota, interesting))
+
+    def _charge(self, quota: ResourceQuota, delta: Dict[str, Quantity],
+                keys: List[str]) -> None:
+        """Atomically move used forward, or raise QuotaExceeded. The check
+        runs INSIDE the CAS mutate — a concurrent charge that lands first
+        re-runs this one against the fresh totals (no lost update, no
+        admit-over-limit window)."""
+        name, ns = quota.metadata.name, quota.metadata.namespace
+
+        def mutate(live):
+            hard = live.spec.hard
+            used = dict(live.status.used)
+            for k in keys:
+                if k not in hard:
+                    continue  # hard shrank since we listed
+                new = used.get(k, Quantity(0)) + delta[k]
+                if new > hard[k]:
+                    raise QuotaExceeded(
+                        f"exceeded quota: {name}, requested: "
+                        f"{k}={delta[k]}, used: "
+                        f"{k}={used.get(k, Quantity(0))}, limited: "
+                        f"{k}={hard[k]}")
+                used[k] = new
+            live.status.hard = dict(live.spec.hard)
+            live.status.used = used
+            return live
+
+        self.client.resource_quotas().patch(name, mutate, namespace=ns)
+
+    def _refund(self, quota: ResourceQuota, delta: Dict[str, Quantity],
+                keys: List[str]) -> None:
+        def mutate(live):
+            used = dict(live.status.used)
+            zero = Quantity(0)
+            for k in keys:
+                cur = used.get(k, zero) - delta[k]
+                used[k] = cur if cur > zero else Quantity(0)
+            live.status.used = used
+            return live
+        try:
+            self.client.resource_quotas().patch(
+                quota.metadata.name, mutate,
+                namespace=quota.metadata.namespace)
+        except Exception:
+            pass  # the controller's recalculation is the backstop
+
+
+# ----------------------------------------------------------------- limitranger
+
+class LimitRanger:
+    """Mutate-then-validate plugin: default container requests/limits from
+    the namespace's LimitRange items, then enforce min/max and
+    maxLimitRequestRatio (ref: plugin/pkg/admission/limitranger)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def _ranges(self, ns: str) -> List[LimitRange]:
+        return self.client.limit_ranges().list(namespace=ns)
+
+    # ---- Admit (mutating): apply defaults
+
+    def admit(self, operation: str, resource: str, obj: Any):
+        if operation != "CREATE" or resource != "pods":
+            return obj
+        ns = obj.metadata.namespace
+        if not ns:
+            return obj
+        for lr in self._ranges(ns):
+            for item in lr.spec.limits:
+                if item.type != "Container":
+                    continue
+                for c in obj.spec.containers + obj.spec.init_containers:
+                    for name, q in item.default_request.items():
+                        c.resources.requests.setdefault(name, Quantity(q))
+                    for name, q in item.default.items():
+                        c.resources.limits.setdefault(name, Quantity(q))
+                    # defaulted limits imply requests when absent (the
+                    # reference derives request from limit for Burstable)
+                    for name, q in c.resources.limits.items():
+                        c.resources.requests.setdefault(name, Quantity(q))
+        return obj
+
+    # ---- Validate: enforce constraints
+
+    def validate(self, operation: str, resource: str, obj: Any) -> None:
+        if operation != "CREATE" or resource != "pods":
+            return
+        ns = obj.metadata.namespace
+        if not ns:
+            return
+        for lr in self._ranges(ns):
+            for item in lr.spec.limits:
+                if item.type == "Container":
+                    for c in obj.spec.containers + obj.spec.init_containers:
+                        self._check(item, c.resources.requests,
+                                    c.resources.limits,
+                                    f"container {c.name!r}")
+                elif item.type == "Pod":
+                    req: Dict[str, Quantity] = {}
+                    lim: Dict[str, Quantity] = {}
+                    for c in obj.spec.containers:
+                        for name, q in c.resources.requests.items():
+                            req[name] = req.get(name, Quantity(0)) + q
+                        for name, q in c.resources.limits.items():
+                            lim[name] = lim.get(name, Quantity(0)) + q
+                    self._check(item, req, lim, "pod")
+
+    @staticmethod
+    def _check(item, requests: Dict[str, Quantity],
+               limits: Dict[str, Quantity], what: str) -> None:
+        from .server import AdmissionDenied
+        for name, lo in item.min.items():
+            got = requests.get(name, limits.get(name))
+            if got is not None and got < lo:
+                raise AdmissionDenied(
+                    f"minimum {name} usage per {item.type} is {lo}, but "
+                    f"{what} requests {got}")
+        for name, hi in item.max.items():
+            got = limits.get(name, requests.get(name))
+            if got is not None and got > hi:
+                raise AdmissionDenied(
+                    f"maximum {name} usage per {item.type} is {hi}, but "
+                    f"{what} uses {got}")
+        for name, ratio in item.max_limit_request_ratio.items():
+            r = requests.get(name)
+            l = limits.get(name)
+            if r is not None and l is not None and not r.is_zero():
+                if l.as_fraction() / r.as_fraction() > ratio.as_fraction():
+                    raise AdmissionDenied(
+                        f"{name} max limit to request ratio per {item.type} "
+                        f"is {ratio}, but provided ratio is "
+                        f"{l.as_fraction() / r.as_fraction()}")

@@ -33,6 +33,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api import serde
 from ..api.core import Binding
+from .admission import QuotaExceeded
 from ..api.validation import ValidationError
 from ..runtime.scheme import SCHEME, Scheme
 from ..state.client import Client
@@ -89,6 +90,15 @@ class APIServer:
         self.authorizer = None
         self._bootstrap_namespaces()
         self.admission.validators.append(self._namespace_lifecycle)
+        # default-enabled plugins (ref: kube-apiserver's default enabled
+        # admission set includes LimitRanger and ResourceQuota; both no-op
+        # in namespaces carrying no LimitRange/ResourceQuota objects)
+        from .admission import LimitRanger, ResourceQuotaAdmission
+        limitranger = LimitRanger(self.client)
+        self.admission.mutators.append(limitranger.admit)
+        self.admission.validators.append(limitranger.validate)
+        self.admission.validators.append(
+            ResourceQuotaAdmission(self.client).validate)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -223,6 +233,9 @@ class APIServer:
             self._error(h, 409, "AlreadyExists", str(e))
         except ConflictError as e:
             self._error(h, 409, "Conflict", str(e))
+        except QuotaExceeded as e:
+            # the reference's quota denial is 403 Forbidden, not 422
+            self._error(h, 403, "Forbidden", str(e))
         except (ValidationError, AdmissionDenied, ValueError) as e:
             self._error(h, 422, "Invalid", str(e))
         except (BrokenPipeError, ConnectionResetError):

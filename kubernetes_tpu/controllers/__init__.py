@@ -27,6 +27,7 @@ from .base import Controller
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
 from .job import JobController
@@ -35,12 +36,15 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
 from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
 __all__ = ["Controller", "ControllerManager", "CronJobController",
            "DaemonSetController", "DeploymentController",
-           "EndpointsController", "GarbageCollector", "JobController",
+           "DisruptionController", "EndpointsController",
+           "GarbageCollector", "JobController",
            "NamespaceController", "NodeLifecycleController",
            "PersistentVolumeBinder", "PodGCController",
-           "ReplicaSetController", "StatefulSetController"]
+           "ReplicaSetController", "ResourceQuotaController",
+           "StatefulSetController"]

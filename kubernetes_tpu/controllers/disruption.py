@@ -1,0 +1,215 @@
+"""Disruption controller — computes PodDisruptionBudget status.
+
+Ref: pkg/controller/disruption/disruption.go (trySync :560 ->
+getExpectedPodCount :640 -> updatePdbStatus :720). This is what makes
+PDB protection real: the scheduler's preemption path reads
+status.disruptions_allowed (scheduler/preemption.py) and nothing else
+writes it.
+
+Semantics follow the reference:
+  - minAvailable as integer: expectedCount = len(matching pods),
+    desiredHealthy = minAvailable.
+  - minAvailable as percent / maxUnavailable (any form): expectedCount =
+    sum of the scales of the DISTINCT controllers owning the matching pods
+    (RC/RS/StatefulSet; an RS owned by a Deployment reports the
+    Deployment's replicas), resolved percentages round up.
+  - disruptionsAllowed = currentHealthy - desiredHealthy - recent
+    disruptions, floored at 0. DisruptedPods entries expire after 2
+    minutes or when the pod is gone (DeletionTimeout pruning).
+
+Divergence: a matching pod with no controller ref contributes scale 1
+instead of failing the sync (the reference raises a "found no controllers"
+condition); a single orphan then degrades protection gracefully rather
+than freezing the budget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api import labels as labelsmod
+from ..api.apps import Deployment, ReplicaSet, StatefulSet
+from ..api.core import Pod, ReplicationController
+from ..api.meta import controller_ref
+from ..api.policy import PodDisruptionBudget
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import NotFoundError
+from .base import Controller
+from .deployment import resolve_int_or_percent
+from .replicaset import pod_is_ready
+
+#: DeletionTimeout (disruption.go:63) — how long an eviction-marked pod
+#: keeps counting against the budget before we conclude it never died
+DELETION_TIMEOUT = 120.0
+
+
+class DisruptionController(Controller):
+    name = "disruption"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pdb_informer = informers.informer_for(PodDisruptionBudget)
+        self.pod_informer = informers.informer_for(Pod)
+        self.rs_informer = informers.informer_for(ReplicaSet)
+        self.rc_informer = informers.informer_for(ReplicationController)
+        self.dep_informer = informers.informer_for(Deployment)
+        self.ss_informer = informers.informer_for(StatefulSet)
+        self.pdb_informer.add_event_handlers(EventHandlers(
+            on_add=lambda p: self.enqueue(p.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key())))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod_event,
+            on_update=lambda old, new: self._on_pod_event(new),
+            on_delete=self._on_pod_event))
+
+    def _on_pod_event(self, pod: Pod) -> None:
+        for pdb in self._pdbs_for_pod(pod):
+            self.enqueue(pdb.metadata.key())
+
+    def _pdbs_for_pod(self, pod: Pod) -> List[PodDisruptionBudget]:
+        out = []
+        for pdb in self.pdb_informer.indexer.list(pod.metadata.namespace):
+            if pdb.spec.selector is not None and labelsmod.matches(
+                    pdb.spec.selector, pod.metadata.labels):
+                out.append(pdb)
+        return out
+
+    # ----------------------------------------------------- scale resolution
+
+    def _controller_scale(self, ns: str, ref) -> Optional[int]:
+        """The scale of the controller owning a pod (ref: the finders list,
+        disruption.go:180-260)."""
+        if ref.kind == "ReplicationController":
+            rc = self.rc_informer.indexer.get_by_key(f"{ns}/{ref.name}")
+            return rc.spec.replicas if rc is not None else None
+        if ref.kind == "StatefulSet":
+            ss = self.ss_informer.indexer.get_by_key(f"{ns}/{ref.name}")
+            return ss.spec.replicas if ss is not None else None
+        if ref.kind == "ReplicaSet":
+            rs = self.rs_informer.indexer.get_by_key(f"{ns}/{ref.name}")
+            if rs is None:
+                return None
+            dref = controller_ref(rs.metadata)
+            if dref is not None and dref.kind == "Deployment":
+                dep = self.dep_informer.indexer.get_by_key(
+                    f"{ns}/{dref.name}")
+                if dep is not None:
+                    return dep.spec.replicas
+            return rs.spec.replicas
+        return None
+
+    def _expected_scale(self, pdb: PodDisruptionBudget,
+                        pods: List[Pod]) -> Optional[int]:
+        """None = some controller could not be resolved (unknown kind or
+        not yet in the informer cache). The caller must FAIL SAFE on None
+        (disruptionsAllowed=0) like the reference's failSafe path — scoring
+        it as 0 replicas would fail OPEN and unprotect every pod."""
+        seen: Dict[Tuple[str, str, str], int] = {}
+        orphans = 0
+        ns = pdb.metadata.namespace
+        for pod in pods:
+            ref = controller_ref(pod.metadata)
+            if ref is None:
+                orphans += 1
+                continue
+            key = (ref.kind, ref.name, ref.uid)
+            if key in seen:
+                continue
+            scale = self._controller_scale(ns, ref)
+            if scale is None:
+                return None
+            seen[key] = scale
+        return sum(seen.values()) + orphans
+
+    # ---------------------------------------------------------------- sync
+
+    def sync(self, key: str) -> None:
+        pdb = self.pdb_informer.indexer.get_by_key(key)
+        if pdb is None:
+            return
+        pods = [p for p in self.pod_informer.indexer.list(
+                    pdb.metadata.namespace)
+                if pdb.spec.selector is not None
+                and labelsmod.matches(pdb.spec.selector, p.metadata.labels)
+                and p.status.phase not in ("Succeeded", "Failed")]
+        current_healthy = sum(1 for p in pods if pod_is_ready(p))
+
+        min_a, max_u = pdb.spec.min_available, pdb.spec.max_unavailable
+        fail_safe = False
+        if max_u is not None:
+            expected = self._expected_scale(pdb, pods)
+            if expected is None:
+                expected, fail_safe = len(pods), True
+                desired_healthy = expected
+            else:
+                mu = resolve_int_or_percent(str(max_u), expected, True)
+                desired_healthy = max(0, expected - mu)
+        elif min_a is not None and isinstance(min_a, str) and \
+                min_a.endswith("%"):
+            expected = self._expected_scale(pdb, pods)
+            if expected is None:
+                expected, fail_safe = len(pods), True
+                desired_healthy = expected
+            else:
+                desired_healthy = resolve_int_or_percent(min_a, expected,
+                                                         True)
+        else:
+            expected = len(pods)
+            desired_healthy = int(min_a) if min_a is not None else 0
+
+        disrupted = self._prune_disrupted(pdb, pods)
+        allowed = current_healthy - desired_healthy - len(disrupted)
+        if allowed < 0 or fail_safe:
+            # failSafe (ref: disruption.go failSafe): an unresolvable
+            # controller denies all disruptions rather than allowing all
+            allowed = 0
+
+        st = pdb.status
+        observed = pdb.metadata.generation
+        if (st.current_healthy == current_healthy
+                and st.desired_healthy == desired_healthy
+                and st.expected_pods == expected
+                and st.disruptions_allowed == allowed
+                and dict(st.disrupted_pods) == disrupted
+                and st.observed_generation == observed):
+            return
+
+        def mutate(cur):
+            cur.status.current_healthy = current_healthy
+            cur.status.desired_healthy = desired_healthy
+            cur.status.expected_pods = expected
+            cur.status.disruptions_allowed = allowed
+            cur.status.disrupted_pods = disrupted
+            cur.status.observed_generation = max(
+                cur.status.observed_generation, observed)
+            return cur
+        try:
+            self.client.pod_disruption_budgets().patch(
+                pdb.metadata.name, mutate, namespace=pdb.metadata.namespace)
+        except NotFoundError:
+            pass  # deleted since we read it; anything else requeues
+
+    def _prune_disrupted(self, pdb: PodDisruptionBudget,
+                         pods: List[Pod]) -> Dict[str, str]:
+        """Drop DisruptedPods entries for pods already gone/deleting or
+        older than DELETION_TIMEOUT (ref: buildDisruptedPodMap :700)."""
+        present = {p.metadata.name: p for p in pods}
+        out: Dict[str, str] = {}
+        now = time.time()
+        for name, stamp in pdb.status.disrupted_pods.items():
+            pod = present.get(name)
+            if pod is None or pod.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                from datetime import datetime, timezone
+                dt = datetime.fromisoformat(stamp.replace("Z", "+00:00"))
+                age = now - dt.timestamp()
+            except Exception:
+                age = 0.0
+            if age > DELETION_TIMEOUT:
+                continue
+            out[name] = stamp
+        return out

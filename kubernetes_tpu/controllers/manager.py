@@ -14,6 +14,7 @@ from ..state.informer import SharedInformerFactory
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollector
 from .job import JobController
@@ -21,6 +22,7 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
 from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
@@ -57,6 +59,8 @@ class ControllerManager:
             grace_period=node_grace_period,
             eviction_timeout=pod_eviction_timeout)
         self.garbagecollector = GarbageCollector(client, self.informers)
+        self.disruption = DisruptionController(client, self.informers)
+        self.resourcequota = ResourceQuotaController(client, self.informers)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -66,7 +70,8 @@ class ControllerManager:
             self.deployment, self.job, self.statefulset,
             self.daemonset, self.cronjob, self.endpoints,
             self.namespace, self.pv_binder, self.nodelifecycle,
-            self.garbagecollector, self.podgc]
+            self.garbagecollector, self.podgc, self.disruption,
+            self.resourcequota]
 
     def start(self) -> None:
         self.informers.start()

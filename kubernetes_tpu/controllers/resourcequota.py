@@ -1,0 +1,153 @@
+"""ResourceQuota controller — reconciles quota status.used against the
+objects actually present.
+
+Ref: pkg/controller/resourcequota/resource_quota_controller.go (syncResourceQuota
+:230 recalculates usage with the quota registry's evaluators and writes status
+when it drifts) + replenishment: deletions of tracked objects enqueue every
+quota in their namespace so freed usage is returned promptly rather than on
+the full-resync timer.
+
+Admission (apiserver/admission.py) only charges forward; this loop is the
+source of truth that also releases.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+from ..api.core import PersistentVolumeClaim, Pod, ResourceQuota, Service
+from ..api.quantity import Quantity
+from ..apiserver.admission import evaluate_usage, scope_matches
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+
+class ResourceQuotaController(Controller):
+    name = "resourcequota"
+
+    #: resource-name -> informer-tracked kind that can change its usage
+    TRACKED = {"pods": Pod, "services": Service,
+               "persistentvolumeclaims": PersistentVolumeClaim}
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 resync_period: float = 30.0, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.resync_period = resync_period
+        self.quota_informer = informers.informer_for(ResourceQuota)
+        self.quota_informer.add_event_handlers(EventHandlers(
+            on_add=lambda q: self.enqueue(q.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key())))
+        self._informers = {}
+        for resource, cls in self.TRACKED.items():
+            inf = informers.informer_for(cls)
+            inf.add_event_handlers(EventHandlers(
+                on_delete=self._replenish,
+                # pod phase flips to Succeeded/Failed release quota too
+                on_update=self._maybe_replenish_update))
+            self._informers[resource] = inf
+        self._resync_thread = None
+        self._stopped = threading.Event()
+
+    # ----------------------------------------------------------- handlers
+
+    def _replenish(self, obj) -> None:
+        ns = obj.metadata.namespace
+        for q in self.quota_informer.indexer.list(ns):
+            self.enqueue(q.metadata.key())
+
+    def _maybe_replenish_update(self, old, new) -> None:
+        if getattr(new, "kind", "") != "Pod":
+            return
+        terminal = ("Succeeded", "Failed")
+        if old.status.phase not in terminal and new.status.phase in terminal:
+            self._replenish(new)
+
+    # --------------------------------------------------------------- sync
+
+    def sync(self, key: str) -> None:
+        quota = self.quota_informer.indexer.get_by_key(key)
+        if quota is None:
+            return
+        ns = quota.metadata.namespace
+        used: Dict[str, Quantity] = {}
+        recounted = set()
+        for resource in self._relevant_resources(quota):
+            inf = self._informers.get(resource)
+            if inf is not None:
+                objs = inf.indexer.list(ns)
+            else:
+                # no informer for this resource: count through the client
+                # (covers count/{resource} on any registered kind)
+                from ..runtime.scheme import SCHEME
+                cls = SCHEME.type_for_resource(resource)
+                if cls is None:
+                    continue
+                try:
+                    objs = self.client.resource(cls).list(namespace=ns)
+                except Exception:
+                    continue  # can't recount -> keep admission's charge
+            recounted.add(resource)
+            for obj in objs:
+                if quota.spec.scopes and resource == "pods":
+                    if not all(scope_matches(s, obj)
+                               for s in quota.spec.scopes):
+                        continue
+                for k, v in evaluate_usage(resource, obj).items():
+                    if k in quota.spec.hard:
+                        used[k] = used.get(k, Quantity(0)) + v
+        # every hard key reports a used total, even when zero (the
+        # reference's status always mirrors spec.hard's key set) — but a
+        # key whose resource could NOT be recounted keeps its current
+        # value: zeroing it would wipe admission's charges
+        for k in quota.spec.hard:
+            if k in used:
+                continue
+            if self._resource_of_key(k) in recounted:
+                used[k] = Quantity(0)
+            else:
+                used[k] = quota.status.used.get(k, Quantity(0))
+        if dict(quota.status.used) == used and \
+                dict(quota.status.hard) == dict(quota.spec.hard):
+            return
+
+        def mutate(live):
+            live.status.hard = dict(live.spec.hard)
+            live.status.used = used
+            return live
+        self.client.resource_quotas().patch(
+            quota.metadata.name, mutate, namespace=ns)
+
+    @staticmethod
+    def _resource_of_key(key: str) -> str:
+        """Which resource a hard key counts (pods for compute keys)."""
+        if key.startswith("count/"):
+            return key[len("count/"):]
+        if key == "requests.storage":
+            return "persistentvolumeclaims"
+        if key.startswith("requests.") or key.startswith("limits.") or \
+                key in ("pods", "cpu", "memory", "ephemeral-storage"):
+            return "pods"
+        return key
+
+    def _relevant_resources(self, quota: ResourceQuota) -> List[str]:
+        return sorted({self._resource_of_key(k) for k in quota.spec.hard})
+
+    # ------------------------------------------------------------- resync
+
+    def run(self) -> None:
+        super().run()
+        self._resync_thread = threading.Thread(
+            target=self._resync_loop, daemon=True, name="quota-resync")
+        self._resync_thread.start()
+
+    def _resync_loop(self) -> None:
+        while not self._stopped.wait(self.resync_period):
+            for q in self.quota_informer.indexer.list(None):
+                self.enqueue(q.metadata.key())
+
+    def stop(self) -> None:
+        self._stopped.set()
+        super().stop()

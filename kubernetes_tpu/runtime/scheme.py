@@ -13,9 +13,9 @@ from typing import Any, Dict, Optional, Tuple, Type
 from ..api import serde
 from ..api.apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
 from ..api.batch import CronJob, Job
-from ..api.core import (Binding, Endpoints, Event, Namespace, Node,
-                        PersistentVolume, PersistentVolumeClaim, Pod,
-                        ReplicationController, Service)
+from ..api.core import (Binding, Endpoints, Event, LimitRange, Namespace,
+                        Node, PersistentVolume, PersistentVolumeClaim, Pod,
+                        ReplicationController, ResourceQuota, Service)
 from ..api.policy import Lease, PodDisruptionBudget, PriorityClass, StorageClass
 
 
@@ -82,6 +82,8 @@ def default_scheme() -> Scheme:
                "persistentvolumeclaims")
     s.register(ReplicationController, "v1", "ReplicationController",
                "replicationcontrollers")
+    s.register(ResourceQuota, "v1", "ResourceQuota", "resourcequotas")
+    s.register(LimitRange, "v1", "LimitRange", "limitranges")
     s.register(Deployment, "apps/v1", "Deployment", "deployments")
     s.register(ReplicaSet, "apps/v1", "ReplicaSet", "replicasets")
     s.register(StatefulSet, "apps/v1", "StatefulSet", "statefulsets")

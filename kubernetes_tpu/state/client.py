@@ -255,3 +255,11 @@ class Client:
     def leases(self, namespace: Optional[str] = None) -> ResourceClient:
         from ..api.policy import Lease
         return self.resource(Lease, namespace)
+
+    def resource_quotas(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.core import ResourceQuota
+        return self.resource(ResourceQuota, namespace)
+
+    def limit_ranges(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.core import LimitRange
+        return self.resource(LimitRange, namespace)
