@@ -1,0 +1,276 @@
+"""Patch algorithms — the apimachinery patch types, over wire-format dicts.
+
+Ref: staging/src/k8s.io/apiserver/pkg/endpoints/handlers/patch.go:45
+(patcher dispatching on content type) and
+staging/src/k8s.io/apimachinery/pkg/util/strategicpatch. Three algorithms:
+
+  json_merge_patch    RFC 7386: objects merge recursively, null deletes,
+                      arrays and scalars replace.
+  json_patch          RFC 6902 op list (add/remove/replace/test/copy/move).
+  strategic_merge     merge-patch semantics PLUS lists of objects keyed by
+                      "name" merge element-wise by that key (the reference's
+                      patchMergeKey for containers/ports/env/volumes), and
+                      {"$patch": "delete"} entries remove by key. Lists
+                      without a name key replace, as VERDICT r2's
+                      strategic-merge-lite scoping allows.
+
+For kubectl apply, three_way_merge_patch(original, modified, current)
+computes the patch the reference's CreateThreeWayMergePatch produces:
+deletions of fields the previous apply set that the new config dropped,
+plus everything the new config changes vs the live object.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+
+# ------------------------------------------------------------ merge patch
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 application. Returns a new value; inputs are not mutated."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    out = {k: copy.deepcopy(v) for k, v in target.items()}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(target.get(k), v)
+    return out
+
+
+def diff_merge_patch(old: Any, new: Any) -> Optional[Dict[str, Any]]:
+    """The RFC 7386 patch transforming old -> new (None when equal for
+    non-dict leaves; {} when dicts already match)."""
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        return copy.deepcopy(new)
+    patch: Dict[str, Any] = {}
+    for k in old:
+        if k not in new:
+            patch[k] = None
+    for k, v in new.items():
+        if k not in old:
+            patch[k] = copy.deepcopy(v)
+        elif old[k] != v:
+            if isinstance(old[k], dict) and isinstance(v, dict):
+                patch[k] = diff_merge_patch(old[k], v)
+            else:
+                patch[k] = copy.deepcopy(v)
+    return patch
+
+
+# -------------------------------------------------------- strategic merge
+
+def _merge_named_list(target: List, patch: List) -> List:
+    """Merge two lists of {"name": ...} objects by name, preserving target
+    order, appending new entries, honoring {"$patch": "delete"}."""
+    out = [copy.deepcopy(e) for e in target]
+    index = {e.get("name"): i for i, e in enumerate(out)
+             if isinstance(e, dict)}
+    for e in patch:
+        if not isinstance(e, dict) or "name" not in e:
+            continue
+        name = e["name"]
+        if e.get("$patch") == "delete":
+            if name in index:
+                out = [x for x in out
+                       if not (isinstance(x, dict) and x.get("name") == name)]
+                index = {x.get("name"): i for i, x in enumerate(out)
+                         if isinstance(x, dict)}
+            continue
+        if name in index:
+            out[index[name]] = strategic_merge(out[index[name]], e)
+        else:
+            out.append(copy.deepcopy(e))
+    return out
+
+
+def _is_named_list(v: Any) -> bool:
+    return (isinstance(v, list) and v
+            and all(isinstance(e, dict) and "name" in e for e in v))
+
+
+def strategic_merge(target: Any, patch: Any) -> Any:
+    if not isinstance(patch, dict):
+        if _is_named_list(patch) and _is_named_list(target):
+            return _merge_named_list(target, patch)
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    out = {k: copy.deepcopy(v) for k, v in target.items()}
+    for k, v in patch.items():
+        if k == "$patch":
+            continue
+        if v is None:
+            out.pop(k, None)
+        elif _is_named_list(v) and _is_named_list(target.get(k)):
+            out[k] = _merge_named_list(target[k], v)
+        else:
+            out[k] = strategic_merge(target.get(k), v)
+    return out
+
+
+# ------------------------------------------------------------- JSON patch
+
+class JSONPatchError(ValueError):
+    pass
+
+
+def _ptr_parts(pointer: str) -> List[str]:
+    if pointer == "":
+        return []
+    if not pointer.startswith("/"):
+        raise JSONPatchError(f"invalid pointer {pointer!r}")
+    return [p.replace("~1", "/").replace("~0", "~")
+            for p in pointer[1:].split("/")]
+
+
+def _ptr_get(doc: Any, parts: List[str]) -> Any:
+    for p in parts:
+        if isinstance(doc, list):
+            doc = doc[int(p)]
+        elif isinstance(doc, dict):
+            if p not in doc:
+                raise JSONPatchError(f"path segment {p!r} not found")
+            doc = doc[p]
+        else:
+            raise JSONPatchError(f"cannot traverse {type(doc).__name__}")
+    return doc
+
+
+def _ptr_set(doc: Any, parts: List[str], value: Any, insert: bool) -> None:
+    parent = _ptr_get(doc, parts[:-1])
+    last = parts[-1]
+    if isinstance(parent, list):
+        idx = len(parent) if last == "-" else int(last)
+        if insert:
+            parent.insert(idx, value)
+        else:
+            parent[idx] = value
+    elif isinstance(parent, dict):
+        parent[last] = value
+    else:
+        raise JSONPatchError(f"cannot write into {type(parent).__name__}")
+
+
+def _ptr_remove(doc: Any, parts: List[str]) -> Any:
+    parent = _ptr_get(doc, parts[:-1])
+    last = parts[-1]
+    if isinstance(parent, list):
+        return parent.pop(int(last))
+    if isinstance(parent, dict):
+        if last not in parent:
+            raise JSONPatchError(f"path segment {last!r} not found")
+        return parent.pop(last)
+    raise JSONPatchError(f"cannot remove from {type(parent).__name__}")
+
+
+def json_patch(doc: Any, ops: List[Dict[str, Any]]) -> Any:
+    """RFC 6902 application. Returns a new document. Malformed ops raise
+    JSONPatchError (a ValueError) — never bare KeyError/IndexError, which
+    HTTP dispatch would misclassify as 404/500."""
+    doc = copy.deepcopy(doc)
+    for op in ops:
+        try:
+            doc = _apply_op(doc, op)
+        except JSONPatchError:
+            raise
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            raise JSONPatchError(f"invalid patch op {op!r}: {e}")
+    return doc
+
+
+def _apply_op(doc: Any, op: Dict[str, Any]) -> Any:
+    kind = op.get("op")
+    parts = _ptr_parts(op.get("path", ""))
+    if kind == "add":
+        _ptr_set(doc, parts, copy.deepcopy(op["value"]), insert=True)
+    elif kind == "replace":
+        _ptr_get(doc, parts)  # must exist
+        _ptr_set(doc, parts, copy.deepcopy(op["value"]), insert=False)
+    elif kind == "remove":
+        _ptr_remove(doc, parts)
+    elif kind == "test":
+        if _ptr_get(doc, parts) != op["value"]:
+            raise JSONPatchError(f"test failed at {op.get('path')!r}")
+    elif kind == "copy":
+        val = copy.deepcopy(_ptr_get(doc, _ptr_parts(op["from"])))
+        _ptr_set(doc, parts, val, insert=True)
+    elif kind == "move":
+        val = _ptr_remove(doc, _ptr_parts(op["from"]))
+        _ptr_set(doc, parts, val, insert=True)
+    else:
+        raise JSONPatchError(f"unknown op {kind!r}")
+    return doc
+
+
+# ---------------------------------------------------------------- 3-way
+
+#: the annotation kubectl records its input under
+#: (ref: k8s.io/kubectl/pkg/util/apply.go)
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def three_way_merge_patch(original: Any, modified: Any,
+                          current: Any) -> Dict[str, Any]:
+    """The apply patch: delete what the ORIGINAL config set but the new
+    (MODIFIED) config dropped — without touching fields others own on
+    CURRENT — plus everything modified adds or changes vs current.
+    Ref: strategicpatch.CreateThreeWayMergePatch."""
+    deletions = _deletions(original, modified, current)
+    changes = diff_merge_patch(current, modified) \
+        if isinstance(current, dict) and isinstance(modified, dict) else {}
+    # changes computed against current would also delete fields the new
+    # config simply doesn't mention (defaulted/other-owner fields); keep
+    # only the ADDITIVE half and let `deletions` carry intentional drops
+    additive = _strip_deletions(changes, modified)
+    return _combine_patches(additive, deletions)
+
+
+def _combine_patches(a: Any, b: Any) -> Any:
+    """Union of two merge patches; b's entries (incl. nulls) win. Unlike
+    json_merge_patch this KEEPS null values — they are the patch's delete
+    directives, not deletions to apply here."""
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return copy.deepcopy(b)
+    out = dict(a)
+    for k, v in b.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _combine_patches(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _strip_deletions(patch: Any, modified: Any) -> Any:
+    if not isinstance(patch, dict):
+        return patch
+    out = {}
+    for k, v in patch.items():
+        if v is None:
+            continue  # current-only field the new config doesn't mention
+        mv = modified.get(k) if isinstance(modified, dict) else None
+        out[k] = _strip_deletions(v, mv)
+    return out
+
+
+def _deletions(original: Any, modified: Any, current: Any) -> Dict[str, Any]:
+    """null-entries for keys original set that modified dropped."""
+    if not isinstance(original, dict) or not isinstance(modified, dict):
+        return {}
+    out: Dict[str, Any] = {}
+    for k, v in original.items():
+        if k not in modified:
+            if isinstance(current, dict) and k in current:
+                out[k] = None
+        elif isinstance(v, dict):
+            sub = _deletions(v, modified[k],
+                             current.get(k) if isinstance(current, dict)
+                             else None)
+            if sub:
+                out[k] = sub
+    return out

@@ -132,10 +132,18 @@ class HTTPResourceClient:
             headers["Authorization"] = f"Bearer {self._token}"
         return headers
 
-    def _request(self, method: str, url: str, body: Any = None):
-        data = serde.to_json_str(body).encode() if body is not None else None
+    def _request(self, method: str, url: str, body: Any = None,
+                 content_type: Optional[str] = None):
+        if content_type is not None:
+            data = json.dumps(body).encode() if body is not None else None
+        else:
+            data = serde.to_json_str(body).encode() \
+                if body is not None else None
+        headers = self._headers()
+        if content_type is not None:
+            headers["Content-Type"] = content_type
         req = urlrequest.Request(url, data=data, method=method,
-                                 headers=self._headers())
+                                 headers=headers)
         try:
             with urlrequest.urlopen(req) as resp:
                 return json.loads(resp.read())
@@ -190,16 +198,49 @@ class HTTPResourceClient:
             "PUT", self._url(obj.metadata.name, namespace=ns,
                              subresource="status"), obj))
 
+    def _raw_patch(self, name: str, body: Any, content_type: str,
+                   namespace: Optional[str] = None, subresource: str = ""):
+        ns = namespace if namespace is not None else self._effective_ns()
+        url = self._url(name, namespace=ns, subresource=subresource)
+        return self._decode(self._request("PATCH", url, body,
+                                          content_type=content_type))
+
+    def merge_patch(self, name: str, patch: dict,
+                    namespace: Optional[str] = None, subresource: str = "",
+                    strategic: bool = True):
+        """Send a server-side merge patch (strategic by default — named
+        lists like containers merge by name; RFC 7386 otherwise)."""
+        ctype = "application/strategic-merge-patch+json" if strategic \
+            else "application/merge-patch+json"
+        return self._raw_patch(name, patch, ctype, namespace, subresource)
+
+    def json_patch(self, name: str, ops: list,
+                   namespace: Optional[str] = None, subresource: str = ""):
+        """Send an RFC 6902 op-list patch."""
+        return self._raw_patch(name, ops, "application/json-patch+json",
+                               namespace, subresource)
+
     def patch(self, name: str, mutate: Callable[[Any], Any],
               namespace: Optional[str] = None, retries: int = 16):
-        """Client-side read-modify-write with CAS retry — the server's PUT
-        enforces resourceVersion, giving guaranteed_update semantics over
-        the wire."""
+        """Read-modify-write that ships only the DIFF as a server-side
+        merge patch, preconditioned on the read's resourceVersion (the
+        reference's optimistic-concurrency PATCH). Retries re-read and
+        re-run mutate, so concurrent writers to OTHER fields never lose
+        updates to ours."""
+        from ..api.patch import diff_merge_patch
         for _ in range(retries):
             cur = self.get(name, namespace=namespace)
-            updated = mutate(cur)
+            before = json.loads(serde.to_json_str(cur))
+            updated = mutate(serde.deepcopy_obj(cur))
+            after = json.loads(serde.to_json_str(updated))
+            delta = diff_merge_patch(before, after)
+            if not delta:
+                return cur
+            delta.setdefault("metadata", {})["resourceVersion"] = \
+                cur.metadata.resource_version
             try:
-                return self.update(updated)
+                return self.merge_patch(name, delta, namespace=namespace,
+                                        strategic=False)
             except ConflictError:
                 continue
         raise ConflictError(f"{self._resource} {name}: too many conflicts")

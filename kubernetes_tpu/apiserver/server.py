@@ -119,6 +119,9 @@ class APIServer:
             def do_DELETE(self):
                 outer._dispatch(self, "DELETE")
 
+            def do_PATCH(self):
+                outer._dispatch(self, "PATCH")
+
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -392,6 +395,19 @@ class APIServer:
                 obj = self.admission.admit("UPDATE", req.resource, obj)
                 out = rc.update(obj)
             self._respond(h, 200, out)
+        elif method == "PATCH":
+            data = self._read_body(h)
+            if data is None:
+                self._error(h, 422, "Invalid", "empty request body")
+                return
+            if not req.name:
+                self._error(h, 405, "MethodNotAllowed",
+                            "PATCH requires a resource name")
+                return
+            ctype = h.headers.get("Content-Type",
+                                  "application/strategic-merge-patch+json")
+            out = self._apply_patch(req, rc, cls, ctype, data)
+            self._respond(h, 200, out)
         elif method == "DELETE":
             if req.resource == "namespaces" and req.name in (
                     "default", "kube-system", "kube-node-lease",
@@ -407,6 +423,59 @@ class APIServer:
             self._respond(h, 200, out)
         else:
             self._error(h, 405, "MethodNotAllowed", method)
+
+    def _apply_patch(self, req: _Request, rc, cls, ctype: str, data):
+        """The PATCH verb (ref: apiserver/pkg/endpoints/handlers/patch.go:45
+        — patcher.patchResource). Dispatches on content type:
+        json-patch (RFC 6902 op list), merge-patch (RFC 7386), or
+        strategic-merge (merge + named-list merging). Applied inside a CAS
+        retry loop against the live object; a metadata.resourceVersion in
+        the patch body (or ?resourceVersion=) is an optimistic-concurrency
+        precondition like the reference's."""
+        from ..api.patch import (JSONPatchError, json_merge_patch,
+                                 json_patch, strategic_merge)
+        ctype = ctype.split(";")[0].strip()
+        expect_rv = req.query.get("resourceVersion")
+        if isinstance(data, dict):
+            expect_rv = (data.get("metadata") or {}) \
+                .get("resourceVersion") or expect_rv
+
+        for _ in range(16):
+            cur = rc.get(req.name, namespace=req.namespace or None)
+            if expect_rv and cur.metadata.resource_version != str(expect_rv):
+                raise ConflictError(
+                    f"{req.resource} {req.name}: the object has been "
+                    f"modified (rv {cur.metadata.resource_version} != "
+                    f"{expect_rv})")
+            enc = json.loads(serde.to_json_str(cur))
+            if ctype == "application/json-patch+json":
+                if not isinstance(data, list):
+                    raise ValueError("json-patch body must be an op list")
+                merged = json_patch(enc, data)
+            elif ctype == "application/merge-patch+json":
+                merged = json_merge_patch(enc, data)
+            else:  # strategic-merge (the kubectl default)
+                merged = strategic_merge(enc, data)
+            obj = serde.decode(cls, merged)
+            if obj.metadata.name != req.name:
+                raise ValueError(
+                    "patch may not change the object's name")
+            if req.namespace and obj.metadata.namespace != req.namespace:
+                raise ValueError(
+                    "patch may not change the object's namespace")
+            # the patch applies to what we just read, whatever rv the
+            # patch body carried
+            obj.metadata.resource_version = cur.metadata.resource_version
+            try:
+                if req.subresource == "status":
+                    return rc.update_status(obj)
+                obj = self.admission.admit("UPDATE", req.resource, obj)
+                return rc.update(obj)
+            except ConflictError:
+                if expect_rv:
+                    raise
+                continue  # unconditional patch: re-read and re-apply
+        raise ConflictError(f"{req.resource} {req.name}: too many conflicts")
 
     def _serve_watch(self, h, req: _Request) -> None:
         """Chunked watch stream: one JSON frame per line (ref: the

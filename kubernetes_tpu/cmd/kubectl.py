@@ -151,11 +151,14 @@ def cmd_describe(args) -> int:
     return 0
 
 
-def _load_manifests(path: str):
+def _load_manifest_dicts(path: str):
     raw = sys.stdin.read() if path == "-" else open(path).read()
     data = json.loads(raw)
-    items = data.get("items", [data]) if isinstance(data, dict) else data
-    return [SCHEME.decode_any(d) for d in items]
+    return data.get("items", [data]) if isinstance(data, dict) else data
+
+
+def _load_manifests(path: str):
+    return [SCHEME.decode_any(d) for d in _load_manifest_dicts(path)]
 
 
 def cmd_create(args) -> int:
@@ -170,29 +173,58 @@ def cmd_create(args) -> int:
 
 
 def cmd_apply(args) -> int:
-    """create-or-update (the 3-way-merge apply reduced to replace-spec)."""
+    """Declarative apply with the reference's 3-way merge: the previous
+    apply's config (the last-applied-configuration annotation) decides
+    which fields WE own — fields we set before and dropped now are
+    deleted; fields other writers own (defaulted values, controller
+    status, foreign labels) are left alone.
+    Ref: k8s.io/kubectl/pkg/cmd/apply + util/apply.go."""
+    from ..api.patch import LAST_APPLIED, three_way_merge_patch
     from ..state.store import NotFoundError
     client = _client(args)
-    for obj in _load_manifests(args.filename):
-        rc = client.resource(type(obj), obj.metadata.namespace or
-                             args.namespace)
+    for raw in _load_manifest_dicts(args.filename):
+        # the RAW manifest is what we own — re-encoding the decoded object
+        # would materialize defaulted fields (e.g. clusterIP: "") and make
+        # apply claim ownership of values the user never wrote
+        obj = SCHEME.decode_any(raw)
+        ns = obj.metadata.namespace or args.namespace
+        rc = client.resource(type(obj), ns)
         kind = SCHEME.resource_for(obj)
+        new_cfg = raw
         try:
-            rc.get(obj.metadata.name, namespace=obj.metadata.namespace
-                   or args.namespace)
+            live = rc.get(obj.metadata.name, namespace=ns)
         except NotFoundError:
+            obj.metadata.annotations[LAST_APPLIED] = \
+                json.dumps(new_cfg, sort_keys=True)
             rc.create(obj)
             print(f"{kind}/{obj.metadata.name} created")
             continue
-
-        def merge(cur, _obj=obj):
-            if hasattr(_obj, "spec"):
-                cur.spec = _obj.spec
-            cur.metadata.labels = dict(_obj.metadata.labels)
-            cur.metadata.annotations = dict(_obj.metadata.annotations)
-            return cur
-        rc.patch(obj.metadata.name, merge,
-                 namespace=obj.metadata.namespace or args.namespace)
+        last_applied = json.dumps(new_cfg, sort_keys=True)
+        original = json.loads(
+            live.metadata.annotations.get(LAST_APPLIED, "") or "{}")
+        current = serde.encode(live)
+        patch = three_way_merge_patch(original, new_cfg, current)
+        patch.pop("status", None)  # apply never writes status
+        md = patch.setdefault("metadata", {})
+        md.pop("resourceVersion", None)
+        from ..api.patch import json_merge_patch
+        # simulate the patch: if the DECODED result equals the live object
+        # (wire-level list replacements often differ textually but decode
+        # identically), skip the write — it would only bump the rv and
+        # wake every watcher on each re-apply
+        simulated = SCHEME.decode_any({**json_merge_patch(current, patch),
+                                       "apiVersion": raw.get("apiVersion"),
+                                       "kind": raw.get("kind")})
+        if simulated == live and \
+                live.metadata.annotations.get(LAST_APPLIED) == last_applied:
+            print(f"{kind}/{obj.metadata.name} unchanged")
+            continue
+        md.setdefault("annotations", {})[LAST_APPLIED] = last_applied
+        # the patch is RFC 7386 (lists carry full replacements, no
+        # $patch:delete directives) — strategic named-list merging would
+        # resurrect list entries the new config dropped
+        rc.merge_patch(obj.metadata.name, patch, namespace=ns,
+                       strategic=False)
         print(f"{kind}/{obj.metadata.name} configured")
     return 0
 
@@ -234,6 +266,54 @@ def cmd_uncordon(args) -> int:
     return _set_unschedulable(args, False, "uncordoned")
 
 
+def cmd_patch(args) -> int:
+    """kubectl patch -p '{"spec": {...}}' [--type strategic|merge|json]."""
+    _, cls = _resolve(args.resource)
+    rc = _client(args).resource(cls, args.namespace)
+    body = json.loads(args.patch)
+    if args.type == "json":
+        out = rc.json_patch(args.name, body, namespace=args.namespace)
+    else:
+        out = rc.merge_patch(args.name, body, namespace=args.namespace,
+                             strategic=(args.type == "strategic"))
+    print(f"{SCHEME.resource_for(out)}/{out.metadata.name} patched")
+    return 0
+
+
+def cmd_label(args) -> int:
+    """kubectl label <resource> <name> k=v ... k- (trailing - removes)."""
+    _, cls = _resolve(args.resource)
+    rc = _client(args).resource(cls, args.namespace)
+    labels = {}
+    for kv in args.labels:
+        if kv.endswith("-") and "=" not in kv:
+            labels[kv[:-1]] = None
+        else:
+            k, _, v = kv.partition("=")
+            labels[k] = v
+    out = rc.merge_patch(args.name, {"metadata": {"labels": labels}},
+                         namespace=args.namespace, strategic=False)
+    print(f"{SCHEME.resource_for(out)}/{out.metadata.name} labeled")
+    return 0
+
+
+def cmd_annotate(args) -> int:
+    _, cls = _resolve(args.resource)
+    rc = _client(args).resource(cls, args.namespace)
+    annotations = {}
+    for kv in args.annotations:
+        if kv.endswith("-") and "=" not in kv:
+            annotations[kv[:-1]] = None
+        else:
+            k, _, v = kv.partition("=")
+            annotations[k] = v
+    out = rc.merge_patch(
+        args.name, {"metadata": {"annotations": annotations}},
+        namespace=args.namespace, strategic=False)
+    print(f"{SCHEME.resource_for(out)}/{out.metadata.name} annotated")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kubectl")
     p.add_argument("--master", "-s", default="http://127.0.0.1:8080")
@@ -273,6 +353,26 @@ def main(argv=None) -> int:
         c = sub.add_parser(verb)
         c.add_argument("name")
         c.set_defaults(fn=fn)
+
+    pa = sub.add_parser("patch")
+    pa.add_argument("resource")
+    pa.add_argument("name")
+    pa.add_argument("--patch", "-p", required=True)
+    pa.add_argument("--type", choices=["strategic", "merge", "json"],
+                    default="strategic")
+    pa.set_defaults(fn=cmd_patch)
+
+    la = sub.add_parser("label")
+    la.add_argument("resource")
+    la.add_argument("name")
+    la.add_argument("labels", nargs="+")
+    la.set_defaults(fn=cmd_label)
+
+    an = sub.add_parser("annotate")
+    an.add_argument("resource")
+    an.add_argument("name")
+    an.add_argument("annotations", nargs="+")
+    an.set_defaults(fn=cmd_annotate)
 
     args = p.parse_args(argv)
     try:

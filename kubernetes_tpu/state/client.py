@@ -101,6 +101,43 @@ class ResourceClient:
         return self._store.guaranteed_update(
             self._resource, ns if self._namespaced else "", name, mutate)
 
+    def merge_patch(self, name: str, patch: dict,
+                    namespace: Optional[str] = None, subresource: str = "",
+                    strategic: bool = True):
+        """Server-side-patch semantics in-process: apply a (strategic)
+        merge patch to the live wire form under CAS (same algorithms the
+        API server's PATCH verb runs — api/patch.py)."""
+        import json as _json
+
+        from ..api.patch import json_merge_patch, strategic_merge
+        from .store import ConflictError
+        ns = namespace if namespace is not None else self._effective_ns()
+        # a resourceVersion in the patch body is an optimistic-concurrency
+        # precondition, exactly like the HTTP PATCH path (server._apply_patch)
+        expect_rv = (patch.get("metadata") or {}).get("resourceVersion") \
+            if isinstance(patch, dict) else None
+
+        def mutate(cur):
+            if expect_rv and \
+                    cur.metadata.resource_version != str(expect_rv):
+                raise ConflictError(
+                    f"{self._resource} {cur.metadata.name}: the object has "
+                    f"been modified (rv {cur.metadata.resource_version} != "
+                    f"{expect_rv})")
+            enc = _json.loads(serde.to_json_str(cur))
+            merged = strategic_merge(enc, patch) if strategic \
+                else json_merge_patch(enc, patch)
+            obj = serde.decode(type(cur), merged)
+            obj.metadata.resource_version = cur.metadata.resource_version
+            if subresource == "status":
+                cur.status = obj.status
+                return cur
+            if self._validate:
+                validate_obj(obj)
+            return obj
+        return self._store.guaranteed_update(
+            self._resource, ns if self._namespaced else "", name, mutate)
+
     #: ref: the lifecycle plugin's immortalNamespaces — a finalizer-gated
     #: Terminating system namespace would be unrecoverable
     IMMORTAL_NAMESPACES = ("default", "kube-system", "kube-node-lease",
