@@ -1,0 +1,92 @@
+"""autoscaling/v1 — Scale subresource and HorizontalPodAutoscaler.
+
+Ref: staging/src/k8s.io/api/autoscaling/v1/types.go. Scale is the virtual
+object GET/PUT .../{resource}/{name}/scale serves — it is never stored;
+the server projects it from the target's spec.replicas
+(ref: pkg/registry/apps/deployment/storage/storage.go ScaleREST).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+
+@dataclass
+class ScaleSpec:
+    replicas: int = 0
+
+
+@dataclass
+class ScaleStatus:
+    replicas: int = 0
+    selector: str = ""
+
+
+@dataclass
+class Scale:
+    api_version: str = "autoscaling/v1"
+    kind: str = "Scale"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ScaleSpec = field(default_factory=ScaleSpec)
+    status: ScaleStatus = field(default_factory=ScaleStatus)
+
+
+def project_scale(obj) -> Scale:
+    """Target workload -> its virtual Scale (ref: ScaleREST.Get building
+    autoscaling.Scale from the stored object)."""
+    sel = getattr(obj.spec, "selector", None)
+    if isinstance(sel, dict):
+        selector = ",".join(f"{k}={v}" for k, v in sorted(sel.items()))
+    elif sel is not None and getattr(sel, "match_labels", None):
+        selector = ",".join(f"{k}={v}"
+                            for k, v in sorted(sel.match_labels.items()))
+    else:
+        selector = ""
+    return Scale(
+        metadata=ObjectMeta(
+            name=obj.metadata.name, namespace=obj.metadata.namespace,
+            uid=obj.metadata.uid,
+            resource_version=obj.metadata.resource_version),
+        spec=ScaleSpec(replicas=obj.spec.replicas),
+        status=ScaleStatus(
+            replicas=getattr(obj.status, "replicas", 0),
+            selector=selector))
+
+
+@dataclass
+class CrossVersionObjectReference:
+    kind: str = ""
+    name: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference)
+    min_replicas: Optional[int] = 1
+    max_replicas: int = 0
+    target_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    observed_generation: int = 0
+    last_scale_time: Optional[str] = None
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    api_version: str = "autoscaling/v1"
+    kind: str = "HorizontalPodAutoscaler"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec)
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus)

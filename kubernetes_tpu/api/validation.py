@@ -147,6 +147,13 @@ def validate_workload(obj) -> None:
         raise ValidationError(errs)
 
 
+#: kinds whose objects must NOT carry a namespace; the scheme adds every
+#: cluster-scoped registration (incl. dynamic CRDs) here
+CLUSTER_SCOPED_KINDS = {
+    "Node", "Namespace", "PersistentVolume", "StorageClass",
+    "PriorityClass", "CustomResourceDefinition"}
+
+
 def validate(obj) -> None:
     if isinstance(obj, Pod):
         validate_pod(obj)
@@ -158,8 +165,7 @@ def validate(obj) -> None:
         errs: List[str] = []
         meta = getattr(obj, "metadata", None)
         if meta is not None:
-            namespaced = getattr(obj, "kind", "") not in (
-                "Node", "Namespace", "PersistentVolume", "StorageClass", "PriorityClass")
+            namespaced = getattr(obj, "kind", "") not in CLUSTER_SCOPED_KINDS
             validate_object_meta(meta, namespaced=namespaced, errs=errs)
         if errs:
             raise ValidationError(errs)

@@ -220,6 +220,21 @@ class HTTPResourceClient:
         return self._raw_patch(name, ops, "application/json-patch+json",
                                namespace, subresource)
 
+    def get_scale(self, name: str, namespace: Optional[str] = None):
+        """GET the /scale subresource (ref: scale client in client-go)."""
+        from ..api.autoscaling import Scale
+        ns = namespace if namespace is not None else self._effective_ns()
+        return serde.decode(Scale, self._request(
+            "GET", self._url(name, namespace=ns, subresource="scale")))
+
+    def update_scale(self, name: str, scale,
+                     namespace: Optional[str] = None):
+        from ..api.autoscaling import Scale
+        ns = namespace if namespace is not None else self._effective_ns()
+        return serde.decode(Scale, self._request(
+            "PUT", self._url(name, namespace=ns, subresource="scale"),
+            scale))
+
     def patch(self, name: str, mutate: Callable[[Any], Any],
               namespace: Optional[str] = None, retries: int = 16):
         """Read-modify-write that ships only the DIFF as a server-side

@@ -89,6 +89,7 @@ class APIServer:
         self.authenticator = None
         self.authorizer = None
         self._bootstrap_namespaces()
+        self._register_existing_crds()
         self.admission.validators.append(self._namespace_lifecycle)
         # default-enabled plugins (ref: kube-apiserver's default enabled
         # admission set includes LimitRanger and ResourceQuota; both no-op
@@ -138,6 +139,42 @@ class APIServer:
                     Namespace(metadata=ObjectMeta(name=name)))
             except AlreadyExistsError:
                 pass  # WAL replay already restored it
+
+    def _register_existing_crds(self) -> None:
+        """CRDs already in the store (handed-in store without WAL replay)
+        must serve immediately."""
+        from ..runtime.crd import register_crd
+        try:
+            items, _ = self.store.list("customresourcedefinitions", None)
+        except Exception:
+            return
+        for crd in items:
+            try:
+                register_crd(crd, self.scheme)
+            except ValueError:
+                pass
+
+    def _delete_cr_instances(self, crd_name: str) -> None:
+        """Deleting a CRD deletes its custom resources (the reference's
+        apiextensions finalizer does this cleanup); without it the orphaned
+        records resurrect on WAL replay once the type re-registers."""
+        try:
+            crd = self.client.resource(
+                self.scheme.type_for_resource(
+                    "customresourcedefinitions")).get(crd_name)
+        except NotFoundError:
+            return
+        plural = crd.spec.names.plural
+        try:
+            items, _ = self.store.list(plural, None)
+        except Exception:
+            return
+        for obj in items:
+            try:
+                self.store.delete(plural, obj.metadata.namespace,
+                                  obj.metadata.name)
+            except NotFoundError:
+                pass
 
     def _namespace_lifecycle(self, operation: str, resource: str,
                              obj) -> None:
@@ -313,8 +350,50 @@ class APIServer:
         length = int(h.headers.get("Content-Length", 0))
         return json.loads(h.rfile.read(length)) if length else None
 
+    #: resources serving the /scale subresource (ref: the ScaleREST
+    #: registrations in pkg/registry/{apps,core}/.../storage.go)
+    SCALABLE = ("deployments", "replicasets", "replicationcontrollers",
+                "statefulsets")
+
+    def _handle_scale(self, h, method: str, req: _Request, rc) -> None:
+        if req.resource not in self.SCALABLE:
+            self._error(h, 404, "NotFound",
+                        f"resource {req.resource} has no scale subresource")
+            return
+        from ..api.autoscaling import project_scale
+        if method == "GET":
+            obj = rc.get(req.name, namespace=req.namespace or None)
+            self._respond(h, 200, project_scale(obj))
+        elif method == "PUT":
+            from ..api.autoscaling import Scale
+            data = self._read_body(h)
+            if data is None:
+                self._error(h, 422, "Invalid", "empty request body")
+                return
+            scale = serde.decode(Scale, data)
+            if scale.spec.replicas < 0:
+                raise ValueError("scale.spec.replicas must be >= 0")
+            expect_rv = scale.metadata.resource_version
+
+            def mutate(cur):
+                if expect_rv and \
+                        cur.metadata.resource_version != expect_rv:
+                    raise ConflictError(
+                        f"{req.resource} {req.name}: the object has been "
+                        f"modified")
+                cur.spec.replicas = scale.spec.replicas
+                return cur
+            out = rc.patch(req.name, mutate,
+                           namespace=req.namespace or None)
+            self._respond(h, 200, project_scale(out))
+        else:
+            self._error(h, 405, "MethodNotAllowed", method)
+
     def _handle(self, h, method: str, req: _Request, cls, user=None) -> None:
         rc = self._rc(cls, req.namespace)
+        if req.subresource == "scale":
+            self._handle_scale(h, method, req, rc)
+            return
         if method == "GET":
             if req.name:
                 obj = rc.get(req.name, namespace=req.namespace or None)
@@ -372,6 +451,15 @@ class APIServer:
                             f"resource {req.resource}")
                 return
             obj = self.admission.admit("CREATE", req.resource, obj)
+            if req.resource == "customresourcedefinitions":
+                # pre-validate WITHOUT registering: a create that fails
+                # after registration would leave a phantom served type
+                from ..runtime.crd import register_crd, validate_crd
+                validate_crd(obj, self.scheme)
+                out = rc.create(obj)
+                register_crd(out, self.scheme)
+                self._respond(h, 201, out)
+                return
             out = rc.create(obj)
             self._respond(h, 201, out)
         elif method == "PUT":
@@ -418,8 +506,16 @@ class APIServer:
                 self._error(h, 403, "Forbidden",
                             f'namespace "{req.name}" cannot be deleted')
                 return
+            if req.resource == "customresourcedefinitions":
+                # cascade FIRST: instance DELETE records must precede the
+                # CRD's in the WAL, or replay drops the type registration
+                # while instance tombstones still need it to decode
+                self._delete_cr_instances(req.name)
             out = rc.delete(req.name, namespace=req.namespace or None,
                             resource_version=req.query.get("resourceVersion"))
+            if req.resource == "customresourcedefinitions":
+                from ..runtime.crd import unregister_crd
+                unregister_crd(out, self.scheme)
             self._respond(h, 200, out)
         else:
             self._error(h, 405, "MethodNotAllowed", method)

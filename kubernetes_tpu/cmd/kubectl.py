@@ -24,7 +24,7 @@ def _client(args) -> HTTPClient:
     return HTTPClient(args.master)
 
 
-def _resolve(resource: str):
+def _resolve(resource: str, client=None):
     aliases = {
         "po": "pods", "pod": "pods",
         "no": "nodes", "node": "nodes",
@@ -36,9 +36,29 @@ def _resolve(resource: str):
         "sc": "storageclasses", "pdb": "poddisruptionbudgets",
         "ds": "daemonsets", "sts": "statefulsets", "job": "jobs",
         "cj": "cronjobs", "ev": "events", "ep": "endpoints",
+        "hpa": "horizontalpodautoscalers",
+        "crd": "customresourcedefinitions",
+        "crds": "customresourcedefinitions",
+        "quota": "resourcequotas", "limits": "limitranges",
     }
     resource = aliases.get(resource, resource)
     cls = SCHEME.type_for_resource(resource)
+    if cls is None and client is not None:
+        # discovery: an unknown resource may be a server-side CRD — fetch
+        # definitions and register the dynamic type locally (the
+        # reference's RESTMapper discovery against /apis)
+        from ..runtime.crd import CustomResourceDefinition, register_crd
+        try:
+            for crd in client.resource(CustomResourceDefinition).list():
+                names = crd.spec.names
+                if resource in (names.plural, names.singular,
+                                names.kind.lower(), *names.short_names):
+                    register_crd(crd)
+                    resource = names.plural
+                    cls = SCHEME.type_for_resource(resource)
+                    break
+        except Exception:
+            pass
     if cls is None:
         raise SystemExit(f"error: the server doesn't have a resource "
                          f"type \"{resource}\"")
@@ -90,7 +110,7 @@ def _node_row(n):
 
 
 def cmd_get(args) -> int:
-    resource, cls = _resolve(args.resource)
+    resource, cls = _resolve(args.resource, _client(args))
     rc = _client(args).resource(cls, args.namespace)
     items = [rc.get(args.name, namespace=args.namespace)] if args.name \
         else rc.list(namespace=None if args.all_namespaces
@@ -126,7 +146,7 @@ def cmd_get(args) -> int:
 
 
 def cmd_describe(args) -> int:
-    _, cls = _resolve(args.resource)
+    _, cls = _resolve(args.resource, _client(args))
     obj = _client(args).resource(cls, args.namespace).get(
         args.name, namespace=args.namespace)
     data = serde.encode(obj)
@@ -161,9 +181,25 @@ def _load_manifests(path: str):
     return [SCHEME.decode_any(d) for d in _load_manifest_dicts(path)]
 
 
+def _decode_with_discovery(raw: dict, client):
+    """decode_any, falling back to server-side CRD discovery for custom
+    kinds the local scheme hasn't seen."""
+    try:
+        return SCHEME.decode_any(raw)
+    except KeyError:
+        from ..runtime.crd import CustomResourceDefinition, register_crd
+        kind = raw.get("kind", "")
+        for crd in client.resource(CustomResourceDefinition).list():
+            if crd.spec.names.kind == kind:
+                register_crd(crd)
+                return SCHEME.decode_any(raw)
+        raise
+
+
 def cmd_create(args) -> int:
     client = _client(args)
-    for obj in _load_manifests(args.filename):
+    for raw in _load_manifest_dicts(args.filename):
+        obj = _decode_with_discovery(raw, client)
         rc = client.resource(type(obj), obj.metadata.namespace or
                              args.namespace)
         out = rc.create(obj)
@@ -186,7 +222,7 @@ def cmd_apply(args) -> int:
         # the RAW manifest is what we own — re-encoding the decoded object
         # would materialize defaulted fields (e.g. clusterIP: "") and make
         # apply claim ownership of values the user never wrote
-        obj = SCHEME.decode_any(raw)
+        obj = _decode_with_discovery(raw, client)
         ns = obj.metadata.namespace or args.namespace
         rc = client.resource(type(obj), ns)
         kind = SCHEME.resource_for(obj)
@@ -230,7 +266,7 @@ def cmd_apply(args) -> int:
 
 
 def cmd_delete(args) -> int:
-    resource, cls = _resolve(args.resource)
+    resource, cls = _resolve(args.resource, _client(args))
     _client(args).resource(cls, args.namespace).delete(
         args.name, namespace=args.namespace)
     print(f"{resource}/{args.name} deleted")
@@ -238,14 +274,48 @@ def cmd_delete(args) -> int:
 
 
 def cmd_scale(args) -> int:
-    resource, cls = _resolve(args.resource)
-
-    def mutate(cur):
-        cur.spec.replicas = args.replicas
-        return cur
-    _client(args).resource(cls, args.namespace).patch(
-        args.name, mutate, namespace=args.namespace)
+    """Scales through the server's /scale subresource — the privilege is
+    {resource}/scale, not full object update (the reference's kubectl
+    scale uses the scale client the same way)."""
+    from ..state.store import ConflictError
+    resource, cls = _resolve(args.resource, _client(args))
+    rc = _client(args).resource(cls, args.namespace)
+    for attempt in range(16):
+        scale = rc.get_scale(args.name, namespace=args.namespace)
+        scale.spec.replicas = args.replicas
+        try:
+            rc.update_scale(args.name, scale, namespace=args.namespace)
+            break
+        except ConflictError:
+            # a concurrent writer bumped the rv between get and put —
+            # re-read and retry (the reference's scale client does the
+            # same RetryOnConflict dance)
+            continue
+    else:
+        raise ConflictError(f"{resource}/{args.name}: too many conflicts")
     print(f"{resource}/{args.name} scaled")
+    return 0
+
+
+def cmd_autoscale(args) -> int:
+    """kubectl autoscale: create an HPA targeting the resource."""
+    from ..api.autoscaling import (CrossVersionObjectReference,
+                                   HorizontalPodAutoscaler,
+                                   HorizontalPodAutoscalerSpec)
+    from ..api.meta import ObjectMeta
+    resource, cls = _resolve(args.resource, _client(args))
+    sample = cls()
+    hpa = HorizontalPodAutoscaler(
+        metadata=ObjectMeta(name=args.name, namespace=args.namespace),
+        spec=HorizontalPodAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind=sample.kind, name=args.name,
+                api_version=sample.api_version),
+            min_replicas=args.min, max_replicas=args.max,
+            target_cpu_utilization_percentage=args.cpu_percent))
+    _client(args).resource(HorizontalPodAutoscaler,
+                           args.namespace).create(hpa)
+    print(f"horizontalpodautoscaler/{args.name} autoscaled")
     return 0
 
 
@@ -268,7 +338,7 @@ def cmd_uncordon(args) -> int:
 
 def cmd_patch(args) -> int:
     """kubectl patch -p '{"spec": {...}}' [--type strategic|merge|json]."""
-    _, cls = _resolve(args.resource)
+    _, cls = _resolve(args.resource, _client(args))
     rc = _client(args).resource(cls, args.namespace)
     body = json.loads(args.patch)
     if args.type == "json":
@@ -282,7 +352,7 @@ def cmd_patch(args) -> int:
 
 def cmd_label(args) -> int:
     """kubectl label <resource> <name> k=v ... k- (trailing - removes)."""
-    _, cls = _resolve(args.resource)
+    _, cls = _resolve(args.resource, _client(args))
     rc = _client(args).resource(cls, args.namespace)
     labels = {}
     for kv in args.labels:
@@ -298,7 +368,7 @@ def cmd_label(args) -> int:
 
 
 def cmd_annotate(args) -> int:
-    _, cls = _resolve(args.resource)
+    _, cls = _resolve(args.resource, _client(args))
     rc = _client(args).resource(cls, args.namespace)
     annotations = {}
     for kv in args.annotations:
@@ -348,6 +418,14 @@ def main(argv=None) -> int:
     s.add_argument("name")
     s.add_argument("--replicas", type=int, required=True)
     s.set_defaults(fn=cmd_scale)
+
+    au = sub.add_parser("autoscale")
+    au.add_argument("resource")
+    au.add_argument("name")
+    au.add_argument("--min", type=int, default=1)
+    au.add_argument("--max", type=int, required=True)
+    au.add_argument("--cpu-percent", type=int, default=80)
+    au.set_defaults(fn=cmd_autoscale)
 
     for verb, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
         c = sub.add_parser(verb)

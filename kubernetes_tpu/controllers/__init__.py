@@ -34,6 +34,8 @@ from .job import JobController
 from .manager import ControllerManager
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podautoscaler import (HorizontalController, MetricsClient,
+                            StaticMetrics)
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
@@ -43,7 +45,8 @@ from .volume import PersistentVolumeBinder
 __all__ = ["Controller", "ControllerManager", "CronJobController",
            "DaemonSetController", "DeploymentController",
            "DisruptionController", "EndpointsController",
-           "GarbageCollector", "JobController",
+           "GarbageCollector", "HorizontalController", "JobController",
+           "MetricsClient", "StaticMetrics",
            "NamespaceController", "NodeLifecycleController",
            "PersistentVolumeBinder", "PodGCController",
            "ReplicaSetController", "ResourceQuotaController",

@@ -20,6 +20,7 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podautoscaler import HorizontalController, MetricsClient
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
@@ -35,7 +36,8 @@ class ControllerManager:
                  pod_eviction_timeout: float = 300.0,
                  terminated_pod_gc_threshold: int = 12500,
                  podgc_period: float = 20.0,
-                 cronjob_period: float = 10.0):
+                 cronjob_period: float = 10.0,
+                 metrics_client: Optional[MetricsClient] = None):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
         from ..api.core import ReplicationController
@@ -61,6 +63,8 @@ class ControllerManager:
         self.garbagecollector = GarbageCollector(client, self.informers)
         self.disruption = DisruptionController(client, self.informers)
         self.resourcequota = ResourceQuotaController(client, self.informers)
+        self.podautoscaler = HorizontalController(
+            client, self.informers, metrics=metrics_client)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -71,7 +75,7 @@ class ControllerManager:
             self.daemonset, self.cronjob, self.endpoints,
             self.namespace, self.pv_binder, self.nodelifecycle,
             self.garbagecollector, self.podgc, self.disruption,
-            self.resourcequota]
+            self.resourcequota, self.podautoscaler]
 
     def start(self) -> None:
         self.informers.start()

@@ -1,0 +1,223 @@
+"""Horizontal pod autoscaler controller.
+
+Ref: pkg/controller/podautoscaler/horizontal.go (reconcileAutoscaler :70,
+computeReplicasForCPUUtilization via pkg/controller/podautoscaler/
+metrics). Reduced to the autoscaling/v1 CPU-utilization path against a
+pluggable metrics source (the metrics-server boundary):
+
+    desired = ceil(current * currentUtilization / targetUtilization)
+
+with the reference's 10% tolerance dead-band, min/max clamping, and a
+scale-down stabilization window so a noisy metric cannot flap the
+workload (ref: the downscale forbidden window, horizontal.go
+scaleDownLimitWindow).
+
+Scaling goes through the target's /scale subresource — the controller
+never writes the workload object itself.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import helpers
+from ..api.autoscaling import HorizontalPodAutoscaler
+from ..api.core import Pod
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+#: the reference's tolerance: inside ±10% of target, do nothing
+TOLERANCE = 0.1
+
+
+class MetricsClient:
+    """The metrics-server boundary (ref: pkg/controller/podautoscaler/
+    metrics/metrics_client.go). Returns cpu usage in millicores per pod;
+    pods without a sample are omitted."""
+
+    def pod_cpu_usage(self, namespace: str,
+                      pod_names: List[str]) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class StaticMetrics(MetricsClient):
+    """Settable source for tests and hollow clusters."""
+
+    def __init__(self):
+        self._usage: Dict[str, int] = {}  # "ns/name" -> millicores
+        self._lock = threading.Lock()
+
+    def set_usage(self, namespace: str, name: str, milli: int) -> None:
+        with self._lock:
+            self._usage[f"{namespace}/{name}"] = milli
+
+    def set_all(self, namespace: str, milli: int) -> None:
+        """Every subsequently-queried pod reports this usage."""
+        with self._lock:
+            self._default = milli
+
+    def pod_cpu_usage(self, namespace, pod_names):
+        out = {}
+        with self._lock:
+            default = getattr(self, "_default", None)
+            for n in pod_names:
+                v = self._usage.get(f"{namespace}/{n}", default)
+                if v is not None:
+                    out[n] = v
+        return out
+
+
+def parse_selector(selector: str) -> Dict[str, str]:
+    out = {}
+    for part in selector.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+class HorizontalController(Controller):
+    name = "horizontalpodautoscaler"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 metrics: Optional[MetricsClient] = None,
+                 sync_period: float = 15.0,
+                 downscale_window: float = 300.0, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.metrics = metrics
+        self.sync_period = sync_period
+        self.downscale_window = downscale_window
+        self.hpa_informer = informers.informer_for(HorizontalPodAutoscaler)
+        self.pod_informer = informers.informer_for(Pod)
+        self.hpa_informer.add_event_handlers(EventHandlers(
+            on_add=lambda h: self.enqueue(h.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key())))
+        self._stopped = threading.Event()
+        self._resync_thread = None
+
+    # ------------------------------------------------------------- plumbing
+
+    def run(self) -> None:
+        super().run()
+        self._resync_thread = threading.Thread(
+            target=self._resync_loop, daemon=True, name="hpa-resync")
+        self._resync_thread.start()
+
+    def _resync_loop(self) -> None:
+        while not self._stopped.wait(self.sync_period):
+            for hpa in self.hpa_informer.indexer.list(None):
+                self.enqueue(hpa.metadata.key())
+
+    def stop(self) -> None:
+        self._stopped.set()
+        super().stop()
+
+    # ----------------------------------------------------------------- sync
+
+    def _target_client(self, hpa: HorizontalPodAutoscaler):
+        from ..runtime.scheme import SCHEME
+        ref = hpa.spec.scale_target_ref
+        cls = SCHEME.type_for(ref.api_version, ref.kind)
+        if cls is None:
+            return None
+        return self.client.resource(cls, hpa.metadata.namespace)
+
+    def sync(self, key: str) -> None:
+        """Ref: reconcileAutoscaler (horizontal.go:70)."""
+        hpa = self.hpa_informer.indexer.get_by_key(key)
+        if hpa is None or self.metrics is None:
+            return
+        ns = hpa.metadata.namespace
+        rc = self._target_client(hpa)
+        if rc is None:
+            return
+        ref = hpa.spec.scale_target_ref
+        scale = rc.get_scale(ref.name, namespace=ns)
+        current = scale.spec.replicas
+
+        desired = current
+        utilization = None
+        if current > 0 and \
+                hpa.spec.target_cpu_utilization_percentage is not None:
+            desired, utilization = self._desired_replicas(hpa, scale,
+                                                          current)
+        # clamp to the HPA's bounds (also applies when current is outside)
+        lo = hpa.spec.min_replicas or 1
+        hi = hpa.spec.max_replicas or lo
+        desired = max(lo, min(hi, desired))
+
+        now = time.time()
+        if desired < current and not self._downscale_allowed(hpa, now):
+            desired = current
+        if desired != current:
+            scale.spec.replicas = desired
+            rc.update_scale(ref.name, scale, namespace=ns)
+        self._update_status(hpa, current, desired, utilization,
+                            scaled=(desired != current), now=now)
+
+    def _desired_replicas(self, hpa, scale, current):
+        """ceil(current * currentUtil / targetUtil) with the tolerance
+        dead-band; None utilization (no samples / no requests) holds."""
+        ns = hpa.metadata.namespace
+        sel = parse_selector(scale.status.selector)
+        pods = [p for p in self.pod_informer.indexer.list(ns)
+                if sel and all(p.metadata.labels.get(k) == v
+                               for k, v in sel.items())
+                and p.status.phase not in ("Succeeded", "Failed")]
+        if not pods:
+            return current, None
+        usage = self.metrics.pod_cpu_usage(
+            ns, [p.metadata.name for p in pods])
+        total_usage = 0
+        total_request = 0
+        for p in pods:
+            if p.metadata.name not in usage:
+                continue
+            req = helpers.pod_requests(p).get("cpu", 0)
+            if req <= 0:
+                continue
+            total_usage += usage[p.metadata.name]
+            total_request += req
+        if total_request == 0:
+            return current, None
+        utilization = 100.0 * total_usage / total_request
+        target = hpa.spec.target_cpu_utilization_percentage
+        ratio = utilization / target
+        if abs(ratio - 1.0) <= TOLERANCE:
+            return current, int(utilization)
+        return int(math.ceil(current * ratio)), int(utilization)
+
+    def _downscale_allowed(self, hpa, now: float) -> bool:
+        """The stabilization window: no scale-down within
+        downscale_window seconds of the last scale operation."""
+        last = hpa.status.last_scale_time
+        if not last:
+            return True
+        from ..utils.clock import parse_iso
+        t = parse_iso(last)
+        return t is None or now - t >= self.downscale_window
+
+    def _update_status(self, hpa, current, desired, utilization,
+                       scaled: bool, now: float) -> None:
+        from datetime import datetime, timezone
+
+        stamp = datetime.fromtimestamp(now, tz=timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ")
+
+        def mutate(cur):
+            cur.status.current_replicas = current
+            cur.status.desired_replicas = desired
+            cur.status.current_cpu_utilization_percentage = utilization
+            cur.status.observed_generation = cur.metadata.generation
+            if scaled:
+                cur.status.last_scale_time = stamp
+            return cur
+        try:
+            self.client.resource(HorizontalPodAutoscaler).patch(
+                hpa.metadata.name, mutate, namespace=hpa.metadata.namespace)
+        except Exception:
+            pass

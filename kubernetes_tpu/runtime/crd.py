@@ -1,0 +1,162 @@
+"""CustomResourceDefinitions — dynamic resource registration.
+
+Ref: staging/src/k8s.io/apiextensions-apiserver/pkg/apiserver/
+customresource_handler.go (crdHandler serving CR CRUD straight out of a
+generic store once a CRD names the resource) and pkg/apis/apiextensions
+types. Reduced: no OpenAPI schema validation, no conversion webhooks, one
+served version — a CR is metadata + free-form spec/status dicts.
+
+The tpu-native twist is architectural: the reference spins up a separate
+apiextensions-apiserver and aggregates it; here the Scheme IS the serving
+table, so registration is `type()`-ing a DynamicResource subclass per CRD
+and adding it to the scheme — every existing layer (store buckets, watch,
+informers, HTTP routing, kubectl) then serves the new kind with zero
+special cases. WAL replay re-registers CRDs as it encounters them so CR
+instance records later in the log decode (state/store.py _replay_wal).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Type
+
+from ..api.meta import ObjectMeta
+
+
+@dataclass
+class CustomResourceDefinitionNames:
+    plural: str = ""
+    singular: str = ""
+    kind: str = ""
+    list_kind: str = ""
+    short_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinitionVersion:
+    name: str = "v1"
+    served: bool = True
+    storage: bool = True
+
+
+@dataclass
+class CustomResourceDefinitionSpec:
+    group: str = ""
+    names: CustomResourceDefinitionNames = field(
+        default_factory=CustomResourceDefinitionNames)
+    scope: str = "Namespaced"  # Namespaced | Cluster
+    #: empty means one served+storage "v1" (storage_version's fallback) —
+    #: a non-empty default would break encode/decode round-tripping of []
+    versions: List[CustomResourceDefinitionVersion] = field(
+        default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinitionCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class CustomResourceDefinitionStatus:
+    accepted_names: CustomResourceDefinitionNames = field(
+        default_factory=CustomResourceDefinitionNames)
+    conditions: List[CustomResourceDefinitionCondition] = field(
+        default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinition:
+    api_version: str = "apiextensions.k8s.io/v1"
+    kind: str = "CustomResourceDefinition"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CustomResourceDefinitionSpec = field(
+        default_factory=CustomResourceDefinitionSpec)
+    status: CustomResourceDefinitionStatus = field(
+        default_factory=CustomResourceDefinitionStatus)
+
+
+@dataclass
+class DynamicResource:
+    """The schema-less custom object: typed metadata, free-form payload.
+    One subclass is `type()`-generated per CRD so the scheme's cls-keyed
+    tables stay unambiguous."""
+    api_version: str = ""
+    kind: str = ""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+def storage_version(crd: CustomResourceDefinition) -> str:
+    for v in crd.spec.versions:
+        if v.storage:
+            return v.name
+    return crd.spec.versions[0].name if crd.spec.versions else "v1"
+
+
+def validate_crd(crd: CustomResourceDefinition, scheme=None) -> None:
+    """Field checks a CRD must pass before it may land in the store OR
+    register a type (callers run this before either side effect so a
+    failure leaves nothing half-done). With a scheme, also checks the
+    plural is free — register_crd would reject it after the store write."""
+    names = crd.spec.names
+    if not (crd.spec.group and names.plural and names.kind):
+        raise ValueError(
+            "CRD needs spec.group, spec.names.plural and spec.names.kind")
+    if scheme is not None:
+        holder = scheme.type_for_resource(names.plural)
+        if holder is not None and \
+                getattr(holder, "_crd_group", None) != crd.spec.group:
+            raise ValueError(
+                f"resource {names.plural!r} is already registered")
+
+
+def register_crd(crd: CustomResourceDefinition, scheme=None) -> Type:
+    """Generate and register the dynamic type for a CRD. Idempotent: the
+    same (group, version, kind) re-registers over itself."""
+    from .scheme import SCHEME
+    scheme = scheme or SCHEME
+    validate_crd(crd)
+    names = crd.spec.names
+    api_version = f"{crd.spec.group}/{storage_version(crd)}"
+    # exact-gvk check: type_for's kind-only fallback would conflate
+    # same-kind CRDs from different groups
+    existing = scheme.type_for_exact(api_version, names.kind)
+    if existing is not None and \
+            getattr(existing, "_crd_resource", None) == names.plural:
+        return existing
+    holder = scheme.type_for_resource(names.plural)
+    if holder is not None and \
+            getattr(holder, "_crd_group", None) != crd.spec.group:
+        # the flat resource table has no per-group URL space: a plural
+        # already owned by a builtin or another group's CRD must be
+        # rejected, not silently stolen
+        raise ValueError(
+            f"resource {names.plural!r} is already registered")
+    cls = type(names.kind, (DynamicResource,), {
+        "_crd_resource": names.plural,
+        "_crd_group": crd.spec.group,
+    })
+    # dataclass machinery is inherited; instances still default api_version
+    # and kind to "" — stamp per-class defaults so bare cls() is well-formed
+    def _init(self, api_version=api_version, kind=names.kind,
+              metadata=None, spec=None, status=None):
+        DynamicResource.__init__(
+            self, api_version, kind, metadata or ObjectMeta(),
+            spec if spec is not None else {},
+            status if status is not None else {})
+    cls.__init__ = _init
+    scheme.register(cls, api_version, names.kind, names.plural,
+                    namespaced=(crd.spec.scope != "Cluster"))
+    return cls
+
+
+def unregister_crd(crd: CustomResourceDefinition, scheme=None) -> None:
+    from .scheme import SCHEME
+    scheme = scheme or SCHEME
+    api_version = f"{crd.spec.group}/{storage_version(crd)}"
+    scheme.unregister(api_version, crd.spec.names.kind,
+                      crd.spec.names.plural)

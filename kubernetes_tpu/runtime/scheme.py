@@ -34,10 +34,39 @@ class Scheme:
         self._resource_by_type[cls] = resource
         self._type_by_resource[resource] = cls
         self._namespaced[cls] = namespaced
+        if not namespaced:
+            # keep generic validation's scope knowledge in sync (it cannot
+            # import the scheme: api <- runtime would cycle)
+            from ..api import validation
+            validation.CLUSTER_SCOPED_KINDS.add(kind)
+
+    def unregister(self, api_version: str, kind: str, resource: str) -> None:
+        """Remove a dynamically-registered kind (CRD deletion)."""
+        cls = self._by_gvk.pop((api_version, kind), None)
+        if cls is None:
+            return
+        self._by_type.pop(cls, None)
+        self._resource_by_type.pop(cls, None)
+        if self._type_by_resource.get(resource) is cls:
+            del self._type_by_resource[resource]
+        was_cluster_scoped = not self._namespaced.pop(cls, True)
+        if was_cluster_scoped and not any(
+                k == kind and not self._namespaced.get(c, True)
+                for (v, k), c in self._by_gvk.items()):
+            # no other cluster-scoped registration shares this kind: prune
+            # the validation set or a recreated Namespaced CRD of the same
+            # kind would have its instances rejected
+            from ..api import validation
+            validation.CLUSTER_SCOPED_KINDS.discard(kind)
 
     def type_for(self, api_version: str, kind: str) -> Optional[Type]:
         return self._by_gvk.get((api_version, kind)) or \
             next((cls for (v, k), cls in self._by_gvk.items() if k == kind), None)
+
+    def type_for_exact(self, api_version: str, kind: str) -> Optional[Type]:
+        """Exact-gvk lookup, no kind-only fallback — same-kind CRDs in
+        different groups must not resolve to each other."""
+        return self._by_gvk.get((api_version, kind))
 
     def type_for_resource(self, resource: str) -> Optional[Type]:
         return self._type_by_resource.get(resource)
@@ -97,6 +126,13 @@ def default_scheme() -> Scheme:
     s.register(StorageClass, "storage.k8s.io/v1", "StorageClass",
                "storageclasses", namespaced=False)
     s.register(Lease, "coordination.k8s.io/v1", "Lease", "leases")
+    from .crd import CustomResourceDefinition
+    s.register(CustomResourceDefinition, "apiextensions.k8s.io/v1",
+               "CustomResourceDefinition", "customresourcedefinitions",
+               namespaced=False)
+    from ..api.autoscaling import HorizontalPodAutoscaler
+    s.register(HorizontalPodAutoscaler, "autoscaling/v1",
+               "HorizontalPodAutoscaler", "horizontalpodautoscalers")
     return s
 
 

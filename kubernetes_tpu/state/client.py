@@ -138,6 +138,26 @@ class ResourceClient:
         return self._store.guaranteed_update(
             self._resource, ns if self._namespaced else "", name, mutate)
 
+    def get_scale(self, name: str, namespace: Optional[str] = None):
+        """The /scale subresource, in-process (same projection the server
+        serves over HTTP)."""
+        from ..api.autoscaling import project_scale
+        return project_scale(self.get(name, namespace=namespace))
+
+    def update_scale(self, name: str, scale,
+                     namespace: Optional[str] = None):
+        from ..api.autoscaling import project_scale
+        from .store import ConflictError
+        expect_rv = scale.metadata.resource_version
+
+        def mutate(cur):
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise ConflictError(
+                    f"{self._resource} {name}: the object has been modified")
+            cur.spec.replicas = scale.spec.replicas
+            return cur
+        return project_scale(self.patch(name, mutate, namespace=namespace))
+
     #: ref: the lifecycle plugin's immortalNamespaces — a finalizer-gated
     #: Terminating system namespace would be unrecoverable
     IMMORTAL_NAMESPACES = ("default", "kube-system", "kube-node-lease",

@@ -133,6 +133,19 @@ class Store:
             if cls is None:
                 continue
             obj = serde.decode(cls, rec["object"])
+            if rec["resource"] == "customresourcedefinitions":
+                # keep the dynamic type table in step with the log: CR
+                # instance records only decode while their CRD's PUT has
+                # been seen and its DELETE has not (the server cascades
+                # instance deletes before the CRD's, preserving order)
+                from ..runtime.crd import register_crd, unregister_crd
+                try:
+                    if rec["op"] == "DELETE":
+                        unregister_crd(obj)
+                    else:
+                        register_crd(obj)
+                except ValueError:
+                    pass
             key = (obj.metadata.namespace, obj.metadata.name)
             bucket = self._data.setdefault(rec["resource"], {})
             if rec["op"] == "DELETE":

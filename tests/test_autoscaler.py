@@ -1,0 +1,236 @@
+"""/scale subresource + HorizontalPodAutoscaler controller.
+
+Modeled on pkg/registry/apps/deployment/storage/storage_test.go (ScaleREST)
+and pkg/controller/podautoscaler/horizontal_test.go.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.api.autoscaling import (CrossVersionObjectReference,
+                                            HorizontalPodAutoscaler,
+                                            HorizontalPodAutoscalerSpec)
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.cmd import kubectl
+from kubernetes_tpu.controllers.podautoscaler import (HorizontalController,
+                                                      StaticMetrics)
+from kubernetes_tpu.state import Client, SharedInformerFactory
+
+
+def make_deployment(name, replicas, labels, cpu="100m"):
+    return api.Deployment(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.DeploymentSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels=dict(labels)),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": Quantity(cpu)}))]))))
+
+
+def make_pod(name, labels, cpu="100m"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu)}))]),
+        status=api.PodStatus(phase="Running"))
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+class TestScaleSubresource:
+    def test_get_and_put_scale_http(self, server):
+        client = HTTPClient(server.address)
+        client.deployments("default").create(
+            make_deployment("web", 3, {"app": "web"}))
+        scale = client.deployments("default").get_scale("web")
+        assert scale.kind == "Scale"
+        assert scale.spec.replicas == 3
+        assert scale.status.selector == "app=web"
+        scale.spec.replicas = 5
+        out = client.deployments("default").update_scale("web", scale)
+        assert out.spec.replicas == 5
+        assert client.deployments("default").get("web").spec.replicas == 5
+
+    def test_scale_rv_precondition(self, server):
+        from kubernetes_tpu.state.store import ConflictError
+        client = HTTPClient(server.address)
+        client.deployments("default").create(
+            make_deployment("web", 3, {"app": "web"}))
+        stale = client.deployments("default").get_scale("web")
+        scale = client.deployments("default").get_scale("web")
+        scale.spec.replicas = 4
+        client.deployments("default").update_scale("web", scale)
+        stale.spec.replicas = 9
+        with pytest.raises(ConflictError):
+            client.deployments("default").update_scale("web", stale)
+
+    def test_unscalable_resource_404(self, server):
+        from kubernetes_tpu.state.store import NotFoundError
+        client = HTTPClient(server.address)
+        client.pods("default").create(make_pod("p", {"a": "b"}))
+        import urllib.request
+        req = urllib.request.Request(
+            f"{server.address}/api/v1/namespaces/default/pods/p/scale")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 404
+
+    def test_kubectl_scale_uses_subresource(self, server):
+        client = HTTPClient(server.address)
+        client.deployments("default").create(
+            make_deployment("web", 2, {"app": "web"}))
+        assert kubectl.main(["-s", server.address, "scale", "deploy",
+                             "web", "--replicas", "6"]) == 0
+        assert client.deployments("default").get("web").spec.replicas == 6
+
+
+class TestHorizontalController:
+    def _setup(self, metrics):
+        client = Client()
+        informers = SharedInformerFactory(client)
+        hc = HorizontalController(client, informers, metrics=metrics,
+                                  downscale_window=0.0)
+        return client, informers, hc
+
+    def _seed(self, client, replicas, usage_milli, metrics,
+              target_pct=50, cpu="100m"):
+        labels = {"app": "web"}
+        client.deployments("default").create(
+            make_deployment("web", replicas, labels, cpu=cpu))
+        for i in range(replicas):
+            client.pods("default").create(
+                make_pod(f"web-{i}", labels, cpu=cpu))
+            metrics.set_usage("default", f"web-{i}", usage_milli)
+        client.resource(HorizontalPodAutoscaler, "default").create(
+            HorizontalPodAutoscaler(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=HorizontalPodAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="Deployment", name="web",
+                        api_version="apps/v1"),
+                    min_replicas=1, max_replicas=10,
+                    target_cpu_utilization_percentage=target_pct)))
+
+    def test_scales_up_on_high_utilization(self):
+        metrics = StaticMetrics()
+        client, informers, hc = self._setup(metrics)
+        # 2 replicas at 90m/100m = 90% vs target 50% -> ceil(2*1.8) = 4
+        self._seed(client, 2, 90, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            hc.sync("default/web")
+            dep = client.deployments("default").get("web")
+            assert dep.spec.replicas == 4
+            st = client.resource(HorizontalPodAutoscaler, "default") \
+                .get("web").status
+            assert st.desired_replicas == 4
+            assert st.current_cpu_utilization_percentage == 90
+            assert st.last_scale_time
+        finally:
+            informers.stop()
+
+    def test_scales_down_and_respects_floor(self):
+        metrics = StaticMetrics()
+        client, informers, hc = self._setup(metrics)
+        # 4 replicas at 5m/100m = 5% vs target 50% -> ceil(4*0.1) = 1
+        self._seed(client, 4, 5, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 1
+        finally:
+            informers.stop()
+
+    def test_tolerance_dead_band_holds(self):
+        metrics = StaticMetrics()
+        client, informers, hc = self._setup(metrics)
+        # 52% vs 50% target is inside the 10% tolerance: no change
+        self._seed(client, 2, 52, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 2
+        finally:
+            informers.stop()
+
+    def test_downscale_stabilization_window(self):
+        metrics = StaticMetrics()
+        client = Client()
+        informers = SharedInformerFactory(client)
+        hc = HorizontalController(client, informers, metrics=metrics,
+                                  downscale_window=3600.0)
+        self._seed(client, 2, 90, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            hc.sync("default/web")  # scales up to 4, stamps lastScaleTime
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 4
+            # usage collapses; downscale is forbidden inside the window
+            for i in range(2):
+                metrics.set_usage("default", f"web-{i}", 1)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if len(informers.informer_for(
+                        HorizontalPodAutoscaler).indexer.list(
+                            "default")) and informers.informer_for(
+                            HorizontalPodAutoscaler).indexer.get_by_key(
+                            "default/web").status.last_scale_time:
+                    break
+                time.sleep(0.02)
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 4  # held by the window
+        finally:
+            informers.stop()
+
+    def test_e2e_up_then_down(self):
+        """VERDICT #10 done-criterion: load scales a Deployment up and
+        back down (downscale window disabled)."""
+        metrics = StaticMetrics()
+        client, informers, hc = self._setup(metrics)
+        self._seed(client, 2, 90, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 4
+            # new pods appear (as the deployment controller would create)
+            for i in range(2, 4):
+                client.pods("default").create(
+                    make_pod(f"web-{i}", {"app": "web"}))
+            # load drops to 10m across all 4 -> 10% vs 50% -> 1 replica
+            for i in range(4):
+                metrics.set_usage("default", f"web-{i}", 10)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if len(informers.informer_for(api.Pod).indexer.list(
+                        "default")) == 4:
+                    break
+                time.sleep(0.02)
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 1
+        finally:
+            informers.stop()

@@ -1,0 +1,268 @@
+"""CRD-lite: dynamic resource registration, CR CRUD+watch over HTTP and
+in-process, kubectl discovery, WAL replay re-registration.
+
+Ref behavior: apiextensions-apiserver customresource_handler_test.go.
+"""
+
+import json
+import threading
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.cmd import kubectl
+from kubernetes_tpu.runtime.crd import (CustomResourceDefinition,
+                                        CustomResourceDefinitionNames,
+                                        CustomResourceDefinitionSpec,
+                                        register_crd, unregister_crd)
+from kubernetes_tpu.runtime.scheme import SCHEME
+from kubernetes_tpu.state.store import NotFoundError
+
+
+def widget_crd(plural="widgets", kind="Widget", group="example.com",
+               scope="Namespaced", short_names=("wg",)):
+    return CustomResourceDefinition(
+        metadata=api.ObjectMeta(name=f"{plural}.{group}"),
+        spec=CustomResourceDefinitionSpec(
+            group=group, scope=scope,
+            names=CustomResourceDefinitionNames(
+                plural=plural, singular=kind.lower(), kind=kind,
+                short_names=list(short_names))))
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+    # dynamic registrations are process-global: drop them between tests
+    for crd in (widget_crd(),):
+        unregister_crd(crd)
+
+
+class TestCRDOverHTTP:
+    def test_cr_crud_and_watch(self, server):
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(widget_crd())
+        cls = SCHEME.type_for_resource("widgets")
+        assert cls is not None and cls.__name__ == "Widget"
+        rc = client.resource(cls, "default")
+
+        events = []
+        ready = threading.Event()
+
+        def watcher():
+            w = rc.watch(namespace="default")
+            ready.set()
+            for ev in w:
+                events.append((ev.type, ev.object.metadata.name))
+                if len(events) >= 3:
+                    break
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        ready.wait(5)
+
+        w1 = cls(metadata=api.ObjectMeta(name="w1", namespace="default"),
+                 spec={"size": 3, "color": "blue"})
+        created = rc.create(w1)
+        assert created.metadata.uid
+        assert created.spec == {"size": 3, "color": "blue"}
+
+        got = rc.get("w1")
+        assert got.spec["size"] == 3
+        assert got.api_version == "example.com/v1"
+        assert got.kind == "Widget"
+
+        # server-side merge patch works on free-form spec
+        patched = rc.merge_patch("w1", {"spec": {"size": 5}},
+                                 strategic=False)
+        assert patched.spec == {"size": 5, "color": "blue"}
+
+        # status subresource-style update through PUT status
+        patched.status = {"phase": "Ready"}
+        rc.update_status(patched)
+        assert rc.get("w1").status == {"phase": "Ready"}
+
+        rc.delete("w1")
+        with pytest.raises(NotFoundError):
+            rc.get("w1")
+        t.join(timeout=5)
+        assert [e[0] for e in events[:3]] == ["ADDED", "MODIFIED",
+                                              "MODIFIED"]
+
+    def test_malformed_crd_rejected(self, server):
+        client = HTTPClient(server.address)
+        bad = CustomResourceDefinition(
+            metadata=api.ObjectMeta(name="bad.example.com"))
+        with pytest.raises(RuntimeError, match="HTTP 422"):
+            client.resource(CustomResourceDefinition).create(bad)
+
+    def test_delete_crd_unregisters_resource(self, server):
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(widget_crd())
+        assert SCHEME.type_for_resource("widgets") is not None
+        client.resource(CustomResourceDefinition).delete(
+            "widgets.example.com")
+        assert SCHEME.type_for_resource("widgets") is None
+
+    def test_delete_crd_cascades_to_instances(self, server):
+        """Deleting a CRD deletes its CRs — otherwise they'd resurrect
+        from the WAL when a same-named CRD is recreated."""
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(widget_crd())
+        cls = SCHEME.type_for_resource("widgets")
+        client.resource(cls, "default").create(
+            cls(metadata=api.ObjectMeta(name="w1", namespace="default"),
+                spec={"x": 1}))
+        client.resource(CustomResourceDefinition).delete(
+            "widgets.example.com")
+        # recreate: the bucket must be empty
+        client.resource(CustomResourceDefinition).create(widget_crd())
+        cls2 = SCHEME.type_for_resource("widgets")
+        items, _ = client.resource(cls2, "default").list_rv("default")
+        assert items == []
+
+    def test_failed_crd_create_leaves_no_phantom_type(self, server):
+        """A CRD create that fails validation must not leave the dynamic
+        type registered (phantom resource with no stored CRD)."""
+        client = HTTPClient(server.address)
+        crd = widget_crd(plural="ghosts", kind="Ghost", short_names=())
+        crd.metadata.namespace = "default"  # cluster-scoped: 422
+        with pytest.raises(RuntimeError, match="HTTP 422"):
+            client.resource(CustomResourceDefinition).create(crd)
+        assert SCHEME.type_for_resource("ghosts") is None
+
+    def test_plural_conflict_with_builtin_rejected(self, server):
+        client = HTTPClient(server.address)
+        impostor = widget_crd(plural="pods", kind="FakePod",
+                              group="evil.com", short_names=())
+        impostor.metadata.name = "pods.evil.com"
+        with pytest.raises(RuntimeError, match="already registered"):
+            client.resource(CustomResourceDefinition).create(impostor)
+        from kubernetes_tpu.api.core import Pod
+        assert SCHEME.type_for_resource("pods") is Pod
+
+    def test_same_kind_different_groups_not_conflated(self, server):
+        """widgets.a.com and grommets.b.com both kind=Widget: the second
+        registration must not return the first's type."""
+        a = widget_crd(plural="awidgets", kind="Widget", group="a.com",
+                       short_names=())
+        a.metadata.name = "awidgets.a.com"
+        b = widget_crd(plural="bwidgets", kind="Widget", group="b.com",
+                       short_names=())
+        b.metadata.name = "bwidgets.b.com"
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(a)
+        client.resource(CustomResourceDefinition).create(b)
+        try:
+            cls_a = SCHEME.type_for_resource("awidgets")
+            cls_b = SCHEME.type_for_resource("bwidgets")
+            assert cls_a is not cls_b
+            assert SCHEME.gvk_for(cls_a) == ("a.com/v1", "Widget")
+            assert SCHEME.gvk_for(cls_b) == ("b.com/v1", "Widget")
+        finally:
+            unregister_crd(a)
+            unregister_crd(b)
+
+    def test_cluster_scope_pruned_on_unregister(self):
+        """Cluster->delete->Namespaced recreation of the same kind must
+        accept namespaced instances again."""
+        from kubernetes_tpu.api import validation
+        crd_c = widget_crd(plural="things", kind="Thing", scope="Cluster",
+                           short_names=())
+        register_crd(crd_c)
+        assert "Thing" in validation.CLUSTER_SCOPED_KINDS
+        unregister_crd(crd_c)
+        assert "Thing" not in validation.CLUSTER_SCOPED_KINDS
+        crd_n = widget_crd(plural="things", kind="Thing", short_names=())
+        cls = register_crd(crd_n)
+        try:
+            obj = cls(metadata=api.ObjectMeta(name="t", namespace="ns1"),
+                      spec={})
+            validation.validate(obj)  # must not 422 on the namespace
+        finally:
+            unregister_crd(crd_n)
+
+    def test_cluster_scoped_crd(self, server):
+        crd = widget_crd(plural="gizmos", kind="Gizmo", scope="Cluster",
+                         short_names=())
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(crd)
+        try:
+            cls = SCHEME.type_for_resource("gizmos")
+            assert not SCHEME.is_namespaced(cls)
+            rc = client.resource(cls)
+            rc.create(cls(metadata=api.ObjectMeta(name="g1"),
+                          spec={"x": 1}))
+            assert rc.get("g1").spec == {"x": 1}
+            items, _ = rc.list_rv()
+            assert [o.metadata.name for o in items] == ["g1"]
+        finally:
+            unregister_crd(crd)
+
+
+class TestKubectlCRD:
+    def test_kubectl_flow(self, server, tmp_path, capsys):
+        crd_manifest = tmp_path / "crd.json"
+        crd_manifest.write_text(json.dumps({
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": "widgets.example.com"},
+            "spec": {
+                "group": "example.com",
+                "names": {"plural": "widgets", "singular": "widget",
+                          "kind": "Widget", "shortNames": ["wg"]},
+                "scope": "Namespaced",
+                "versions": [{"name": "v1", "served": True,
+                              "storage": True}]}}))
+        cr_manifest = tmp_path / "cr.json"
+        cr_manifest.write_text(json.dumps({
+            "apiVersion": "example.com/v1", "kind": "Widget",
+            "metadata": {"name": "w1", "namespace": "default"},
+            "spec": {"size": 7}}))
+        argv = ["--master", server.address]
+        assert kubectl.main([*argv, "create", "-f", str(crd_manifest)]) == 0
+        try:
+            assert kubectl.main([*argv, "apply", "-f",
+                                 str(cr_manifest)]) == 0
+            # get by plural and by short name
+            assert kubectl.main([*argv, "get", "widgets"]) == 0
+            assert kubectl.main([*argv, "get", "wg", "w1", "-o",
+                                 "json"]) == 0
+            out = capsys.readouterr().out
+            assert '"size": 7' in out
+            assert kubectl.main([*argv, "delete", "widgets", "w1"]) == 0
+        finally:
+            unregister_crd(widget_crd())
+
+
+class TestWALReplay:
+    def test_cr_instances_survive_restart(self, tmp_path):
+        from kubernetes_tpu.state.store import Store
+        wal = str(tmp_path / "wal.log")
+        store = Store(wal_path=wal)
+        from kubernetes_tpu.state import Client
+        client = Client(store)
+        crd = widget_crd(plural="sprockets", kind="Sprocket",
+                         short_names=())
+        client.resource(CustomResourceDefinition).create(crd)
+        cls = register_crd(crd)
+        try:
+            client.resource(cls, "default").create(
+                cls(metadata=api.ObjectMeta(name="s1",
+                                            namespace="default"),
+                    spec={"teeth": 12}))
+            store.close()
+            unregister_crd(crd)
+            assert SCHEME.type_for_resource("sprockets") is None
+            # restart: replay must re-register the dynamic type in order
+            store2 = Store(wal_path=wal)
+            client2 = Client(store2)
+            cls2 = SCHEME.type_for_resource("sprockets")
+            assert cls2 is not None
+            got = client2.resource(cls2, "default").get("s1")
+            assert got.spec == {"teeth": 12}
+            store2.close()
+        finally:
+            unregister_crd(crd)
