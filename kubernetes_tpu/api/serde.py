@@ -69,7 +69,24 @@ def _strip_optional(tp):
 
 
 def encode(obj: Any) -> Any:
-    """Encode a dataclass (or container of them) to plain JSON-able data."""
+    """Encode a dataclass (or container of them) to plain JSON-able data.
+
+    Dataclasses go through per-class COMPILED encoders (same technique as
+    the deepcopy copiers below): field dispatch is resolved once from the
+    type hints, not re-inspected per value — the reflective path below is
+    the fallback for values that deviate from their declared types."""
+    if obj is None:
+        return None
+    cls = obj.__class__
+    h = _ENCODERS.get(cls)
+    if h is not None:
+        return h(obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _encoder_for(cls)(obj)
+    return _encode_slow(obj)
+
+
+def _encode_slow(obj: Any) -> Any:
     if obj is None:
         return None
     if hasattr(obj, "to_json") and not isinstance(obj, type):
@@ -102,7 +119,16 @@ def encode(obj: Any) -> Any:
 
 
 def decode(cls: Type[T], data: Any) -> T:
-    """Decode JSON-able data into an instance of dataclass `cls`."""
+    """Decode JSON-able data into an instance of dataclass `cls` (per-class
+    compiled decoders; the reflective _decode_value is the fallback)."""
+    if data is None:
+        return None
+    h = _DECODERS.get(cls)
+    if h is not None:
+        return h(data)
+    if isinstance(cls, type) and dataclasses.is_dataclass(cls) \
+            and not hasattr(cls, "from_json"):
+        return _decoder_for(cls)(data)
     return _decode_value(cls, data)
 
 
@@ -133,6 +159,236 @@ def _decode_value(tp, data):
     if tp is float and isinstance(data, int):
         return float(data)
     return data
+
+
+# --------------------------------------------------------- compiled codecs
+
+_ENCODERS: dict = {}
+_DECODERS: dict = {}
+
+_SCALARS = (str, int, float, bool)
+
+
+def _encoder_for(cls):
+    h = _ENCODERS.get(cls)
+    if h is None:
+        h = _build_encoder(cls)
+    return h
+
+
+def _decoder_for(cls):
+    h = _DECODERS.get(cls)
+    if h is None:
+        h = _build_decoder(cls)
+    return h
+
+
+def _codec_kind(tp):
+    """Classify a RESOLVED (non-Optional) hint for codegen."""
+    if tp in _SCALARS:
+        return "scalar", tp
+    if tp is Any:
+        return "any", None
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return "enum", tp
+    if isinstance(tp, type) and hasattr(tp, "from_json"):
+        return "value", tp  # Quantity-style value object
+    if isinstance(tp, type) and dataclasses.is_dataclass(tp):
+        return "dataclass", tp
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        args = get_args(tp)
+        return "list", (args[0] if args else Any)
+    if origin is dict:
+        args = get_args(tp)
+        return "dict", (args[1] if len(args) == 2 else Any)
+    if tp is dict:
+        return "rawdict", None
+    if tp is list:
+        return "rawlist", None
+    return "other", tp
+
+
+def _build_encoder(cls):
+    if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)) or \
+            hasattr(cls, "to_json"):
+        _ENCODERS[cls] = _encode_slow
+        return _encode_slow
+    _ENCODERS[cls] = _encode_slow  # recursion guard during build
+    hints = _hints_of(cls)
+    src = ["def _enc(v):", "    d = v.__dict__", "    out = {}"]
+    ns = {"_slow": _encode_slow}
+    for i, f in enumerate(dataclasses.fields(cls)):
+        n, wire = f.name, _wire_name(f)
+        kind, sub = _codec_kind(_strip_optional(hints[n]))
+        drop_empty = (f.default_factory is not dataclasses.MISSING
+                      and not f.metadata.get("keep_empty"))
+        src.append(f"    x = d[{n!r}]")
+        src.append("    if x is not None:")
+        if kind in ("list", "dict", "rawdict", "rawlist") and drop_empty:
+            guard = "        if x:"
+        elif drop_empty:
+            # non-container field with a default_factory (rare): keep the
+            # reflective empty semantics
+            guard = "        if x != [] and x != {}:"
+        else:
+            guard = "        if True:"
+        src.append(guard)
+        pre = "            "
+        if kind == "scalar":
+            src.append(f"{pre}out[{wire!r}] = x if x.__class__ in _SC "
+                       f"else _slow(x)")
+            ns["_SC"] = frozenset(_SCALARS)
+        elif kind == "enum":
+            src.append(f"{pre}out[{wire!r}] = x.value "
+                       f"if isinstance(x, _E{i}) else _slow(x)")
+            ns[f"_E{i}"] = sub
+        elif kind == "value":
+            src.append(f"{pre}out[{wire!r}] = x.to_json() "
+                       f"if isinstance(x, _V{i}) else _slow(x)")
+            ns[f"_V{i}"] = sub
+        elif kind == "dataclass":
+            ns[f"_d{i}"] = sub
+            ns[f"_s{i}"] = _encoder_for(sub) if sub is not cls else None
+            if sub is cls:
+                src.append(f"{pre}out[{wire!r}] = _enc(x) "
+                           f"if x.__class__ is _d{i} else _slow(x)")
+            else:
+                src.append(f"{pre}out[{wire!r}] = _s{i}(x) "
+                           f"if x.__class__ is _d{i} else _slow(x)")
+        elif kind == "list":
+            ekind, esub = _codec_kind(_strip_optional(sub))
+            if ekind == "scalar":
+                src.append(f"{pre}out[{wire!r}] = list(x) "
+                           f"if isinstance(x, (list, tuple)) else _slow(x)")
+            elif ekind == "dataclass" and esub is not cls:
+                ns[f"_el{i}"] = esub
+                ns[f"_es{i}"] = _encoder_for(esub)
+                src.append(
+                    f"{pre}out[{wire!r}] = ["
+                    f"_es{i}(e) if e.__class__ is _el{i} else _slow(e) "
+                    f"for e in x] if isinstance(x, (list, tuple)) "
+                    f"else _slow(x)")
+            elif ekind == "value":
+                ns[f"_el{i}"] = esub
+                src.append(
+                    f"{pre}out[{wire!r}] = ["
+                    f"e.to_json() if isinstance(e, _el{i}) else _slow(e) "
+                    f"for e in x] if isinstance(x, (list, tuple)) "
+                    f"else _slow(x)")
+            else:
+                src.append(f"{pre}out[{wire!r}] = _slow(x)")
+        elif kind == "dict":
+            vkind, vsub = _codec_kind(_strip_optional(sub))
+            if vkind == "scalar":
+                src.append(f"{pre}out[{wire!r}] = dict(x) "
+                           f"if isinstance(x, dict) else _slow(x)")
+            elif vkind == "value":
+                ns[f"_dv{i}"] = vsub
+                src.append(
+                    f"{pre}out[{wire!r}] = {{"
+                    f"k: (e.to_json() if isinstance(e, _dv{i}) "
+                    f"else _slow(e)) for k, e in x.items()}} "
+                    f"if isinstance(x, dict) else _slow(x)")
+            else:
+                src.append(f"{pre}out[{wire!r}] = _slow(x)")
+        elif kind in ("rawdict", "rawlist", "any", "other"):
+            src.append(f"{pre}out[{wire!r}] = _slow(x)")
+    src.append("    return out")
+    exec(compile("\n".join(src), f"<encoder {cls.__name__}>", "exec"), ns)
+    h = ns["_enc"]
+    _ENCODERS[cls] = h
+    return h
+
+
+def _build_decoder(cls):
+    if not (isinstance(cls, type) and dataclasses.is_dataclass(cls)) or \
+            hasattr(cls, "from_json"):
+        h = lambda data: _decode_value(cls, data)  # noqa: E731
+        _DECODERS[cls] = h
+        return h
+    _DECODERS[cls] = lambda data: _decode_value(cls, data)  # recursion guard
+    src = ["def _dec(data):", "    kw = {}"]
+    ns = {"_cls": cls, "_dv": _decode_value, "_Any": Any}
+    for i, (f, wire, ftp) in enumerate(_wire_fields(cls)):
+        n = f.name
+        kind, sub = _codec_kind(_strip_optional(ftp))
+        src.append(f"    if {wire!r} in data:")
+        src.append(f"        x = data[{wire!r}]")
+        pre = "        "
+        if kind == "scalar" and sub is not float:
+            src.append(f"{pre}kw[{n!r}] = x")
+        elif kind == "scalar":  # float accepts wire ints
+            src.append(f"{pre}kw[{n!r}] = float(x) "
+                       f"if isinstance(x, int) else x")
+        elif kind in ("any", "rawdict", "rawlist", "other"):
+            if kind == "other":
+                ns[f"_t{i}"] = sub
+                src.append(f"{pre}kw[{n!r}] = _dv(_t{i}, x)")
+            else:
+                src.append(f"{pre}kw[{n!r}] = x")
+        elif kind == "enum":
+            ns[f"_e{i}"] = sub
+            src.append(f"{pre}kw[{n!r}] = _e{i}(x) "
+                       f"if x is not None else None")
+        elif kind == "value":
+            ns[f"_v{i}"] = sub
+            src.append(f"{pre}kw[{n!r}] = _v{i}.from_json(x) "
+                       f"if x is not None else None")
+        elif kind == "dataclass":
+            ns[f"_t{i}"] = sub
+            if sub is cls:
+                src.append(f"{pre}kw[{n!r}] = _dec(x) "
+                           f"if isinstance(x, dict) else _dv(_t{i}, x)")
+            else:
+                ns[f"_s{i}"] = _decoder_for(sub)
+                src.append(f"{pre}kw[{n!r}] = _s{i}(x) "
+                           f"if isinstance(x, dict) else _dv(_t{i}, x)")
+        elif kind == "list":
+            ekind, esub = _codec_kind(_strip_optional(sub))
+            if ekind == "scalar":
+                src.append(f"{pre}kw[{n!r}] = list(x) "
+                           f"if isinstance(x, list) else _dv(_lt{i}, x)")
+                ns[f"_lt{i}"] = ftp
+            elif ekind == "dataclass" and esub is not cls:
+                ns[f"_el{i}"] = _decoder_for(esub)
+                ns[f"_lt{i}"] = ftp
+                src.append(
+                    f"{pre}kw[{n!r}] = ["
+                    f"_el{i}(e) if isinstance(e, dict) else e "
+                    f"for e in x] if isinstance(x, list) "
+                    f"else _dv(_lt{i}, x)")
+            elif ekind == "value":
+                ns[f"_el{i}"] = esub
+                ns[f"_lt{i}"] = ftp
+                src.append(
+                    f"{pre}kw[{n!r}] = ["
+                    f"_el{i}.from_json(e) for e in x] "
+                    f"if isinstance(x, list) else _dv(_lt{i}, x)")
+            else:
+                ns[f"_lt{i}"] = ftp
+                src.append(f"{pre}kw[{n!r}] = _dv(_lt{i}, x)")
+        elif kind == "dict":
+            vkind, vsub = _codec_kind(_strip_optional(sub))
+            if vkind == "scalar":
+                src.append(f"{pre}kw[{n!r}] = dict(x) "
+                           f"if isinstance(x, dict) else _dv(_dt{i}, x)")
+                ns[f"_dt{i}"] = ftp
+            elif vkind == "value":
+                ns[f"_dv{i}"] = vsub
+                ns[f"_dt{i}"] = ftp
+                src.append(
+                    f"{pre}kw[{n!r}] = {{"
+                    f"k: _dv{i}.from_json(e) for k, e in x.items()}} "
+                    f"if isinstance(x, dict) else _dv(_dt{i}, x)")
+            else:
+                ns[f"_dt{i}"] = ftp
+                src.append(f"{pre}kw[{n!r}] = _dv(_dt{i}, x)")
+    src.append("    return _cls(**kw)")
+    exec(compile("\n".join(src), f"<decoder {cls.__name__}>", "exec"), ns)
+    h = ns["_dec"]
+    _DECODERS[cls] = h
+    return h
 
 
 def to_json_str(obj: Any, **kw) -> str:

@@ -147,11 +147,14 @@ def validate_workload(obj) -> None:
         raise ValidationError(errs)
 
 
-#: kinds whose objects must NOT carry a namespace; the scheme adds every
-#: cluster-scoped registration (incl. dynamic CRDs) here
-CLUSTER_SCOPED_KINDS = {
+#: BUILTIN kinds whose objects must NOT carry a namespace (static set);
+#: dynamically-registered cluster-scoped types are tracked by CLASS in
+#: CLUSTER_SCOPED_TYPES — keying dynamics by kind name would let a CRD
+#: with kind "Service" poison validation of core Services
+CLUSTER_SCOPED_KINDS = frozenset({
     "Node", "Namespace", "PersistentVolume", "StorageClass",
-    "PriorityClass", "CustomResourceDefinition"}
+    "PriorityClass", "CustomResourceDefinition"})
+CLUSTER_SCOPED_TYPES: set = set()
 
 
 def validate(obj) -> None:
@@ -165,7 +168,9 @@ def validate(obj) -> None:
         errs: List[str] = []
         meta = getattr(obj, "metadata", None)
         if meta is not None:
-            namespaced = getattr(obj, "kind", "") not in CLUSTER_SCOPED_KINDS
+            namespaced = (
+                getattr(obj, "kind", "") not in CLUSTER_SCOPED_KINDS
+                and type(obj) not in CLUSTER_SCOPED_TYPES)
             validate_object_meta(meta, namespaced=namespaced, errs=errs)
         if errs:
             raise ValidationError(errs)

@@ -154,16 +154,28 @@ class APIServer:
             except ValueError:
                 pass
 
-    def _delete_cr_instances(self, crd_name: str) -> None:
+    def _update_crd(self, rc, obj):
+        """CRD updates must re-validate and re-register live — otherwise
+        the scheme serves the OLD names until restart while WAL replay
+        would register the NEW shape (live/replay divergence), and a
+        rename onto a builtin's plural would only explode at replay."""
+        from ..runtime.crd import register_crd, unregister_crd, validate_crd
+        old = rc.get(obj.metadata.name)
+        validate_crd(obj, self.scheme if obj.spec.names.plural !=
+                     old.spec.names.plural else None)
+        out = rc.update(obj)
+        if (old.spec.group, old.spec.names.kind,
+                old.spec.names.plural) != (out.spec.group,
+                                           out.spec.names.kind,
+                                           out.spec.names.plural):
+            unregister_crd(old, self.scheme)
+        register_crd(out, self.scheme)
+        return out
+
+    def _delete_cr_instances(self, crd) -> None:
         """Deleting a CRD deletes its custom resources (the reference's
         apiextensions finalizer does this cleanup); without it the orphaned
         records resurrect on WAL replay once the type re-registers."""
-        try:
-            crd = self.client.resource(
-                self.scheme.type_for_resource(
-                    "customresourcedefinitions")).get(crd_name)
-        except NotFoundError:
-            return
         plural = crd.spec.names.plural
         try:
             items, _ = self.store.list(plural, None)
@@ -309,6 +321,11 @@ class APIServer:
             resource = req.resource
             if req.subresource:
                 resource = f"{req.resource}/{req.subresource}"
+            elif req.resource == "bindings":
+                # the bindings collection IS the bind privilege (single or
+                # bulk) — authorizing it as a plain "bindings" create would
+                # let a role without pods/binding bind pods
+                resource = "pods/binding"
             if not self._check_authz(h, user, verb, resource, req.namespace):
                 return False, user
         return True, user
@@ -414,6 +431,42 @@ class APIServer:
             if data is None:
                 self._error(h, 422, "Invalid", "empty request body")
                 return
+            if req.resource == "bindings":
+                # the scheduler's bulk bind: a List of Bindings lands as
+                # ONE store transaction (PodClient.bind_bulk), the wire
+                # analog of the in-process batch-bind path. A single
+                # Binding body binds one pod. Authorization already ran as
+                # create pods/binding (_authorized maps this resource).
+                items = data.get("items", [data]) \
+                    if data.get("kind") == "List" else [data]
+                bindings = []
+                for d in items:
+                    b = serde.decode(Binding, d)
+                    if req.namespace:
+                        if b.metadata.namespace and \
+                                b.metadata.namespace != req.namespace:
+                            self._error(
+                                h, 422, "Invalid",
+                                f"binding namespace "
+                                f"({b.metadata.namespace}) does not match "
+                                f"the request ({req.namespace})")
+                            return
+                        b.metadata.namespace = req.namespace
+                    bindings.append(b)
+                outs = self.client.pods(req.namespace or None) \
+                    .bind_bulk(bindings)
+                # slim per-slot results — the reference's bind returns
+                # metav1.Status, never the pod; echoing N full pods would
+                # cost an encode+decode per bind on the hot path
+                body = {"apiVersion": "v1", "kind": "List", "items": [
+                    {"kind": "Status", "status": "Success"}
+                    if not isinstance(o, Exception) else
+                    {"kind": "Status", "status": "Failure",
+                     "reason": type(o).__name__, "message": str(o)}
+                    for o in outs]}
+                self._respond_raw(h, 200, json.dumps(body).encode(),
+                                  "application/json")
+                return
             if (req.resource == "pods" and req.subresource == "binding") or (
                     req.resource == "pods" and not req.name and
                     data and data.get("kind") == "Binding"):
@@ -481,7 +534,10 @@ class APIServer:
                 out = rc.update_status(obj)
             else:
                 obj = self.admission.admit("UPDATE", req.resource, obj)
-                out = rc.update(obj)
+                if req.resource == "customresourcedefinitions":
+                    out = self._update_crd(rc, obj)
+                else:
+                    out = rc.update(obj)
             self._respond(h, 200, out)
         elif method == "PATCH":
             data = self._read_body(h)
@@ -506,15 +562,15 @@ class APIServer:
                 self._error(h, 403, "Forbidden",
                             f'namespace "{req.name}" cannot be deleted')
                 return
-            if req.resource == "customresourcedefinitions":
-                # cascade FIRST: instance DELETE records must precede the
-                # CRD's in the WAL, or replay drops the type registration
-                # while instance tombstones still need it to decode
-                self._delete_cr_instances(req.name)
             out = rc.delete(req.name, namespace=req.namespace or None,
                             resource_version=req.query.get("resourceVersion"))
             if req.resource == "customresourcedefinitions":
+                # cascade only AFTER the delete committed — a stale-rv
+                # rejection above must not have destroyed the instances
+                # (WAL replay handles instance tombstones appearing after
+                # the CRD's DELETE record by raw metadata removal)
                 from ..runtime.crd import unregister_crd
+                self._delete_cr_instances(out)
                 unregister_crd(out, self.scheme)
             self._respond(h, 200, out)
         else:
@@ -604,10 +660,29 @@ class APIServer:
                     continue
                 if ev is None:
                     break
-                frame = json.dumps({
-                    "type": ev.type,
-                    "object": serde.encode(ev.object)}) + "\n"
-                write_chunk(frame.encode())
+                # coalesce everything already queued into ONE chunk: a
+                # bulk bind lands thousands of events at once, and one
+                # write per event is a syscall + chunk-header per event
+                # on both sides of the wire
+                batch = [ev]
+                closing = False
+                while len(batch) < 2048:
+                    try:
+                        nxt = watch.events.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if nxt is None:
+                        closing = True
+                        break
+                    batch.append(nxt)
+                frames = b"".join(
+                    (json.dumps({"type": e.type,
+                                 "object": serde.encode(e.object)})
+                     + "\n").encode()
+                    for e in batch)
+                write_chunk(frames)
+                if closing:
+                    break
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

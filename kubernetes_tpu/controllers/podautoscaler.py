@@ -138,6 +138,13 @@ class HorizontalController(Controller):
         ref = hpa.spec.scale_target_ref
         scale = rc.get_scale(ref.name, namespace=ns)
         current = scale.spec.replicas
+        if current == 0:
+            # spec.replicas == 0 means the operator paused the workload:
+            # autoscaling is DISABLED, not a reason to scale back up
+            # (ref: reconcileAutoscaler's scalingActive=false branch)
+            self._update_status(hpa, 0, 0, None, scaled=False,
+                                now=time.time())
+            return
 
         desired = current
         utilization = None

@@ -36,9 +36,10 @@ class Scheme:
         self._namespaced[cls] = namespaced
         if not namespaced:
             # keep generic validation's scope knowledge in sync (it cannot
-            # import the scheme: api <- runtime would cycle)
+            # import the scheme: api <- runtime would cycle); keyed by
+            # CLASS — a kind-name key would collide with builtins
             from ..api import validation
-            validation.CLUSTER_SCOPED_KINDS.add(kind)
+            validation.CLUSTER_SCOPED_TYPES.add(cls)
 
     def unregister(self, api_version: str, kind: str, resource: str) -> None:
         """Remove a dynamically-registered kind (CRD deletion)."""
@@ -49,15 +50,9 @@ class Scheme:
         self._resource_by_type.pop(cls, None)
         if self._type_by_resource.get(resource) is cls:
             del self._type_by_resource[resource]
-        was_cluster_scoped = not self._namespaced.pop(cls, True)
-        if was_cluster_scoped and not any(
-                k == kind and not self._namespaced.get(c, True)
-                for (v, k), c in self._by_gvk.items()):
-            # no other cluster-scoped registration shares this kind: prune
-            # the validation set or a recreated Namespaced CRD of the same
-            # kind would have its instances rejected
-            from ..api import validation
-            validation.CLUSTER_SCOPED_KINDS.discard(kind)
+        self._namespaced.pop(cls, None)
+        from ..api import validation
+        validation.CLUSTER_SCOPED_TYPES.discard(cls)
 
     def type_for(self, api_version: str, kind: str) -> Optional[Type]:
         return self._by_gvk.get((api_version, kind)) or \

@@ -749,6 +749,10 @@ class BatchScheduler:
                 preds.check_volume_binding_factory(self.volume_binder)
         return all_preds
 
+    #: max candidate nodes that undergo the full clone+reprieve victim
+    #: search per preempting pod (see the ranking proxy in preempt())
+    PREEMPT_CANDIDATE_CAP = 100
+
     def preempt(self, pod: Pod):
         """Ref: generic_scheduler.go Preempt (:310-369). Returns a
         PreemptionPlan or None. Pure computation — the shell performs the
@@ -789,12 +793,48 @@ class BatchScheduler:
             return ok
         pdbs = list(self.pdb_lister())
         base_meta = preds.PredicateMetadata(pod, infos)
-        victims_map: Dict[str, Tuple[List[Pod], int]] = {}
+        candidates = []
         for row in np.nonzero(vec)[0]:
             name = self.mirror.name_of.get(int(row))
             ni = infos.get(name) if name else None
             if ni is None or not pre.resource_screen(pod, ni):
                 continue
+            candidates.append((name, ni))
+        if len(candidates) > self.PREEMPT_CANDIDATE_CAP:
+            # cost bound: the clone + reprieve loop per candidate is host
+            # python (the reference absorbs full-cluster cost with 16
+            # goroutines, :996); rank by a cheap proxy for pick_one_node's
+            # criteria — PDB-clean first (its FIRST criterion), then
+            # lowest max victim priority, then fewest lower-priority pods
+            # — and search only the best CAP. A mass high-priority burst
+            # over 5k full nodes stays O(CAP×pods/node) instead of
+            # O(nodes×pods/node) per pod.
+            prio = helpers.pod_priority(pod)
+
+            def touches_pdb(p) -> bool:
+                from ..api import labels as labelsmod
+                for pdb in pdbs:
+                    if pdb.metadata.namespace == p.metadata.namespace and \
+                            pdb.spec.selector is not None and \
+                            labelsmod.matches(pdb.spec.selector,
+                                              p.metadata.labels):
+                        return True
+                return False
+
+            def proxy(item):
+                _, ni = item
+                lower = [p for p in ni.pods
+                         if helpers.pod_priority(p) < prio]
+                has_pdb = any(touches_pdb(p) for p in lower) if pdbs \
+                    else False
+                return (has_pdb,
+                        max((helpers.pod_priority(p) for p in lower),
+                            default=0),
+                        len(lower))
+            candidates.sort(key=proxy)
+            candidates = candidates[:self.PREEMPT_CANDIDATE_CAP]
+        victims_map: Dict[str, Tuple[List[Pod], int]] = {}
+        for name, ni in candidates:
             sel = pre.select_victims_on_node(pod, ni, infos, fits, pdbs,
                                              base_meta=base_meta)
             if sel is not None:

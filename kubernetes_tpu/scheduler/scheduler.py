@@ -427,6 +427,12 @@ class Scheduler:
         n_assumed = 0
         for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
+                if not hasattr(out, "metadata"):
+                    # slim wire success (the server answers Status, like
+                    # the reference's bind): assume our own local clone —
+                    # the informer's MODIFIED echo carries the real object
+                    out = serde.shallow_bind_clone(res.pod)
+                    out.spec.node_name = res.node_name
                 # ref: scheduler.go assume :382-409 — the nomination is
                 # consumed the moment the pod lands
                 self.queue.nominated.delete(out)

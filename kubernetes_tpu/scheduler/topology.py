@@ -128,6 +128,12 @@ class TopologyIndex:
         # term table grows)
         self._match_cache: Dict[Tuple, frozenset] = {}
         self._match_cache_nterms = 0
+        #: lazy activation: an affinity-free cluster pays only a cheap
+        #: `spec.affinity is not None` scan per dirty node — the per-pod
+        #: rv-diff bookkeeping starts at the FIRST affinity carrier or
+        #: term (one O(cluster) rebuild), not on every uniform batch
+        self._active = False
+        self._last_snapshot = None
 
     # ------------------------------------------------------------ interning
 
@@ -186,6 +192,9 @@ class TopologyIndex:
         """Register a term for match-count maintenance, backfilling from the
         pods the index already holds (one O(pods) scan per NEW term — the
         amortized replacement for the reference's per-cycle full scan)."""
+        # a term arriving from a PENDING pod is the other activation edge:
+        # the index must hold records before the backfill scan below
+        self._activate()
         term = self._intern(tk, namespaces, selector)
         if term.match_registered:
             return term
@@ -208,6 +217,37 @@ class TopologyIndex:
     def apply(self, snapshot, dirty_names) -> None:
         """Consume the cache's dirty-node list (call right after
         TensorMirror.apply — row_of must already reflect the delta)."""
+        self._last_snapshot = snapshot
+        if not self._active:
+            if not self._dirty_has_affinity(snapshot, dirty_names):
+                return
+            self._activate()  # rebuilds from the FULL snapshot
+            return
+        self._apply_records(snapshot, dirty_names)
+
+    def _dirty_has_affinity(self, snapshot, dirty_names) -> bool:
+        for name in dirty_names:
+            ni = snapshot.node_infos.get(name)
+            if ni is None:
+                continue
+            for p in ni.pods:
+                aff = p.spec.affinity
+                if aff is not None and (aff.pod_affinity is not None or
+                                        aff.pod_anti_affinity is not None):
+                    return True
+        return False
+
+    def _activate(self) -> None:
+        """First affinity carrier/term seen: switch to incremental
+        maintenance, seeded by one full-cluster pass."""
+        if self._active:
+            return
+        self._active = True
+        snap = self._last_snapshot
+        if snap is not None:
+            self._apply_records(snap, list(snap.node_infos))
+
+    def _apply_records(self, snapshot, dirty_names) -> None:
         changed = False
         for name in dirty_names:
             ni = snapshot.node_infos.get(name)

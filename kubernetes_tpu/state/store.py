@@ -131,6 +131,16 @@ class Store:
                 continue
             cls = SCHEME.type_for_resource(rec["resource"])
             if cls is None:
+                if rec["op"] == "DELETE":
+                    # tombstone for an unregistered kind (CRD cascade
+                    # writes instance deletes AFTER the CRD's own DELETE):
+                    # removal needs only the record's metadata, not a type
+                    md = (rec.get("object") or {}).get("metadata", {})
+                    bucket = self._data.get(rec["resource"])
+                    if bucket is not None:
+                        bucket.pop((md.get("namespace", ""),
+                                    md.get("name", "")), None)
+                    self._rv = max(self._rv, rec["rv"])
                 continue
             obj = serde.decode(cls, rec["object"])
             if rec["resource"] == "customresourcedefinitions":

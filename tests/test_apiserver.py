@@ -382,3 +382,39 @@ class TestAuth:
                 sched.stop()
         finally:
             srv.stop()
+
+
+class TestBulkBindings:
+    def test_bind_bulk_one_post_one_transaction(self):
+        """The wire bulk-bind path: a Binding List POST lands as one store
+        transaction; failed slots come back as typed exceptions."""
+        srv = APIServer().start()
+        try:
+            client = HTTPClient(srv.address)
+            client.nodes().create(make_node("n1"))
+            for i in range(3):
+                client.pods("default").create(make_pod(f"b{i}"))
+            bindings = [api.Binding(
+                metadata=api.ObjectMeta(name=f"b{i}", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="n1"))
+                for i in range(3)]
+            # one of them targets a pod that does not exist
+            bindings.append(api.Binding(
+                metadata=api.ObjectMeta(name="ghost", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="n1")))
+            outs = client.pods("default").bind_bulk(bindings)
+            assert len(outs) == 4
+            # slim wire slots: truthy success markers, typed failures
+            for i in range(3):
+                assert outs[i] and not isinstance(outs[i], Exception)
+                assert client.pods("default").get(
+                    f"b{i}").spec.node_name == "n1"
+            assert isinstance(outs[3], NotFoundError)
+            # binding an already-bound pod to a DIFFERENT node conflicts
+            # (same-node rebind is idempotent by design)
+            outs2 = client.pods("default").bind_bulk([api.Binding(
+                metadata=api.ObjectMeta(name="b0", namespace="default"),
+                target=api.ObjectReference(kind="Node", name="other"))])
+            assert isinstance(outs2[0], ConflictError)
+        finally:
+            srv.stop()

@@ -204,6 +204,25 @@ class TestHorizontalController:
         finally:
             informers.stop()
 
+    def test_scale_to_zero_disables_autoscaling(self):
+        """spec.replicas == 0 is an operator pause: the HPA must not
+        fight it back up to min_replicas (ref: reconcileAutoscaler's
+        scalingActive=false branch)."""
+        metrics = StaticMetrics()
+        client, informers, hc = self._setup(metrics)
+        self._seed(client, 2, 90, metrics, target_pct=50)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            scale = client.deployments("default").get_scale("web")
+            scale.spec.replicas = 0
+            client.deployments("default").update_scale("web", scale)
+            hc.sync("default/web")
+            assert client.deployments("default").get("web") \
+                .spec.replicas == 0
+        finally:
+            informers.stop()
+
     def test_e2e_up_then_down(self):
         """VERDICT #10 done-criterion: load scales a Deployment up and
         back down (downscale window disabled)."""

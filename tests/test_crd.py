@@ -123,6 +123,52 @@ class TestCRDOverHTTP:
         items, _ = client.resource(cls2, "default").list_rv("default")
         assert items == []
 
+    def test_stale_rv_delete_preserves_instances(self, server):
+        """A CRD delete rejected by its resourceVersion precondition must
+        NOT have cascaded the instances away."""
+        import urllib.request
+        client = HTTPClient(server.address)
+        created = client.resource(CustomResourceDefinition).create(
+            widget_crd())
+        cls = SCHEME.type_for_resource("widgets")
+        client.resource(cls, "default").create(
+            cls(metadata=api.ObjectMeta(name="w1", namespace="default"),
+                spec={"x": 1}))
+        stale_rv = created.metadata.resource_version
+        # bump the CRD so the recorded rv goes stale
+        client.resource(CustomResourceDefinition).merge_patch(
+            "widgets.example.com",
+            {"metadata": {"labels": {"touched": "yes"}}}, strategic=False)
+        req = urllib.request.Request(
+            f"{server.address}/apis/apiextensions.k8s.io/v1/"
+            f"customresourcedefinitions/widgets.example.com"
+            f"?resourceVersion={stale_rv}", method="DELETE")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 409
+        # the CRD and its instance both survive the rejected delete
+        assert SCHEME.type_for_resource("widgets") is not None
+        assert client.resource(cls, "default").get("w1").spec == {"x": 1}
+
+    def test_crd_update_reregisters_live(self, server):
+        """Updating a CRD's names must re-register immediately (not at
+        restart): new short names resolve, stale plural rejected."""
+        client = HTTPClient(server.address)
+        client.resource(CustomResourceDefinition).create(widget_crd())
+        live = client.resource(CustomResourceDefinition).get(
+            "widgets.example.com")
+        live.spec.names.short_names = ["wid"]
+        client.resource(CustomResourceDefinition).update(live)
+        assert kubectl.main(["-s", server.address, "get", "wid"]) == 0
+        # renaming the plural onto a builtin is rejected, nothing stored
+        live = client.resource(CustomResourceDefinition).get(
+            "widgets.example.com")
+        live.spec.names.plural = "pods"
+        with pytest.raises(RuntimeError, match="already registered"):
+            client.resource(CustomResourceDefinition).update(live)
+        assert client.resource(CustomResourceDefinition).get(
+            "widgets.example.com").spec.names.plural == "widgets"
+
     def test_failed_crd_create_leaves_no_phantom_type(self, server):
         """A CRD create that fails validation must not leave the dynamic
         type registered (phantom resource with no stored CRD)."""
@@ -171,10 +217,10 @@ class TestCRDOverHTTP:
         from kubernetes_tpu.api import validation
         crd_c = widget_crd(plural="things", kind="Thing", scope="Cluster",
                            short_names=())
-        register_crd(crd_c)
-        assert "Thing" in validation.CLUSTER_SCOPED_KINDS
+        cls_c = register_crd(crd_c)
+        assert cls_c in validation.CLUSTER_SCOPED_TYPES
         unregister_crd(crd_c)
-        assert "Thing" not in validation.CLUSTER_SCOPED_KINDS
+        assert cls_c not in validation.CLUSTER_SCOPED_TYPES
         crd_n = widget_crd(plural="things", kind="Thing", short_names=())
         cls = register_crd(crd_n)
         try:
@@ -183,6 +229,23 @@ class TestCRDOverHTTP:
             validation.validate(obj)  # must not 422 on the namespace
         finally:
             unregister_crd(crd_n)
+
+    def test_cluster_crd_kind_collision_does_not_poison_builtin(self):
+        """A Cluster-scoped CRD whose KIND matches a namespaced builtin
+        must not make core objects of that kind fail validation."""
+        from kubernetes_tpu.api import validation
+        crd = widget_crd(plural="myservices", kind="Service",
+                         group="example.com", scope="Cluster",
+                         short_names=())
+        register_crd(crd)
+        try:
+            svc = api.Service(
+                metadata=api.ObjectMeta(name="s", namespace="default"),
+                spec=api.ServiceSpec(selector={"a": "b"},
+                                     ports=[api.ServicePort(port=80)]))
+            validation.validate(svc)  # namespaced Service still valid
+        finally:
+            unregister_crd(crd)
 
     def test_cluster_scoped_crd(self, server):
         crd = widget_crd(plural="gizmos", kind="Gizmo", scope="Cluster",
