@@ -414,7 +414,7 @@ def measure_parity(variant, n_pods, n_nodes):
     return matches / max(1, len(oracle_decision)), scheduled, extra
 
 
-N_RUNS = int(os.environ.get("BENCH_RUNS", "2"))
+N_RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 
 
 def main():

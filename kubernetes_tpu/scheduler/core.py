@@ -134,7 +134,8 @@ class BatchScheduler:
                  hard_pod_affinity_weight: Optional[int] = None,
                  volume_binder=None,
                  pvc_lister=None, pv_lister=None,
-                 nominated=None, pdb_lister=None, extenders=None):
+                 nominated=None, pdb_lister=None, extenders=None,
+                 mesh=None):
         from . import priorities as prios_mod
         from .queue import NominatedPodMap
         from .scorer import ScoreCompiler
@@ -161,7 +162,7 @@ class BatchScheduler:
             pvc_lister, pv_lister)
         self.cache = cache
         self.snapshot = Snapshot()
-        self.mirror = TensorMirror()
+        self.mirror = TensorMirror(mesh=mesh)
         self.terms = TermCompiler(self.mirror)
         #: the M3 incremental topologyPairsMaps analog (topology.py)
         self.topology = TopologyIndex(self.mirror)
@@ -646,9 +647,9 @@ class BatchScheduler:
             node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
-        assign_d, scores_d, new_usage = schedule_batch(node_cfg, usage,
-                                                       batch.device(),
-                                                       self._nominated_device())
+        assign_d, scores_d, new_usage = schedule_batch(
+            node_cfg, usage, batch.device(self.mirror.mesh),
+            self._nominated_device())
         return PendingBatch(pods=pods, profiles=profiles, batch=batch,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
@@ -728,9 +729,9 @@ class BatchScheduler:
         if used is None:
             self._nom_dev = None
         else:
-            import jax.numpy as jnp
-            self._nom_dev = {"used": jnp.asarray(used),
-                             "count": jnp.asarray(count)}
+            # node-axis tensors: shard with the mirror's mesh
+            self._nom_dev = {"used": self.mirror.put_nodes(used),
+                             "count": self.mirror.put_nodes(count)}
         #: pod key -> reserved row, exactly as charged into _nom_dev
         self._nom_rows_by_key = rows_by_key
         self._nom_key = key

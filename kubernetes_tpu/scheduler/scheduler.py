@@ -40,7 +40,8 @@ class Scheduler:
                  scheduler_name: str = "default-scheduler",
                  clock: Clock = REAL_CLOCK,
                  disable_preemption: bool = False,
-                 framework=None, extenders=None, metrics=None):
+                 framework=None, extenders=None, metrics=None,
+                 mesh=None):
         from .framework import Framework
         from .metrics import SchedulerMetrics
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
@@ -76,7 +77,7 @@ class Scheduler:
             pvc_lister=pvc_lister, pv_lister=pv_by_name,
             nominated=self.queue.nominated,
             pdb_lister=lambda: pdb_informer.indexer.list(),
-            extenders=self.extenders)
+            extenders=self.extenders, mesh=mesh)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle

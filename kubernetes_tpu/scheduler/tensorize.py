@@ -133,7 +133,13 @@ class TensorMirror:
     """Name <-> row mapping plus incremental row updates from cache dirties."""
 
     def __init__(self, vocab: Optional[ResourceVocab] = None,
-                 min_capacity: int = 128):
+                 min_capacity: int = 128, mesh=None):
+        #: jax.sharding.Mesh with a "nodes" axis, or None (single device).
+        #: With a mesh, every [N]/[N,C] tensor is placed
+        #: NamedSharding(P("nodes")) so the kernels' node axis rides ICI
+        #: (the scaling-book recipe: annotate shardings, let XLA insert
+        #: the collectives); pod batches stay replicated.
+        self.mesh = mesh
         self.vocab = vocab or ResourceVocab()
         self.t = NodeTensors(_bucket(1, min_capacity), self.vocab.n_cols)
         self.row_of: Dict[str, int] = {}
@@ -291,6 +297,25 @@ class TensorMirror:
 
     # ------------------------------------------------------------- device
 
+    def put_nodes(self, arr):
+        """Host array -> device, sharded over the mesh's node axis (or a
+        plain transfer single-device)."""
+        import jax
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P("nodes") if np.ndim(arr) == 1 else P("nodes", None)
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def put_replicated(self, arr):
+        import jax
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
     def device_cfg_usage(self) -> Tuple[dict, dict]:
         """The (node_cfg, usage) pytrees on device. Dirty rows ship as ONE
         packed scatter (kernels.apply_dirty); full upload only after a
@@ -299,9 +324,9 @@ class TensorMirror:
         t = self.t
         if self._device_cfg is None or self._device_usage is None:
             # resize or invalidate_usage: both re-uploaded from host truth
-            self._device_cfg = {k: jnp.asarray(v)
+            self._device_cfg = {k: self.put_nodes(v)
                                 for k, v in t.cfg_arrays().items()}
-            self._device_usage = {k: jnp.asarray(v)
+            self._device_usage = {k: self.put_nodes(v)
                                   for k, v in t.usage_arrays().items()}
         elif self._dirty_rows:
             from .kernels.batch import apply_dirty
@@ -311,13 +336,13 @@ class TensorMirror:
             # pad with an out-of-range row; apply_dirty drops it
             pad = np.full((D,), t.capacity, np.int32)
             pad[:len(idx)] = idx
-            cfg_rows = {k: _padded_rows(v, idx, D)
+            cfg_rows = {k: self.put_replicated(_padded_rows(v, idx, D))
                         for k, v in t.cfg_arrays().items()}
-            usage_rows = {k: _padded_rows(v, idx, D)
+            usage_rows = {k: self.put_replicated(_padded_rows(v, idx, D))
                           for k, v in t.usage_arrays().items()}
             self._device_cfg, self._device_usage = apply_dirty(
                 self._device_cfg, self._device_usage,
-                jnp.asarray(pad), cfg_rows, usage_rows)
+                self.put_replicated(pad), cfg_rows, usage_rows)
         self._dirty_rows.clear()
         return self._device_cfg, self._device_usage
 
@@ -608,16 +633,31 @@ class PodBatchTensors:
         fits = fits & (self.req[i][None, :] <= free).all(axis=1)
         return fits
 
-    def device(self) -> dict:
+    def device(self, mesh=None) -> dict:
         import jax.numpy as jnp
-        return {"req": jnp.asarray(self.req),
-                "nonzero_req": jnp.asarray(self.nonzero_req),
-                "mem_pressure_blocked": jnp.asarray(self.mem_pressure_blocked),
-                "active": jnp.asarray(self.active),
-                "seq": jnp.asarray(self.seq),
-                "mask_idx": jnp.asarray(self.mask_idx),
-                "score_idx": jnp.asarray(self.score_idx),
-                "nom_row": jnp.asarray(self.nom_row),
-                "unique_masks": jnp.asarray(self.unique_masks),
-                "unique_scores": jnp.asarray(self.unique_scores),
-                "resource_weights": jnp.asarray(self.resource_weights)}
+        if mesh is None:
+            put = mask_put = jnp.asarray
+        else:
+            # pod axes replicate; the mask/score tables' NODE axis shards
+            # with the mirror (each core sees every pod, owns a node shard)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(mesh, P())
+            by_node = NamedSharding(mesh, P(None, "nodes"))
+
+            def put(a):
+                return jax.device_put(np.asarray(a), repl)
+
+            def mask_put(a):
+                return jax.device_put(np.asarray(a), by_node)
+        return {"req": put(self.req),
+                "nonzero_req": put(self.nonzero_req),
+                "mem_pressure_blocked": put(self.mem_pressure_blocked),
+                "active": put(self.active),
+                "seq": put(self.seq),
+                "mask_idx": put(self.mask_idx),
+                "score_idx": put(self.score_idx),
+                "nom_row": put(self.nom_row),
+                "unique_masks": mask_put(self.unique_masks),
+                "unique_scores": mask_put(self.unique_scores),
+                "resource_weights": put(self.resource_weights)}

@@ -54,3 +54,99 @@ def test_sharded_matches_single_device():
         sharded_assign, _, _ = schedule_batch(cfg_s, usage_s, pb)
     np.testing.assert_array_equal(np.asarray(single_assign),
                                   np.asarray(sharded_assign))
+
+
+def _drain_fixture(client_cls, n_nodes=24, n_pods=96):
+    """Nodes + pending pods with mixed shapes and one affinity group."""
+    from kubernetes_tpu import api
+    from kubernetes_tpu.api import Quantity
+    client = client_cls()
+    nodes = []
+    for i in range(n_nodes):
+        alloc = {"cpu": Quantity("4"), "memory": Quantity("8Gi"),
+                 "pods": Quantity(110)}
+        nodes.append(client.nodes().create(api.Node(
+            metadata=api.ObjectMeta(
+                name=f"n{i}",
+                labels={api.wellknown.LABEL_HOSTNAME: f"n{i}",
+                        api.wellknown.LABEL_ZONE: f"z{i % 4}"}),
+            status=api.NodeStatus(
+                capacity=dict(alloc), allocatable=dict(alloc),
+                conditions=[api.NodeCondition(type="Ready",
+                                              status="True")]))))
+    pods = []
+    for i in range(n_pods):
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name=f"p{i}", namespace="default",
+                                    labels={"app": "m", "g": f"g{i % 8}"}),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(requests={
+                    "cpu": Quantity(["100m", "250m", "500m"][i % 3]),
+                    "memory": Quantity("128Mi")}))]))
+        if i % 5 == 0:
+            pod.spec.affinity = api.Affinity(
+                pod_anti_affinity=api.PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"g": f"g{i % 8}"}),
+                            topology_key=api.wellknown.LABEL_HOSTNAME)]))
+        pods.append(client.pods().create(pod))
+    return client, nodes, pods
+
+
+def test_full_drain_on_mesh_matches_single_device():
+    """VERDICT r2 #4: the PRODUCTION drain (TensorMirror dirty scatters,
+    chained usage, packed fetch, in-batch repair) on an 8-device mesh must
+    bind every pod to the same node the single-device drain picks."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.state import Client
+
+    def run(mesh):
+        client, nodes, pods = _drain_fixture(Client)
+        sched = Scheduler(client, batch_size=32, mesh=mesh)
+        for n in nodes:
+            sched.cache.add_node(n)
+        for p in pods:
+            sched.queue.add(p)
+        sched.algorithm.refresh()
+        n = sched.drain_pipelined()
+        binds = {p.metadata.name: p.spec.node_name
+                 for p in client.pods().list()}
+        return n, binds
+
+    n_single, single = run(None)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    with mesh:
+        n_mesh, mesh_binds = run(mesh)
+    assert n_single == n_mesh > 0
+    assert single == mesh_binds
+
+
+def test_mesh_drain_sharded_arrays():
+    """The mesh drain really places node tensors across all 8 shards (no
+    silent single-device fallback)."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.state import Client
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    client, nodes, pods = _drain_fixture(Client, n_nodes=16, n_pods=32)
+    with mesh:
+        sched = Scheduler(client, batch_size=32, mesh=mesh)
+        for n in nodes:
+            sched.cache.add_node(n)
+        for p in pods:
+            sched.queue.add(p)
+        sched.algorithm.refresh()
+        assert sched.drain_pipelined() > 0
+        cfg, usage = sched.algorithm.mirror.device_cfg_usage()
+    arr = next(iter(usage.values()))
+    assert len(arr.sharding.device_set) == 8
