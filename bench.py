@@ -182,6 +182,54 @@ WIRE_NODES = int(os.environ.get("BENCH_WIRE_NODES", "5000"))
 WIRE_PODS = int(os.environ.get("BENCH_WIRE_PODS", "20000"))
 
 
+class _SpawnedAPIServer:
+    """A real kube-apiserver subprocess (WAL on, own GIL) for the wire and
+    density configs — spawn, healthz handshake, hard teardown."""
+
+    def __enter__(self):
+        import socket
+        import subprocess
+        import tempfile
+        import urllib.request
+        self._tmp = tempfile.mkdtemp(prefix="bench-hub-")
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # the hub must never grab the TPU
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.cmd.kube_apiserver",
+             "--port", str(port), "--data-dir", self._tmp],
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 60
+        while True:
+            try:
+                urllib.request.urlopen(f"{self.base}/healthz", timeout=1)
+                return self
+            except Exception:
+                if time.time() > deadline or self._proc.poll() is not None:
+                    self.__exit__(None, None, None)
+                    raise RuntimeError("apiserver process never came up")
+                time.sleep(0.1)
+
+    def __exit__(self, *exc):
+        import shutil
+        import subprocess
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # a hung flush must not mask the caller's real error or leak
+            # the process/tmpdir
+            self._proc.kill()
+            self._proc.wait()
+        shutil.rmtree(self._tmp, ignore_errors=True)
+        return False
+
+
 def run_wire_config(n_nodes, n_pods, batch=None):
     """The headline config THROUGH THE HUB (ref: scheduler_perf runs
     against a real apiserver, test/integration/scheduler_perf/util.go:
@@ -191,40 +239,13 @@ def run_wire_config(n_nodes, n_pods, batch=None):
     watch into its informers, binds leave as Binding Lists through the
     bulk bindings endpoint (one store transaction per batch, one POST per
     batch). Returns (pods/s, scheduled, setup_s, elapsed)."""
-    import shutil
-    import socket
-    import subprocess
-    import tempfile
-    import urllib.request
-
     from kubernetes_tpu.apiserver import HTTPClient
     from kubernetes_tpu.scheduler import Scheduler
 
-    tmp = tempfile.mkdtemp(prefix="bench-wal-")
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"  # the hub must never grab the TPU
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "kubernetes_tpu.cmd.kube_apiserver",
-         "--port", str(port), "--data-dir", tmp],
-        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     sched = None
-    try:
-        base = f"http://127.0.0.1:{port}"
-        deadline = time.time() + 60
-        while True:
-            try:
-                urllib.request.urlopen(f"{base}/healthz", timeout=1)
-                break
-            except Exception:
-                if time.time() > deadline or proc.poll() is not None:
-                    raise RuntimeError("apiserver process never came up")
-                time.sleep(0.1)
-        client = HTTPClient(base)
+    with _SpawnedAPIServer() as hub:
+      try:
+        client = HTTPClient(hub.base)
         b = batch or BATCH
         sched = Scheduler(client, batch_size=b)
         t_setup = time.time()
@@ -259,15 +280,153 @@ def run_wire_config(n_nodes, n_pods, batch=None):
         elapsed = time.time() - t0
         rate = scheduled / elapsed if elapsed else 0.0
         return rate, scheduled, setup_s, elapsed
-    finally:
+      finally:
         if sched is not None:
             try:
                 sched.informers.stop()
             except Exception:
                 pass
-        proc.terminate()
-        proc.wait(timeout=10)
-        shutil.rmtree(tmp, ignore_errors=True)
+
+
+DENSITY_NODES = int(os.environ.get("BENCH_DENSITY_NODES", "100"))
+DENSITY_PODS_PER_NODE = int(os.environ.get("BENCH_DENSITY_PPN", "30"))
+
+
+def run_density_config(n_nodes, pods_per_node):
+    """The density e2e (ref: test/e2e/scalability/density.go:56 — 30
+    pods/node across the cluster, saturation time and pod-startup
+    latency; scheduler_test.go:35-38's >=30 pods/s floor): a REAL
+    kube-apiserver process, N hollow kubelets (kubemark) registering and
+    heartbeating over HTTP, the controller manager materializing a
+    Deployment into pods, the scheduler binding them, and the hollow
+    runtimes driving them to Running — all concurrently. Startup latency
+    is measured from pod creation to the WATCH-observed Running status
+    reported by the hollow kubelet's PLEG.
+    Returns a dict of rates and latency quantiles."""
+    import threading
+
+    from kubernetes_tpu.apiserver import HTTPClient
+    from kubernetes_tpu.controllers import ControllerManager
+    from kubernetes_tpu.node.hollow import HollowCluster
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.utils.clock import parse_iso
+
+    hollow = mgr = sched = None
+    n_pods = n_nodes * pods_per_node
+    with _SpawnedAPIServer() as hub:
+      try:
+        client = HTTPClient(hub.base)
+        # watch-observed Running times, keyed by pod name
+        running_at = {}
+        running_done = threading.Event()
+
+        stop_watching = threading.Event()
+
+        def note_running(p):
+            if p.status.phase == "Running" and \
+                    p.metadata.name not in running_at:
+                running_at[p.metadata.name] = (
+                    time.time(),
+                    parse_iso(p.metadata.creation_timestamp or ""))
+
+        def watch_running():
+            # reflector shape: list + watch, relisting whenever the stream
+            # drops (a density burst can overflow the resumable window and
+            # 410 the watcher — the reference's informers relist the same
+            # way)
+            while not stop_watching.is_set():
+                try:
+                    for p in client.pods("default").list():
+                        note_running(p)
+                    if len(running_at) >= n_pods:
+                        break
+                    w = client.pods("default").watch()
+                    for ev in w:
+                        note_running(ev.object)
+                        if len(running_at) >= n_pods or \
+                                stop_watching.is_set():
+                            break
+                    w.stop()
+                except Exception:
+                    time.sleep(0.2)
+                if len(running_at) >= n_pods:
+                    break
+            running_done.set()
+        watcher = threading.Thread(target=watch_running, daemon=True)
+        watcher.start()
+
+        hollow = HollowCluster(
+            client, n_nodes,
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            heartbeat_period=10.0, pleg_period=0.5).start()
+        mgr = ControllerManager(client)
+        mgr.start()
+        batch_size = 1024
+        sched = Scheduler(client, batch_size=batch_size)
+        # informers first (idempotent vs the later start()) so the cache
+        # holds the hollow nodes for warmup compiles
+        sched.informers.start()
+        sched.informers.wait_for_cache_sync()
+        deadline = time.time() + 120
+        while len(sched.cache.node_names()) < n_nodes:
+            if time.time() > deadline:
+                raise RuntimeError("hollow nodes never registered")
+            time.sleep(0.25)
+        # warm every power-of-two pod bucket the loop can pop — the
+        # deployment controller trickles pods in, so the first real cycles
+        # hit MANY bucket shapes; compiling them during the timed region
+        # would charge XLA compile time to pod-startup latency
+        sched.algorithm.refresh()
+        sz = batch_size
+        while sz >= 1:
+            sched.algorithm.schedule(
+                [make_pod(2_000_000 + i) for i in range(sz)])
+            sched.algorithm.mirror.invalidate_usage()
+            sz //= 2
+        _warm_dirty_scatter(sched)
+        sched.start()
+
+        t0 = time.time()
+        client.deployments("default").create(api.Deployment(
+            metadata=api.ObjectMeta(name="density", namespace="default"),
+            spec=api.DeploymentSpec(
+                replicas=n_pods,
+                selector=api.LabelSelector(match_labels={"app": "density"}),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "density"}),
+                    spec=api.PodSpec(containers=[api.Container(
+                        name="c", image="pause",
+                        resources=api.ResourceRequirements(requests={
+                            "cpu": Quantity("100m"),
+                            "memory": Quantity("64Mi")}))])))))
+        ok = running_done.wait(timeout=max(120.0, n_pods / 10.0))
+        stop_watching.set()
+        if not ok:
+            raise RuntimeError(
+                f"only {len(running_at)}/{n_pods} pods reached Running")
+        t_end = max(at for at, _ in running_at.values())
+        saturation_s = t_end - t0
+        startup = sorted(at - created for at, created in
+                         running_at.values() if created is not None)
+
+        def q(p):
+            return round(startup[min(len(startup) - 1,
+                                     int(p * len(startup)))], 3)
+        return {
+            "nodes": n_nodes, "pods": n_pods,
+            "saturation_s": round(saturation_s, 2),
+            "pods_per_sec": round(n_pods / saturation_s, 1),
+            "startup_p50_s": q(0.50), "startup_p90_s": q(0.90),
+            "startup_p99_s": q(0.99),
+            "floor_30_pods_per_sec": bool(n_pods / saturation_s >= 30.0),
+        }
+      finally:
+        for comp in (sched, mgr, hollow):
+            if comp is not None:
+                try:
+                    comp.stop()
+                except Exception:
+                    pass
 
 
 def _warm_dirty_scatter(sched):
@@ -458,6 +617,13 @@ def main():
             affinity[variant] = {
                 "pods_per_sec": round(r, 1), "scheduled": n_sched,
                 "nodes": AFF_NODES, "pods": AFF_PODS}
+    density = None
+    if DENSITY_NODES > 0:
+        try:
+            density = run_density_config(DENSITY_NODES,
+                                         DENSITY_PODS_PER_NODE)
+        except Exception as e:
+            density = {"error": str(e)}
     wire = None
     if WIRE_PODS > 0:
         w_rate, w_sched, w_setup, w_elapsed = run_wire_config(
@@ -493,6 +659,7 @@ def main():
                    "latency": latency,
                    "affinity": affinity,
                    "wire": wire,
+                   "density": density,
                    "parity_rate": parity_rate,
                    "parity": parity,
                    "parity_fixture": f"{PARITY_PODS}x{PARITY_NODES}",

@@ -25,6 +25,7 @@ from ..api.core import (ContainerStatus, Node, NodeCondition, Pod,
 from ..api.meta import ObjectMeta
 from ..api.quantity import Quantity
 from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import NotFoundError
 from ..state.workqueue import RateLimitingQueue
 from ..utils.clock import now_iso
 from .runtime import ContainerRuntime, FakeRuntime
@@ -158,7 +159,11 @@ class NodeAgent:
         if sb is None:
             sb = self.runtime.run_pod_sandbox(pod)
             self.runtime.start_containers(sb, pod)
-            self._write_status(pod, "Running", ready=True)
+        # status write runs on EVERY sync, not only sandbox creation — the
+        # _reported suppressor dedups no-ops, and a write that failed once
+        # (patch conflicts under a density burst) must retry through the
+        # workqueue instead of leaving the pod Pending forever
+        self._write_status(pod, "Running", ready=True)
 
     def _uid_for(self, key: str, pod: Optional[Pod]) -> Optional[str]:
         if pod is not None:
@@ -202,6 +207,11 @@ class NodeAgent:
             return f"{prefix}.{(h >> 8) % 250 + 1}.{h % 250 + 1}"
 
         def mutate(cur):
+            if cur.status.phase in ("Succeeded", "Failed") and \
+                    phase == "Running":
+                # a queued sync raced pleg_relist through a stale informer
+                # read: never regress a terminal phase on the server copy
+                return cur
             cur.status.phase = phase
             # deterministic fake IPs (hash() is seed-randomized per process
             # and would churn Endpoints across restarts); pod_ip is per-pod
@@ -227,8 +237,10 @@ class NodeAgent:
             self.client.pods(pod.metadata.namespace).patch(
                 pod.metadata.name, mutate)
             self._reported[uid] = (phase, ready)
-        except Exception:
-            pass
+        except NotFoundError:
+            pass  # deleted under us; the informer delete will clean up
+        # anything else (conflict exhaustion, transient HTTP) propagates:
+        # the sync worker rate-limit-requeues the pod and the write retries
 
     # --------------------------------------------------------------- run
 

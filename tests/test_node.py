@@ -246,3 +246,68 @@ class TestKubectl:
         assert client.nodes().get("n1").spec.unschedulable
         rc, out = self._run(capsys, cluster, "uncordon", "n1")
         assert not client.nodes().get("n1").spec.unschedulable
+
+
+class TestDensity:
+    def test_density_slice_concurrent_stack(self):
+        """The density shape end-to-end (ref: e2e/scalability/density.go):
+        hollow kubelets + controller manager + scheduler all running
+        concurrently against one hub; a Deployment saturates the fleet and
+        every pod reaches heartbeat-confirmed Running."""
+        import time as _t
+
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.node.hollow import HollowCluster
+        from kubernetes_tpu.scheduler import Scheduler
+        srv = APIServer().start()
+        client = HTTPClient(srv.address)
+        hollow = mgr = sched = None
+        try:
+            hollow = HollowCluster(
+                client, 10, capacity={"cpu": "8", "memory": "16Gi",
+                                      "pods": "110"},
+                heartbeat_period=2.0, pleg_period=0.2).start()
+            mgr = ControllerManager(client)
+            mgr.start()
+            sched = Scheduler(client, batch_size=64)
+            sched.start()
+            deadline = _t.time() + 20
+            while len(client.nodes().list()) < 10:
+                assert _t.time() < deadline, "hollow nodes never registered"
+                _t.sleep(0.1)
+            client.deployments("default").create(api.Deployment(
+                metadata=api.ObjectMeta(name="d", namespace="default"),
+                spec=api.DeploymentSpec(
+                    replicas=30,
+                    selector=api.LabelSelector(match_labels={"a": "d"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"a": "d"}),
+                        spec=api.PodSpec(containers=[api.Container(
+                            name="c", image="pause",
+                            resources=api.ResourceRequirements(requests={
+                                "cpu": Quantity("100m")}))])))))
+            deadline = _t.time() + 60
+            while _t.time() < deadline:
+                pods = client.pods("default").list()
+                if len(pods) == 30 and all(
+                        p.status.phase == "Running" and p.spec.node_name
+                        for p in pods):
+                    break
+                _t.sleep(0.25)
+            else:
+                phases = [p.status.phase for p in
+                          client.pods("default").list()]
+                raise AssertionError(f"density never saturated: {phases}")
+            # spread across the fleet, not piled on one node
+            nodes_used = {p.spec.node_name
+                          for p in client.pods("default").list()}
+            assert len(nodes_used) >= 5
+        finally:
+            for comp in (sched, mgr, hollow):
+                if comp is not None:
+                    try:
+                        comp.stop()
+                    except Exception:
+                        pass
+            srv.stop()
