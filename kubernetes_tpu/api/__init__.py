@@ -5,7 +5,8 @@ from .apps import (DaemonSet, DaemonSetSpec, Deployment, DeploymentSpec,
                    DeploymentStrategy, ReplicaSet, ReplicaSetSpec,
                    RollingUpdateDeployment, StatefulSet, StatefulSetSpec)
 from .batch import CronJob, CronJobSpec, Job, JobCondition, JobSpec
-from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
+from .core import (Affinity, Binding, ConfigMap, Container, ContainerImage,
+                   ContainerPort,
                    Endpoints, Event, Namespace, Node, NodeAffinity,
                    NodeCondition, NodeSelector, NodeSelectorRequirement,
                    NodeSelectorTerm, NodeSpec, NodeStatus, ObjectReference,
@@ -16,9 +17,12 @@ from .core import (Affinity, Binding, Container, ContainerImage, ContainerPort,
                    PodStatus, PodTemplateSpec, PreferredSchedulingTerm,
                    LimitRange, LimitRangeItem, LimitRangeSpec,
                    ReplicationController, ResourceQuota, ResourceQuotaSpec,
-                   ResourceQuotaStatus, ResourceRequirements, Service,
+                   ResourceQuotaStatus, ResourceRequirements, Secret,
+                   Service, ServiceAccount,
                    ServicePort, ServiceSpec, Taint, Toleration, Volume,
                    WeightedPodAffinityTerm)
+from .rbac import (AggregationRule, ClusterRole, ClusterRoleBinding,
+                   RBACPolicyRule, Role, RoleBinding, RoleRef, Subject)
 from .defaults import default
 from .meta import (LabelSelector, LabelSelectorRequirement, ObjectMeta,
                    OwnerReference, controller_ref, new_controller_ref)

@@ -582,3 +582,40 @@ class LimitRange:
     kind: str = "LimitRange"
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+# ------------------------------------------------- config & identity
+
+@dataclass
+class ConfigMap:
+    """Ref: core/v1 ConfigMap (types.go:4952)."""
+    api_version: str = "v1"
+    kind: str = "ConfigMap"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    binary_data: Dict[str, str] = field(default_factory=dict)  # base64
+    immutable: Optional[bool] = None
+
+
+@dataclass
+class Secret:
+    """Ref: core/v1 Secret (types.go:4790). `data` values are base64 on
+    the wire per convention; stringData is write-only convenience merged
+    into data by defaulting."""
+    api_version: str = "v1"
+    kind: str = "Secret"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: Dict[str, str] = field(default_factory=dict)
+    string_data: Dict[str, str] = field(default_factory=dict)
+    immutable: Optional[bool] = None
+
+
+@dataclass
+class ServiceAccount:
+    """Ref: core/v1 ServiceAccount (types.go:3980)."""
+    api_version: str = "v1"
+    kind: str = "ServiceAccount"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[ObjectReference] = field(default_factory=list)
+    automount_service_account_token: Optional[bool] = None

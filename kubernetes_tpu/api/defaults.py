@@ -76,6 +76,8 @@ def default(obj):
             obj.spec.selector = LabelSelector(
                 match_labels={"job-name": obj.metadata.name})
         return _default_workload(obj, kind_labels_from_template=False)
+    if getattr(obj, "kind", "") == "Secret":
+        merge_secret_string_data(obj)
     if getattr(obj, "kind", "") == "Namespace":
         # the kubernetes finalizer gates deletion on content cleanup
         # (ref: pkg/registry/core/namespace strategy + the namespace
@@ -98,9 +100,21 @@ def default(obj):
     if meta is not None and not meta.namespace and getattr(obj, "kind", "") in (
             "Service", "Endpoints", "PersistentVolumeClaim", "Job", "CronJob",
             "PodDisruptionBudget", "Event", "ConfigMap", "Lease", "ReplicationController",
-            "ResourceQuota", "LimitRange"):
+            "ResourceQuota", "LimitRange", "Secret", "ServiceAccount",
+            "Role", "RoleBinding", "HorizontalPodAutoscaler"):
         meta.namespace = "default"
     return obj
+
+
+def merge_secret_string_data(obj) -> None:
+    """stringData is write-only convenience, merged into data as base64 on
+    BOTH create and update (ref: pkg/registry/core/secret strategy
+    PrepareForCreate AND PrepareForUpdate)."""
+    if getattr(obj, "string_data", None):
+        import base64
+        for k, v in obj.string_data.items():
+            obj.data[k] = base64.b64encode(v.encode()).decode()
+        obj.string_data = {}
 
 
 def service_cluster_ip(namespace: str, name: str, salt: int = 0) -> str:

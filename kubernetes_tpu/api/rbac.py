@@ -1,0 +1,80 @@
+"""rbac.authorization.k8s.io/v1 — Role/ClusterRole + bindings as API
+objects.
+
+Ref: staging/src/k8s.io/api/rbac/v1/types.go. These are the STORED policy
+objects the API server's RBACAuthorizer compiles its rule table from
+(apiserver/auth.py RBACAuthorizer.use_store) — the round-2 authorizer
+held config entries only; now `kubectl create -f role.json` changes live
+authorization exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .meta import LabelSelector, ObjectMeta
+
+
+@dataclass
+class RBACPolicyRule:
+    """Ref: rbac/v1 PolicyRule."""
+    verbs: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AggregationRule:
+    cluster_role_selectors: List[LabelSelector] = field(default_factory=list)
+
+
+@dataclass
+class Role:
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    kind: str = "Role"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[RBACPolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole:
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    kind: str = "ClusterRole"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[RBACPolicyRule] = field(default_factory=list)
+    aggregation_rule: Optional[AggregationRule] = None
+
+
+@dataclass
+class RoleRef:
+    api_group: str = "rbac.authorization.k8s.io"
+    kind: str = "Role"  # Role | ClusterRole
+    name: str = ""
+
+
+@dataclass
+class Subject:
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""
+    api_group: str = ""
+
+
+@dataclass
+class RoleBinding:
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    kind: str = "RoleBinding"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+@dataclass
+class ClusterRoleBinding:
+    api_version: str = "rbac.authorization.k8s.io/v1"
+    kind: str = "ClusterRoleBinding"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)

@@ -200,6 +200,40 @@ class ResourceQuotaAdmission:
             pass  # the controller's recalculation is the backstop
 
 
+# -------------------------------------------------------------- serviceaccount
+
+class ServiceAccountAdmission:
+    """Ref: plugin/pkg/admission/serviceaccount — default the pod's
+    serviceAccountName and require the account to exist (the mutating
+    half; token volume projection has no analog without a kubelet token
+    path)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def admit(self, operation: str, resource: str, obj: Any):
+        if operation == "CREATE" and resource == "pods" and \
+                not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+        return obj
+
+    def validate(self, operation: str, resource: str, obj: Any) -> None:
+        if operation != "CREATE" or resource != "pods":
+            return
+        ns = obj.metadata.namespace
+        name = obj.spec.service_account_name
+        if not ns or not name:
+            return
+        from ..state.store import NotFoundError
+        try:
+            self.client.service_accounts(ns).get(name)
+        except NotFoundError:
+            from .server import AdmissionDenied
+            raise AdmissionDenied(
+                f'pod rejected: service account {name!r} not found in '
+                f'namespace "{ns}"')
+
+
 # ----------------------------------------------------------------- limitranger
 
 class LimitRanger:

@@ -49,12 +49,18 @@ class TokenAuthenticator:
 @dataclass
 class PolicyRule:
     """Ref: rbac.PolicyRule — verbs x resources (+ optional namespace
-    scoping, the RoleBinding analog). '*' wildcards."""
+    scoping, the RoleBinding analog). '*' wildcards. Non-empty
+    resource_names restrict the rule to those objects — and, like the
+    reference, can then never match name-less requests (list/create)."""
     verbs: Tuple[str, ...]
     resources: Tuple[str, ...]
     namespaces: Tuple[str, ...] = ("*",)
+    resource_names: Tuple[str, ...] = ()
 
-    def matches(self, verb: str, resource: str, namespace: str) -> bool:
+    def matches(self, verb: str, resource: str, namespace: str,
+                name: str = "") -> bool:
+        if self.resource_names and name not in self.resource_names:
+            return False
         return (("*" in self.verbs or verb in self.verbs)
                 and ("*" in self.resources or resource in self.resources)
                 and ("*" in self.namespaces
@@ -63,11 +69,26 @@ class PolicyRule:
 
 class RBACAuthorizer:
     """Subject (user or group) -> rules; default deny (ref: rbac's
-    RuleResolver + the union authorizer's NoOpinion fallthrough)."""
+    RuleResolver + the union authorizer's NoOpinion fallthrough).
+
+    Two rule sources union together:
+      - static grants (the bootstrap/--token-file era shape), and
+      - STORED Role/ClusterRole (+Binding) objects once use_store() wires
+        a client — `kubectl create -f rolebinding.json` then changes live
+        authorization like the reference. The object table recompiles
+        lazily with a short TTL (the reference's authorizer caches too).
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._subject_rules: Dict[str, List[PolicyRule]] = {}
+        self._client = None
+        self._ttl = 1.0
+        self._compiled_at = 0.0
+        self._obj_rules: Dict[str, List[PolicyRule]] = {}
+        # compile runs OUTSIDE _lock (one compiler at a time); readers
+        # keep authorizing against the previous table meanwhile
+        self._compile_lock = threading.Lock()
 
     def grant(self, subject: str, verbs, resources,
               namespaces=("*",)) -> None:
@@ -76,14 +97,81 @@ class RBACAuthorizer:
         with self._lock:
             self._subject_rules.setdefault(subject, []).append(rule)
 
+    def use_store(self, client, ttl: float = 1.0) -> None:
+        """Compile rules from stored rbac/v1 objects via this client."""
+        with self._lock:
+            self._client = client
+            self._ttl = ttl
+            self._compiled_at = 0.0
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._compiled_at = 0.0
+
+    @staticmethod
+    def subject_key(subject) -> str:
+        """rbac/v1 Subject -> internal subject key."""
+        if subject.kind == "Group":
+            return f"group:{subject.name}"
+        if subject.kind == "ServiceAccount":
+            ns = subject.namespace or "default"
+            return f"system:serviceaccount:{ns}:{subject.name}"
+        return subject.name
+
+    def _maybe_recompile(self) -> None:
+        import time as _time
+        client = self._client
+        if client is None or \
+                _time.monotonic() - self._compiled_at < self._ttl:
+            return
+        if not self._compile_lock.acquire(blocking=False):
+            return  # another request is already compiling; use old table
+        try:
+            if _time.monotonic() - self._compiled_at < self._ttl:
+                return
+            roles = {(r.metadata.namespace, r.metadata.name): r
+                     for r in client.roles().list(namespace=None)}
+            cluster_roles = {r.metadata.name: r
+                             for r in client.cluster_roles().list()}
+            table: Dict[str, List[PolicyRule]] = {}
+
+            def add(binding, namespaces) -> None:
+                ref = binding.role_ref
+                if ref.kind == "ClusterRole":
+                    role = cluster_roles.get(ref.name)
+                else:
+                    role = roles.get((binding.metadata.namespace, ref.name))
+                if role is None:
+                    return  # dangling ref: grants nothing (default deny)
+                rules = [PolicyRule(tuple(r.verbs), tuple(r.resources),
+                                    tuple(namespaces),
+                                    tuple(r.resource_names))
+                         for r in role.rules]
+                for subj in binding.subjects:
+                    table.setdefault(self.subject_key(subj),
+                                     []).extend(rules)
+
+            for rb in client.role_bindings().list(namespace=None):
+                add(rb, (rb.metadata.namespace,))
+            for crb in client.cluster_role_bindings().list():
+                add(crb, ("*",))
+            with self._lock:
+                self._obj_rules = table
+                self._compiled_at = _time.monotonic()
+        finally:
+            self._compile_lock.release()
+
     def authorize(self, user: UserInfo, verb: str, resource: str,
-                  namespace: str) -> bool:
+                  namespace: str, name: str = "") -> bool:
+        self._maybe_recompile()
         with self._lock:
             subjects = [user.name] + [f"group:{g}" for g in user.groups]
             for s in subjects:
-                for rule in self._subject_rules.get(s, ()):
-                    if rule.matches(verb, resource, namespace):
-                        return True
+                for rules in (self._subject_rules.get(s, ()),
+                              self._obj_rules.get(s, ())):
+                    for rule in rules:
+                        if rule.matches(verb, resource, namespace, name):
+                            return True
         return False
 
 

@@ -79,11 +79,17 @@ class _Request:
 
 class APIServer:
     def __init__(self, store: Optional[Store] = None, scheme: Scheme = SCHEME,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 audit_log_path: Optional[str] = None):
         self.client = Client(store)
         self.store = self.client.store
         self.scheme = scheme
         self.admission = AdmissionChain()
+        #: structured audit trail (ref: apiserver/pkg/audit — the
+        #: ResponseComplete stage as one JSON line per request)
+        self._audit_file = open(audit_log_path, "a") \
+            if audit_log_path else None
+        self._audit_lock = threading.Lock()
         #: optional authn/authz (ref: DefaultBuildHandlerChain slots at
         #: config.go:543-557); None = open hub (the insecure port shape)
         self.authenticator = None
@@ -94,10 +100,14 @@ class APIServer:
         # default-enabled plugins (ref: kube-apiserver's default enabled
         # admission set includes LimitRanger and ResourceQuota; both no-op
         # in namespaces carrying no LimitRange/ResourceQuota objects)
-        from .admission import LimitRanger, ResourceQuotaAdmission
+        from .admission import (LimitRanger, ResourceQuotaAdmission,
+                                ServiceAccountAdmission)
         limitranger = LimitRanger(self.client)
         self.admission.mutators.append(limitranger.admit)
         self.admission.validators.append(limitranger.validate)
+        sa = ServiceAccountAdmission(self.client)
+        self.admission.mutators.append(sa.admit)
+        self.admission.validators.append(sa.validate)
         self.admission.validators.append(
             ResourceQuotaAdmission(self.client).validate)
         outer = self
@@ -139,6 +149,19 @@ class APIServer:
                     Namespace(metadata=ObjectMeta(name=name)))
             except AlreadyExistsError:
                 pass  # WAL replay already restored it
+            self._ensure_default_sa(name)
+
+    def _ensure_default_sa(self, namespace: str) -> None:
+        """Every namespace carries a "default" ServiceAccount (the
+        serviceaccounts controller's invariant; stamped server-side too so
+        pod admission never races namespace creation)."""
+        from ..api.core import ServiceAccount
+        from ..api.meta import ObjectMeta
+        try:
+            self.client.service_accounts(namespace).create(ServiceAccount(
+                metadata=ObjectMeta(name="default", namespace=namespace)))
+        except (AlreadyExistsError, NotFoundError):
+            pass
 
     def _register_existing_crds(self) -> None:
         """CRDs already in the store (handed-in store without WAL replay)
@@ -227,6 +250,10 @@ class APIServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._audit_file is not None:
+            with self._audit_lock:
+                self._audit_file.close()
+                self._audit_file = None
 
     # ------------------------------------------------------------- routing
 
@@ -258,6 +285,20 @@ class APIServer:
 
     def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
         try:
+            self._dispatch_inner(h, method)
+        finally:
+            # the ResponseComplete audit line fires after EVERY outcome,
+            # including the error mappings below (which set _audit_code)
+            ctx = getattr(h, "_audit_ctx", None)
+            if ctx is not None:
+                # consume the ctx: keep-alive reuses this handler for the
+                # next request, which must not replay this line
+                h._audit_ctx = None
+                self._audit(h, *ctx)
+
+    def _dispatch_inner(self, h: BaseHTTPRequestHandler,
+                        method: str) -> None:
+        try:
             url = urlparse(h.path)
             query = {k: v[0] for k, v in parse_qs(url.query).items()}
             if url.path in ("/healthz", "/readyz", "/livez"):
@@ -273,6 +314,7 @@ class APIServer:
                             f"unknown resource {req.resource}")
                 return
             ok, user = self._authorized(h, method, req)
+            h._audit_ctx = (method, req, user)
             if not ok:
                 return  # 401/403 already written
             self._handle(h, method, req, cls, user)
@@ -326,15 +368,17 @@ class APIServer:
                 # bulk) — authorizing it as a plain "bindings" create would
                 # let a role without pods/binding bind pods
                 resource = "pods/binding"
-            if not self._check_authz(h, user, verb, resource, req.namespace):
+            if not self._check_authz(h, user, verb, resource, req.namespace,
+                                     name=req.name):
                 return False, user
         return True, user
 
     def _check_authz(self, h, user, verb: str, resource: str,
-                     namespace: str) -> bool:
+                     namespace: str, name: str = "") -> bool:
         if self.authorizer is None or user is None:
             return True
-        if not self.authorizer.authorize(user, verb, resource, namespace):
+        if not self.authorizer.authorize(user, verb, resource, namespace,
+                                         name):
             self._error(
                 h, 403, "Forbidden",
                 f'user "{user.name}" cannot {verb} {resource}'
@@ -514,6 +558,8 @@ class APIServer:
                 self._respond(h, 201, out)
                 return
             out = rc.create(obj)
+            if req.resource == "namespaces":
+                self._ensure_default_sa(out.metadata.name)
             self._respond(h, 201, out)
         elif method == "PUT":
             data = self._read_body(h)
@@ -698,7 +744,38 @@ class APIServer:
         self._respond_raw(h, code, serde.to_json_str(obj).encode(),
                           "application/json")
 
+    def _audit(self, h, method: str, req: _Request, user) -> None:
+        """One ResponseComplete line per request (ref: audit.Event, level
+        Metadata — no request/response bodies)."""
+        if self._audit_file is None:
+            return  # cheap unlocked fast path; re-checked under the lock
+        from ..utils.clock import now_iso
+        from .auth import request_verb
+        line = json.dumps({
+            "stage": "ResponseComplete",
+            "timestamp": now_iso(),
+            "user": getattr(user, "name", "") or "system:unsecured",
+            "groups": list(getattr(user, "groups", ()) or ()),
+            "verb": request_verb(method, req.query.get("watch")
+                                 in ("true", "1"), bool(req.name)),
+            "resource": req.resource,
+            "subresource": req.subresource,
+            "namespace": req.namespace,
+            "name": req.name,
+            "code": getattr(h, "_audit_code", 200),
+            "sourceIP": h.client_address[0],
+        })
+        with self._audit_lock:
+            # the None check lives under the lock: stop() closes the file
+            # under the same lock, so an in-flight request cannot race a
+            # write onto a closed handle
+            if self._audit_file is None:
+                return
+            self._audit_file.write(line + "\n")
+            self._audit_file.flush()
+
     def _respond_raw(self, h, code: int, body: bytes, ctype: str) -> None:
+        h._audit_code = code
         h.send_response(code)
         h.send_header("Content-Type", ctype)
         h.send_header("Content-Length", str(len(body)))

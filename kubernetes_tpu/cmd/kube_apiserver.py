@@ -27,6 +27,8 @@ def main(argv=None) -> int:
     p.add_argument("--token-auth-file", default=None,
                    help="CSV token,user[,uid],group1;group2 — enables authn "
                         "(+ default-deny RBAC; system:masters gets all)")
+    p.add_argument("--audit-log-path", default=None,
+                   help="append one JSON audit line per request")
     args = p.parse_args(argv)
     store = None
     wal_file = None
@@ -38,7 +40,7 @@ def main(argv=None) -> int:
         wal_file = os.path.join(args.data_dir, "store.wal")
         store = Store(wal_path=wal_file, wal_sync=args.wal_sync)
     srv = APIServer(store=store, host=args.bind_address,
-                    port=args.port)
+                    port=args.port, audit_log_path=args.audit_log_path)
     if args.token_auth_file:
         from ..apiserver.auth import (RBACAuthorizer, TokenAuthenticator,
                                       UserInfo)
@@ -63,6 +65,8 @@ def main(argv=None) -> int:
         authz = RBACAuthorizer()
         # the bootstrap superuser binding (ref: system:masters)
         authz.grant("group:system:masters", ["*"], ["*"])
+        # stored Role/ClusterRole(+Binding) objects feed the live policy
+        authz.use_store(srv.client)
         srv.authenticator = authn
         srv.authorizer = authz
     srv.start()

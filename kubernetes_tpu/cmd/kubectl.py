@@ -40,6 +40,12 @@ def _resolve(resource: str, client=None):
         "crd": "customresourcedefinitions",
         "crds": "customresourcedefinitions",
         "quota": "resourcequotas", "limits": "limitranges",
+        "cm": "configmaps", "configmap": "configmaps",
+        "secret": "secrets", "sa": "serviceaccounts",
+        "serviceaccount": "serviceaccounts",
+        "role": "roles", "rolebinding": "rolebindings",
+        "clusterrole": "clusterroles",
+        "clusterrolebinding": "clusterrolebindings",
     }
     resource = aliases.get(resource, resource)
     cls = SCHEME.type_for_resource(resource)
@@ -336,6 +342,64 @@ def cmd_uncordon(args) -> int:
     return _set_unschedulable(args, False, "uncordoned")
 
 
+def cmd_rollout(args) -> int:
+    """kubectl rollout status|restart <deploy|sts|ds> <name>."""
+    resource, cls = _resolve(args.resource, _client(args))
+    rc = _client(args).resource(cls, args.namespace)
+    if args.action == "status":
+        if resource != "deployments":
+            print(f"error: rollout status supports deployments, "
+                  f"not {resource}", file=sys.stderr)
+            return 1
+        import time as _t
+        deadline = _t.time() + args.timeout
+        while True:
+            d = rc.get(args.name, namespace=args.namespace)
+            if (d.status.observed_generation >= d.metadata.generation
+                    and d.status.updated_replicas >= d.spec.replicas
+                    and d.status.available_replicas >= d.spec.replicas
+                    # no surplus old-template replicas still alive
+                    and d.status.replicas == d.status.updated_replicas):
+                print(f'deployment "{args.name}" successfully rolled out')
+                return 0
+            if _t.time() > deadline:
+                print(f'Waiting for deployment "{args.name}" rollout: '
+                      f'{d.status.available_replicas} of '
+                      f'{d.spec.replicas} updated replicas are available',
+                      file=sys.stderr)
+                return 1
+            _t.sleep(0.2)
+    elif args.action == "restart":
+        if resource not in ("deployments", "statefulsets", "daemonsets"):
+            print(f"error: rollout restart supports deployments/"
+                  f"statefulsets/daemonsets, not {resource}",
+                  file=sys.stderr)
+            return 1
+        # the reference stamps a restartedAt annotation into the pod
+        # template, rolling every pod through the update machinery
+        from datetime import datetime, timezone
+        stamp = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        rc.merge_patch(args.name, {"spec": {"template": {"metadata": {
+            "annotations": {
+                "kubectl.kubernetes.io/restartedAt": stamp}}}}},
+            namespace=args.namespace, strategic=False)
+        print(f"{resource[:-1]}.apps/{args.name} restarted")
+        return 0
+    print(f"error: unknown rollout action {args.action}", file=sys.stderr)
+    return 1
+
+
+def cmd_api_resources(args) -> int:
+    rows = []
+    for resource in sorted(SCHEME.resources()):
+        cls = SCHEME.type_for_resource(resource)
+        av, kind = SCHEME.gvk_for(cls)
+        rows.append([resource, av, str(SCHEME.is_namespaced(cls)).lower(),
+                     kind])
+    _print_table(rows, ["NAME", "APIVERSION", "NAMESPACED", "KIND"])
+    return 0
+
+
 def cmd_patch(args) -> int:
     """kubectl patch -p '{"spec": {...}}' [--type strategic|merge|json]."""
     _, cls = _resolve(args.resource, _client(args))
@@ -431,6 +495,16 @@ def main(argv=None) -> int:
         c = sub.add_parser(verb)
         c.add_argument("name")
         c.set_defaults(fn=fn)
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "restart"])
+    ro.add_argument("resource")  # deployment (the rollout-managed kind)
+    ro.add_argument("name")
+    ro.add_argument("--timeout", type=float, default=60.0)
+    ro.set_defaults(fn=cmd_rollout)
+
+    ar = sub.add_parser("api-resources")
+    ar.set_defaults(fn=cmd_api_resources)
 
     pa = sub.add_parser("patch")
     pa.add_argument("resource")

@@ -24,6 +24,7 @@ device round trip has nothing to amortize.
 """
 
 from .base import Controller
+from .clusterroleaggregation import ClusterRoleAggregationController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
@@ -33,16 +34,23 @@ from .garbagecollector import GarbageCollector
 from .job import JobController
 from .manager import ControllerManager
 from .namespace import NamespaceController
+from .nodeipam import NodeIpamController
 from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import (HorizontalController, MetricsClient,
                             StaticMetrics)
 from .podgc import PodGCController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
+from .volumeprotection import (PVCProtectionController,
+                               PVProtectionController)
 from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
-__all__ = ["Controller", "ControllerManager", "CronJobController",
+__all__ = ["Controller", "ControllerManager",
+           "ClusterRoleAggregationController", "CronJobController",
+           "NodeIpamController", "PVCProtectionController",
+           "PVProtectionController", "ServiceAccountController",
            "DaemonSetController", "DeploymentController",
            "DisruptionController", "EndpointsController",
            "GarbageCollector", "HorizontalController", "JobController",

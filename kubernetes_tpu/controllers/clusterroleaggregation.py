@@ -1,0 +1,72 @@
+"""ClusterRole aggregation controller.
+
+Ref: pkg/controller/clusterroleaggregation/clusterroleaggregation_controller.go
+— a ClusterRole carrying an aggregationRule gets its .rules overwritten
+with the union of every ClusterRole matching any of the rule's label
+selectors (how the reference composes admin/edit/view from feature
+roles).
+"""
+
+from __future__ import annotations
+
+from ..api import labels as labelsmod
+from ..api.rbac import ClusterRole
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import NotFoundError
+from .base import Controller
+
+
+def _rule_key(r):
+    return (tuple(r.verbs), tuple(r.api_groups), tuple(r.resources),
+            tuple(r.resource_names))
+
+
+class ClusterRoleAggregationController(Controller):
+    name = "clusterrole-aggregation"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.cr_informer = informers.informer_for(ClusterRole)
+        self.cr_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_change,
+            on_update=lambda old, new: self._on_change(new),
+            on_delete=self._on_change))
+
+    def _on_change(self, role: ClusterRole) -> None:
+        # any ClusterRole change may feed any aggregated role: enqueue all
+        # aggregating roles (the reference does the same full re-sync)
+        for cr in self.cr_informer.indexer.list(None):
+            if cr.aggregation_rule is not None:
+                self.enqueue(cr.metadata.name)
+
+    def sync(self, key: str) -> None:
+        role = self.cr_informer.indexer.get_by_key(key)
+        if role is None or role.aggregation_rule is None:
+            return
+        selectors = role.aggregation_rule.cluster_role_selectors
+        rules, seen = [], set()
+        for cr in sorted(self.cr_informer.indexer.list(None),
+                         key=lambda c: c.metadata.name):
+            if cr.metadata.name == role.metadata.name:
+                continue
+            if not any(labelsmod.matches(sel, cr.metadata.labels)
+                       for sel in selectors):
+                continue
+            for r in cr.rules:
+                k = _rule_key(r)
+                if k not in seen:
+                    seen.add(k)
+                    rules.append(r)
+        if [_rule_key(r) for r in role.rules] == \
+                [_rule_key(r) for r in rules]:
+            return
+
+        def mutate(cur):
+            cur.rules = rules
+            return cur
+        try:
+            self.client.cluster_roles().patch(role.metadata.name, mutate)
+        except NotFoundError:
+            pass

@@ -22,8 +22,13 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import HorizontalController, MetricsClient
 from .podgc import PodGCController
+from .clusterroleaggregation import ClusterRoleAggregationController
+from .nodeipam import NodeIpamController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
+from .volumeprotection import (PVCProtectionController,
+                               PVProtectionController)
 from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
@@ -65,6 +70,12 @@ class ControllerManager:
         self.resourcequota = ResourceQuotaController(client, self.informers)
         self.podautoscaler = HorizontalController(
             client, self.informers, metrics=metrics_client)
+        self.serviceaccount = ServiceAccountController(client, self.informers)
+        self.clusterrole_aggregation = ClusterRoleAggregationController(
+            client, self.informers)
+        self.nodeipam = NodeIpamController(client, self.informers)
+        self.pvc_protection = PVCProtectionController(client, self.informers)
+        self.pv_protection = PVProtectionController(client, self.informers)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -75,7 +86,9 @@ class ControllerManager:
             self.daemonset, self.cronjob, self.endpoints,
             self.namespace, self.pv_binder, self.nodelifecycle,
             self.garbagecollector, self.podgc, self.disruption,
-            self.resourcequota, self.podautoscaler]
+            self.resourcequota, self.podautoscaler, self.serviceaccount,
+            self.clusterrole_aggregation, self.nodeipam,
+            self.pvc_protection, self.pv_protection]
 
     def start(self) -> None:
         self.informers.start()

@@ -1,0 +1,83 @@
+"""Node IPAM controller — allocates spec.podCIDR per node from the
+cluster CIDR.
+
+Ref: pkg/controller/nodeipam/ipam/range_allocator.go (AllocateOrOccupyCIDR)
+reduced to the single-range /24-per-node allocator.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+
+from ..api.core import Node
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import NotFoundError
+from .base import Controller
+
+
+class NodeIpamController(Controller):
+    name = "nodeipam"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 cluster_cidr: str = "10.244.0.0/16",
+                 node_cidr_mask: int = 24, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.node_informer = informers.informer_for(Node)
+        self._net = ipaddress.ip_network(cluster_cidr)
+        self._mask = node_cidr_mask
+        self._alloc_lock = threading.Lock()
+        self._used: set = set()
+        self._cursor = 0
+        self._n_subnets = 2 ** (node_cidr_mask - self._net.prefixlen)
+        self.node_informer.add_event_handlers(EventHandlers(
+            on_add=lambda n: self.enqueue(n.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name),
+            on_delete=self._release))
+
+    def _release(self, node: Node) -> None:
+        if node.spec.pod_cidr:
+            with self._alloc_lock:
+                self._used.discard(node.spec.pod_cidr)
+
+    def _subnet_at(self, i: int) -> str:
+        base = int(self._net.network_address)
+        step = 1 << (32 - self._mask)
+        return str(ipaddress.ip_network(
+            (base + i * step, self._mask)))
+
+    def _next_cidr(self) -> str:
+        with self._alloc_lock:
+            for _ in range(self._n_subnets):
+                s = self._subnet_at(self._cursor % self._n_subnets)
+                self._cursor += 1
+                if s not in self._used:
+                    self._used.add(s)
+                    return s
+        raise RuntimeError("cluster CIDR exhausted")
+
+    def sync(self, key: str) -> None:
+        node = self.node_informer.indexer.get_by_key(key)
+        if node is None:
+            return
+        if node.spec.pod_cidr:
+            with self._alloc_lock:
+                self._used.add(node.spec.pod_cidr)
+            return
+        cidr = self._next_cidr()
+
+        def mutate(cur):
+            if not cur.spec.pod_cidr:
+                cur.spec.pod_cidr = cidr
+            return cur
+        try:
+            out = self.client.nodes().patch(key, mutate)
+            if out.spec.pod_cidr != cidr:  # raced another allocation
+                self._release_cidr(cidr)
+        except NotFoundError:
+            self._release_cidr(cidr)
+
+    def _release_cidr(self, cidr: str) -> None:
+        with self._alloc_lock:
+            self._used.discard(cidr)

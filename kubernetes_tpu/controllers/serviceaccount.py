@@ -1,0 +1,48 @@
+"""ServiceAccount controller — every active namespace owns a "default"
+ServiceAccount, recreated when deleted.
+
+Ref: pkg/controller/serviceaccount/serviceaccounts_controller.go
+(NewServiceAccountsController with DefaultServiceAccountsControllerOptions
+-> one managed account named "default").
+"""
+
+from __future__ import annotations
+
+from ..api.core import Namespace, ServiceAccount
+from ..api.meta import ObjectMeta
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import AlreadyExistsError, NotFoundError
+from .base import Controller
+
+
+class ServiceAccountController(Controller):
+    name = "serviceaccount"
+
+    MANAGED = ("default",)
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.ns_informer = informers.informer_for(Namespace)
+        self.sa_informer = informers.informer_for(ServiceAccount)
+        self.ns_informer.add_event_handlers(EventHandlers(
+            on_add=lambda ns: self.enqueue(ns.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name)))
+        self.sa_informer.add_event_handlers(EventHandlers(
+            on_delete=lambda sa: self.enqueue(sa.metadata.namespace)))
+
+    def sync(self, key: str) -> None:
+        ns = self.ns_informer.indexer.get_by_key(key)
+        if ns is None or ns.metadata.deletion_timestamp is not None or \
+                ns.status.phase == "Terminating":
+            return
+        for name in self.MANAGED:
+            try:
+                self.client.service_accounts(key).get(name)
+            except NotFoundError:
+                try:
+                    self.client.service_accounts(key).create(ServiceAccount(
+                        metadata=ObjectMeta(name=name, namespace=key)))
+                except (AlreadyExistsError, NotFoundError):
+                    pass

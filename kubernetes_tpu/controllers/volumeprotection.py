@@ -1,0 +1,123 @@
+"""PVC/PV protection controllers — finalizers that keep in-use volumes
+from vanishing under their consumers.
+
+Ref: pkg/controller/volume/pvcprotection/pvc_protection_controller.go and
+pvprotection/pv_protection_controller.go: the finalizer is stamped on
+every (non-deleting) object; when deletion is requested the finalizer is
+removed only once nothing uses the volume — a PVC with a running pod, or
+a PV still Bound, lingers in Terminating until released.
+"""
+
+from __future__ import annotations
+
+from ..api.core import PersistentVolume, PersistentVolumeClaim, Pod
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import ConflictError, NotFoundError
+from .base import Controller
+
+PVC_FINALIZER = "kubernetes.io/pvc-protection"
+PV_FINALIZER = "kubernetes.io/pv-protection"
+
+
+class PVCProtectionController(Controller):
+    name = "pvc-protection"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pvc_informer = informers.informer_for(PersistentVolumeClaim)
+        self.pod_informer = informers.informer_for(Pod)
+        self.pvc_informer.add_event_handlers(EventHandlers(
+            on_add=lambda c: self.enqueue(c.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key())))
+        # a pod finishing/disappearing may unblock a Terminating PVC
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_update=lambda old, new: self._on_pod(new),
+            on_delete=self._on_pod))
+
+    def _on_pod(self, pod: Pod) -> None:
+        for v in pod.spec.volumes:
+            if v.persistent_volume_claim is not None:
+                self.enqueue(f"{pod.metadata.namespace}/"
+                             f"{v.persistent_volume_claim.claim_name}")
+
+    def _in_use(self, pvc) -> bool:
+        """Ref: isBeingUsed — any non-terminal pod in the namespace
+        mounting this claim."""
+        for pod in self.pod_informer.indexer.list(pvc.metadata.namespace):
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            for v in pod.spec.volumes:
+                if v.persistent_volume_claim is not None and \
+                        v.persistent_volume_claim.claim_name == \
+                        pvc.metadata.name:
+                    return True
+        return False
+
+    def sync(self, key: str) -> None:
+        pvc = self.pvc_informer.indexer.get_by_key(key)
+        if pvc is None:
+            return
+        ns, name = key.split("/", 1)
+        rc = self.client.persistent_volume_claims(ns)
+        if pvc.metadata.deletion_timestamp is None:
+            if PVC_FINALIZER not in pvc.metadata.finalizers:
+                def add(cur):
+                    if cur.metadata.deletion_timestamp is None and \
+                            PVC_FINALIZER not in cur.metadata.finalizers:
+                        cur.metadata.finalizers.append(PVC_FINALIZER)
+                    return cur
+                self._patch(rc, name, add)
+            return
+        if PVC_FINALIZER in pvc.metadata.finalizers and \
+                not self._in_use(pvc):
+            def remove(cur):
+                cur.metadata.finalizers = [
+                    f for f in cur.metadata.finalizers
+                    if f != PVC_FINALIZER]
+                return cur
+            self._patch(rc, name, remove)
+
+    @staticmethod
+    def _patch(rc, name, mutate) -> None:
+        try:
+            rc.patch(name, mutate)
+        except (NotFoundError, ConflictError):
+            pass  # gone or raced; the next event re-syncs
+
+
+class PVProtectionController(Controller):
+    name = "pv-protection"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pv_informer = informers.informer_for(PersistentVolume)
+        self.pv_informer.add_event_handlers(EventHandlers(
+            on_add=lambda v: self.enqueue(v.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name)))
+
+    def sync(self, key: str) -> None:
+        pv = self.pv_informer.indexer.get_by_key(key)
+        if pv is None:
+            return
+        rc = self.client.persistent_volumes()
+        if pv.metadata.deletion_timestamp is None:
+            if PV_FINALIZER not in pv.metadata.finalizers:
+                def add(cur):
+                    if cur.metadata.deletion_timestamp is None and \
+                            PV_FINALIZER not in cur.metadata.finalizers:
+                        cur.metadata.finalizers.append(PV_FINALIZER)
+                    return cur
+                PVCProtectionController._patch(rc, key, add)
+            return
+        # deleting: release once the volume is no longer Bound to a claim
+        if PV_FINALIZER in pv.metadata.finalizers and \
+                pv.status.phase != "Bound":
+            def remove(cur):
+                cur.metadata.finalizers = [
+                    f for f in cur.metadata.finalizers if f != PV_FINALIZER]
+                return cur
+            PVCProtectionController._patch(rc, key, remove)

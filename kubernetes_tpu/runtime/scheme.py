@@ -13,9 +13,11 @@ from typing import Any, Dict, Optional, Tuple, Type
 from ..api import serde
 from ..api.apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
 from ..api.batch import CronJob, Job
-from ..api.core import (Binding, Endpoints, Event, LimitRange, Namespace,
-                        Node, PersistentVolume, PersistentVolumeClaim, Pod,
-                        ReplicationController, ResourceQuota, Service)
+from ..api.core import (Binding, ConfigMap, Endpoints, Event, LimitRange,
+                        Namespace, Node, PersistentVolume,
+                        PersistentVolumeClaim, Pod, ReplicationController,
+                        ResourceQuota, Secret, Service, ServiceAccount)
+from ..api.rbac import (ClusterRole, ClusterRoleBinding, Role, RoleBinding)
 from ..api.policy import Lease, PodDisruptionBudget, PriorityClass, StorageClass
 
 
@@ -108,6 +110,17 @@ def default_scheme() -> Scheme:
                "replicationcontrollers")
     s.register(ResourceQuota, "v1", "ResourceQuota", "resourcequotas")
     s.register(LimitRange, "v1", "LimitRange", "limitranges")
+    s.register(ConfigMap, "v1", "ConfigMap", "configmaps")
+    s.register(Secret, "v1", "Secret", "secrets")
+    s.register(ServiceAccount, "v1", "ServiceAccount", "serviceaccounts")
+    s.register(Role, "rbac.authorization.k8s.io/v1", "Role", "roles")
+    s.register(ClusterRole, "rbac.authorization.k8s.io/v1", "ClusterRole",
+               "clusterroles", namespaced=False)
+    s.register(RoleBinding, "rbac.authorization.k8s.io/v1", "RoleBinding",
+               "rolebindings")
+    s.register(ClusterRoleBinding, "rbac.authorization.k8s.io/v1",
+               "ClusterRoleBinding", "clusterrolebindings",
+               namespaced=False)
     s.register(Deployment, "apps/v1", "Deployment", "deployments")
     s.register(ReplicaSet, "apps/v1", "ReplicaSet", "replicasets")
     s.register(StatefulSet, "apps/v1", "StatefulSet", "statefulsets")

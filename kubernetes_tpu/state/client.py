@@ -80,6 +80,10 @@ class ResourceClient:
         return items
 
     def update(self, obj):
+        if isinstance(obj, corev1.Secret):
+            obj = serde.deepcopy_obj(obj)
+            from ..api.defaults import merge_secret_string_data
+            merge_secret_string_data(obj)
         if self._validate:
             validate_obj(obj)
         return self._store.update(self._resource, serde.deepcopy_obj(obj))
@@ -132,6 +136,9 @@ class ResourceClient:
             if subresource == "status":
                 cur.status = obj.status
                 return cur
+            if isinstance(obj, corev1.Secret):
+                from ..api.defaults import merge_secret_string_data
+                merge_secret_string_data(obj)
             if self._validate:
                 validate_obj(obj)
             return obj
@@ -320,3 +327,35 @@ class Client:
     def limit_ranges(self, namespace: Optional[str] = None) -> ResourceClient:
         from ..api.core import LimitRange
         return self.resource(LimitRange, namespace)
+
+    def config_maps(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.core import ConfigMap
+        return self.resource(ConfigMap, namespace)
+
+    def secrets(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.core import Secret
+        return self.resource(Secret, namespace)
+
+    def service_accounts(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.core import ServiceAccount
+        return self.resource(ServiceAccount, namespace)
+
+    def roles(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.rbac import Role
+        return self.resource(Role, namespace)
+
+    def cluster_roles(self) -> ResourceClient:
+        from ..api.rbac import ClusterRole
+        return self.resource(ClusterRole)
+
+    def role_bindings(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.rbac import RoleBinding
+        return self.resource(RoleBinding, namespace)
+
+    def cluster_role_bindings(self) -> ResourceClient:
+        from ..api.rbac import ClusterRoleBinding
+        return self.resource(ClusterRoleBinding)
+
+    def horizontal_pod_autoscalers(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.autoscaling import HorizontalPodAutoscaler
+        return self.resource(HorizontalPodAutoscaler, namespace)

@@ -1,0 +1,357 @@
+"""ConfigMap/Secret/ServiceAccount, RBAC-as-objects, the new controllers
+(serviceaccount, clusterrole aggregation, nodeipam, volume protection),
+the audit trail, and kubectl rollout.
+
+Modeled on pkg/registry/core/{secret,serviceaccount} strategy tests,
+plugin/pkg/auth/authorizer/rbac tests, and
+pkg/controller/{serviceaccount,clusterroleaggregation,nodeipam} tests.
+"""
+
+import base64
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.apiserver.auth import (RBACAuthorizer,
+                                           TokenAuthenticator, UserInfo)
+from kubernetes_tpu.state import Client, SharedInformerFactory
+
+
+def make_pod(name, ns="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+class TestConfigAndIdentityTypes:
+    def test_secret_string_data_merged_base64(self, server):
+        client = HTTPClient(server.address)
+        out = client.secrets("default").create(api.Secret(
+            metadata=api.ObjectMeta(name="creds", namespace="default"),
+            string_data={"password": "hunter2"}))
+        assert out.string_data == {}
+        assert base64.b64decode(out.data["password"]).decode() == "hunter2"
+
+    def test_secret_string_data_merged_on_update_too(self, server):
+        client = HTTPClient(server.address)
+        client.secrets("default").create(api.Secret(
+            metadata=api.ObjectMeta(name="s", namespace="default")))
+        live = client.secrets("default").get("s")
+        live.string_data = {"token": "abc"}
+        out = client.secrets("default").update(live)
+        assert out.string_data == {}
+        assert base64.b64decode(out.data["token"]).decode() == "abc"
+
+    def test_configmap_roundtrip(self, server):
+        client = HTTPClient(server.address)
+        client.config_maps("default").create(api.ConfigMap(
+            metadata=api.ObjectMeta(name="cfg", namespace="default"),
+            data={"key": "value"}))
+        assert client.config_maps("default").get("cfg").data == {
+            "key": "value"}
+
+    def test_default_service_account_bootstrapped(self, server):
+        client = HTTPClient(server.address)
+        assert client.service_accounts("default").get("default")
+        client.namespaces().create(api.Namespace(
+            metadata=api.ObjectMeta(name="fresh")))
+        assert client.service_accounts("fresh").get("default")
+
+    def test_pod_gets_default_service_account(self, server):
+        client = HTTPClient(server.address)
+        out = client.pods("default").create(make_pod("p"))
+        assert out.spec.service_account_name == "default"
+
+    def test_pod_with_missing_sa_rejected(self, server):
+        client = HTTPClient(server.address)
+        pod = make_pod("p")
+        pod.spec.service_account_name = "nope"
+        with pytest.raises(RuntimeError, match="service account"):
+            client.pods("default").create(pod)
+
+
+class TestRBACObjects:
+    def _secured_server(self):
+        srv = APIServer().start()
+        authn = TokenAuthenticator()
+        authn.add("admin-token", UserInfo("admin", ("system:masters",)))
+        authn.add("dev-token", UserInfo("dev", ("devs",)))
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        authz.use_store(srv.client, ttl=0.0)  # recompile every authorize
+        srv.authenticator = authn
+        srv.authorizer = authz
+        return srv
+
+    def test_stored_role_binding_grants_access(self):
+        srv = self._secured_server()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            dev = HTTPClient(srv.address, token="dev-token")
+            with pytest.raises(PermissionError):
+                dev.pods("default").list()
+            admin.roles("default").create(api.Role(
+                metadata=api.ObjectMeta(name="pod-reader",
+                                        namespace="default"),
+                rules=[api.RBACPolicyRule(verbs=["get", "list"],
+                                          resources=["pods"])]))
+            admin.role_bindings("default").create(api.RoleBinding(
+                metadata=api.ObjectMeta(name="dev-reads",
+                                        namespace="default"),
+                subjects=[api.Subject(kind="Group", name="devs")],
+                role_ref=api.RoleRef(kind="Role", name="pod-reader")))
+            assert dev.pods("default").list() == []
+            # namespace scoping: only where the binding lives
+            admin.namespaces().create(api.Namespace(
+                metadata=api.ObjectMeta(name="other")))
+            with pytest.raises(PermissionError):
+                dev.pods("other").list()
+            # writes stay denied
+            with pytest.raises(PermissionError):
+                dev.pods("default").create(make_pod("x"))
+            # removing the binding revokes
+            admin.role_bindings("default").delete("dev-reads")
+            with pytest.raises(PermissionError):
+                dev.pods("default").list()
+        finally:
+            srv.stop()
+
+    def test_resource_names_scope_enforced(self):
+        """A rule with resourceNames grants ONLY those objects — and never
+        name-less verbs like list (the reference's semantics)."""
+        srv = self._secured_server()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            dev = HTTPClient(srv.address, token="dev-token")
+            admin.secrets("default").create(api.Secret(
+                metadata=api.ObjectMeta(name="mine", namespace="default"),
+                string_data={"k": "v"}))
+            admin.secrets("default").create(api.Secret(
+                metadata=api.ObjectMeta(name="other",
+                                        namespace="default"),
+                string_data={"k": "v"}))
+            admin.roles("default").create(api.Role(
+                metadata=api.ObjectMeta(name="one-secret",
+                                        namespace="default"),
+                rules=[api.RBACPolicyRule(
+                    verbs=["get", "list"], resources=["secrets"],
+                    resource_names=["mine"])]))
+            admin.role_bindings("default").create(api.RoleBinding(
+                metadata=api.ObjectMeta(name="b", namespace="default"),
+                subjects=[api.Subject(kind="User", name="dev")],
+                role_ref=api.RoleRef(kind="Role", name="one-secret")))
+            assert dev.secrets("default").get("mine")
+            with pytest.raises(PermissionError):
+                dev.secrets("default").get("other")
+            with pytest.raises(PermissionError):
+                dev.secrets("default").list()  # name-less: never matches
+        finally:
+            srv.stop()
+
+    def test_cluster_role_binding_spans_namespaces(self):
+        srv = self._secured_server()
+        try:
+            admin = HTTPClient(srv.address, token="admin-token")
+            dev = HTTPClient(srv.address, token="dev-token")
+            admin.cluster_roles().create(api.ClusterRole(
+                metadata=api.ObjectMeta(name="node-viewer"),
+                rules=[api.RBACPolicyRule(verbs=["list"],
+                                          resources=["nodes"])]))
+            admin.cluster_role_bindings().create(api.ClusterRoleBinding(
+                metadata=api.ObjectMeta(name="devs-view-nodes"),
+                subjects=[api.Subject(kind="User", name="dev")],
+                role_ref=api.RoleRef(kind="ClusterRole",
+                                     name="node-viewer")))
+            assert dev.nodes().list() == []
+        finally:
+            srv.stop()
+
+
+class TestNewControllers:
+    def _stack(self):
+        client = Client()
+        informers = SharedInformerFactory(client)
+        return client, informers
+
+    def test_serviceaccount_controller_recreates_default(self):
+        from kubernetes_tpu.controllers.serviceaccount import \
+            ServiceAccountController
+        client, informers = self._stack()
+        client.namespaces().create(api.Namespace(
+            metadata=api.ObjectMeta(name="team")))
+        sac = ServiceAccountController(client, informers)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            sac.sync("team")
+            assert client.service_accounts("team").get("default")
+        finally:
+            informers.stop()
+
+    def test_clusterrole_aggregation(self):
+        from kubernetes_tpu.controllers.clusterroleaggregation import \
+            ClusterRoleAggregationController
+        client, informers = self._stack()
+        client.cluster_roles().create(api.ClusterRole(
+            metadata=api.ObjectMeta(
+                name="feature-a", labels={"aggregate-to-admin": "true"}),
+            rules=[api.RBACPolicyRule(verbs=["get"],
+                                      resources=["widgets"])]))
+        client.cluster_roles().create(api.ClusterRole(
+            metadata=api.ObjectMeta(
+                name="feature-b", labels={"aggregate-to-admin": "true"}),
+            rules=[api.RBACPolicyRule(verbs=["list"],
+                                      resources=["gadgets"])]))
+        client.cluster_roles().create(api.ClusterRole(
+            metadata=api.ObjectMeta(name="admin"),
+            aggregation_rule=api.AggregationRule(
+                cluster_role_selectors=[api.LabelSelector(
+                    match_labels={"aggregate-to-admin": "true"})])))
+        ctrl = ClusterRoleAggregationController(client, informers)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            ctrl.sync("admin")
+            live = client.cluster_roles().get("admin")
+            got = {(tuple(r.verbs), tuple(r.resources))
+                   for r in live.rules}
+            assert got == {(("get",), ("widgets",)),
+                           (("list",), ("gadgets",))}
+        finally:
+            informers.stop()
+
+    def test_nodeipam_allocates_disjoint_cidrs(self):
+        from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+        client, informers = self._stack()
+        for i in range(3):
+            client.nodes().create(api.Node(
+                metadata=api.ObjectMeta(name=f"n{i}")))
+        ctrl = NodeIpamController(client, informers)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            for i in range(3):
+                ctrl.sync(f"n{i}")
+            cidrs = [client.nodes().get(f"n{i}").spec.pod_cidr
+                     for i in range(3)]
+            assert all(c.endswith("/24") for c in cidrs)
+            assert len(set(cidrs)) == 3
+        finally:
+            informers.stop()
+
+    def test_pvc_protection_blocks_in_use_delete(self):
+        from kubernetes_tpu.controllers.volumeprotection import (
+            PVC_FINALIZER, PVCProtectionController)
+        client, informers = self._stack()
+        pvc = client.persistent_volume_claims("default").create(
+            api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name="data", namespace="default")))
+        pod = make_pod("user")
+        pod.spec.volumes = [api.Volume(
+            name="v", persistent_volume_claim=
+            api.PersistentVolumeClaimVolumeSource(claim_name="data"))]
+        pod.status.phase = "Running"
+        client.pods("default").create(pod)
+        ctrl = PVCProtectionController(client, informers)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            ctrl.sync("default/data")
+            live = client.persistent_volume_claims("default").get("data")
+            assert PVC_FINALIZER in live.metadata.finalizers
+            # delete while in use: lingers Terminating
+            client.persistent_volume_claims("default").delete("data")
+            live = client.persistent_volume_claims("default").get("data")
+            assert live.metadata.deletion_timestamp is not None
+            ctrl.sync("default/data")  # still in use: finalizer stays
+            assert PVC_FINALIZER in client.persistent_volume_claims(
+                "default").get("data").metadata.finalizers
+            # consumer finishes -> finalizer removed -> object gone
+            client.pods("default").delete("user")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if not ctrl.pod_informer.indexer.list("default"):
+                    break
+                time.sleep(0.02)
+            ctrl.sync("default/data")
+            from kubernetes_tpu.state.store import NotFoundError
+            with pytest.raises(NotFoundError):
+                client.persistent_volume_claims("default").get("data")
+        finally:
+            informers.stop()
+
+
+class TestAudit:
+    def test_audit_trail_written(self, tmp_path):
+        path = str(tmp_path / "audit.log")
+        srv = APIServer(audit_log_path=path).start()
+        try:
+            client = HTTPClient(srv.address)
+            client.pods("default").create(make_pod("p"))
+            client.pods("default").get("p")
+            try:
+                client.pods("default").get("ghost")
+            except Exception:
+                pass
+        finally:
+            srv.stop()
+        lines = [json.loads(l) for l in open(path) if l.strip()]
+        by = {(e["verb"], e["name"], e["code"]) for e in lines}
+        assert ("create", "", 201) in by
+        assert ("get", "p", 200) in by
+        assert ("get", "ghost", 404) in by
+        assert all(e["stage"] == "ResponseComplete" for e in lines)
+
+
+class TestKubectlRollout:
+    def test_rollout_status_and_restart(self, server):
+        from kubernetes_tpu.cmd import kubectl
+        from kubernetes_tpu.controllers import ControllerManager
+        client = HTTPClient(server.address)
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.deployments("default").create(api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_labels={"a": "w"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"a": "w"}),
+                        spec=api.PodSpec(containers=[api.Container(
+                            name="c", image="i")])))))
+            # mark pods ready so the rollout can complete (no kubelet here)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                pods = client.pods("default").list()
+                if len(pods) >= 2:
+                    break
+                time.sleep(0.1)
+            for p in client.pods("default").list():
+                p.status.phase = "Running"
+                p.status.conditions = [api.PodCondition(type="Ready",
+                                                        status="True")]
+                client.pods("default").update_status(p)
+            assert kubectl.main(["-s", server.address, "rollout",
+                                 "status", "deployment", "web",
+                                 "--timeout", "20"]) == 0
+            assert kubectl.main(["-s", server.address, "rollout",
+                                 "restart", "deployment", "web"]) == 0
+            live = client.deployments("default").get("web")
+            assert "kubectl.kubernetes.io/restartedAt" in \
+                live.spec.template.metadata.annotations
+            assert kubectl.main(["-s", server.address,
+                                 "api-resources"]) == 0
+        finally:
+            mgr.stop()
