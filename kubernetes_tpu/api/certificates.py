@@ -1,0 +1,65 @@
+"""certificates.k8s.io/v1 — CertificateSigningRequest.
+
+Ref: staging/src/k8s.io/api/certificates/v1/types.go. The CSR flow:
+a client posts spec.request (base64 PEM CSR), the approval controller
+adds an Approved condition, the signing controller fills
+status.certificate from the cluster CA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .meta import ObjectMeta
+
+SIGNER_KUBELET_CLIENT = "kubernetes.io/kube-apiserver-client-kubelet"
+SIGNER_KUBELET_SERVING = "kubernetes.io/kubelet-serving"
+SIGNER_CLIENT = "kubernetes.io/kube-apiserver-client"
+
+
+@dataclass
+class CertificateSigningRequestSpec:
+    request: str = ""  # base64 PEM CSR
+    signer_name: str = SIGNER_CLIENT
+    usages: List[str] = field(default_factory=list)
+    username: str = ""
+    groups: List[str] = field(default_factory=list)
+    expiration_seconds: Optional[int] = None
+
+
+@dataclass
+class CertificateSigningRequestCondition:
+    type: str = ""  # Approved | Denied | Failed
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[str] = None
+
+
+@dataclass
+class CertificateSigningRequestStatus:
+    conditions: List[CertificateSigningRequestCondition] = \
+        field(default_factory=list)
+    certificate: str = ""  # base64 PEM chain once signed
+
+
+@dataclass
+class CertificateSigningRequest:
+    api_version: str = "certificates.k8s.io/v1"
+    kind: str = "CertificateSigningRequest"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CertificateSigningRequestSpec = field(
+        default_factory=CertificateSigningRequestSpec)
+    status: CertificateSigningRequestStatus = field(
+        default_factory=CertificateSigningRequestStatus)
+
+
+def is_approved(csr: CertificateSigningRequest) -> bool:
+    return any(c.type == "Approved" and c.status == "True"
+               for c in csr.status.conditions)
+
+
+def is_denied(csr: CertificateSigningRequest) -> bool:
+    return any(c.type == "Denied" and c.status == "True"
+               for c in csr.status.conditions)

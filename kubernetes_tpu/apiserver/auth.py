@@ -175,6 +175,34 @@ class RBACAuthorizer:
         return False
 
 
+class CertAuthenticator:
+    """x509 client-certificate authentication: the TLS layer verified the
+    chain against the client CA; this maps subject CN -> user and O ->
+    groups (ref: authentication/request/x509 CommonNameUserConversion).
+    Composes with a TokenAuthenticator fallback for bearer clients."""
+
+    def __init__(self, fallback=None):
+        self.fallback = fallback
+
+    def authenticate_cert(self, der_cert: bytes) -> Optional[UserInfo]:
+        import ssl
+
+        from ..utils import certs as certutil
+        try:
+            pem = ssl.DER_cert_to_PEM_cert(der_cert).encode()
+            cn, orgs = certutil.subject_of(pem)
+        except Exception:
+            return None
+        if not cn:
+            return None
+        return UserInfo(cn, orgs)
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        if self.fallback is not None:
+            return self.fallback.authenticate(authorization_header)
+        return ANONYMOUS if not authorization_header else None
+
+
 #: HTTP method -> RBAC verb (ref: endpoints/request RequestInfo verbs)
 VERB_OF = {"GET": "get", "POST": "create", "PUT": "update",
            "DELETE": "delete", "PATCH": "patch"}

@@ -97,7 +97,8 @@ class _HTTPWatch:
 class HTTPResourceClient:
     def __init__(self, base_url: str, scheme: Scheme, cls: Type,
                  namespace: Optional[str] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, ssl_context=None):
+        self._ssl = ssl_context
         self._base = base_url.rstrip("/")
         self._scheme = scheme
         self._cls = cls
@@ -145,7 +146,7 @@ class HTTPResourceClient:
         req = urlrequest.Request(url, data=data, method=method,
                                  headers=headers)
         try:
-            with urlrequest.urlopen(req) as resp:
+            with urlrequest.urlopen(req, context=self._ssl) as resp:
                 return json.loads(resp.read())
         except urlerror.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"))
@@ -276,7 +277,7 @@ class HTTPResourceClient:
         url = self._url(namespace=ns or "", query=query)
         req = urlrequest.Request(url, headers=self._headers())
         try:
-            resp = urlrequest.urlopen(req)
+            resp = urlrequest.urlopen(req, context=self._ssl)
         except urlerror.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"))
         return _HTTPWatch(resp, self._cls)
@@ -344,17 +345,45 @@ class HTTPClient:
     credentials (the kubeconfig token shape)."""
 
     def __init__(self, base_url: str, scheme: Scheme = SCHEME,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 insecure_skip_tls_verify: bool = False):
         self.base_url = base_url
         self.scheme = scheme
         self.token = token
+        self.ssl_context = None
+        if base_url.startswith("https") or cert_file or ca_file:
+            # kubeconfig TLS shape: server CA pinning + optional client
+            # cert/key pair for x509 authentication. An https server with
+            # neither a CA nor the explicit insecure flag FAILS here —
+            # silently skipping verification would hand bearer tokens to
+            # any MITM
+            import ssl
+            if ca_file:
+                ctx = ssl.create_default_context(cafile=ca_file)
+                ctx.check_hostname = False  # pinned by CA; hosts are IPs
+            elif insecure_skip_tls_verify:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            else:
+                raise ValueError(
+                    "https server requires ca_file (to pin the server "
+                    "cert) or insecure_skip_tls_verify=True")
+            if cert_file:
+                ctx.load_cert_chain(cert_file, key_file)
+            self.ssl_context = ctx
 
     def resource(self, cls: Type, namespace: Optional[str] = None):
         if cls is corev1.Pod:
             return HTTPPodClient(self.base_url, self.scheme, cls, namespace,
-                                 token=self.token)
+                                 token=self.token,
+                                 ssl_context=self.ssl_context)
         return HTTPResourceClient(self.base_url, self.scheme, cls, namespace,
-                                  token=self.token)
+                                  token=self.token,
+                                  ssl_context=self.ssl_context)
 
     def __getattr__(self, name):
         """Convenience accessors (pods(), nodes(), ...) mirror Client's by

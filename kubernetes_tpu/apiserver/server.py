@@ -80,7 +80,10 @@ class _Request:
 class APIServer:
     def __init__(self, store: Optional[Store] = None, scheme: Scheme = SCHEME,
                  host: str = "127.0.0.1", port: int = 0,
-                 audit_log_path: Optional[str] = None):
+                 audit_log_path: Optional[str] = None,
+                 tls_cert_file: Optional[str] = None,
+                 tls_key_file: Optional[str] = None,
+                 client_ca_file: Optional[str] = None):
         self.client = Client(store)
         self.store = self.client.store
         self.scheme = scheme
@@ -135,6 +138,24 @@ class APIServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
+        self._tls = bool(tls_cert_file)
+        if tls_cert_file:
+            # the reference's secure serving port: TLS with OPTIONAL
+            # client certs verified against --client-ca-file; an x509
+            # peer identity then wins over bearer headers
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file)
+            if client_ca_file:
+                ctx.load_verify_locations(client_ca_file)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            # handshake on first read in the per-connection WORKER thread:
+            # with do_handshake_on_connect the handshake runs inside
+            # accept() on the single serve_forever thread, so one stalled
+            # client would freeze every new connection
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self._thread: Optional[threading.Thread] = None
 
     def _bootstrap_namespaces(self) -> None:
@@ -237,7 +258,8 @@ class APIServer:
     @property
     def address(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -350,10 +372,20 @@ class APIServer:
         if self.authenticator is None:
             return True, None
         from .auth import request_verb
-        user = self.authenticator.authenticate(
-            h.headers.get("Authorization", ""))
+        user = None
+        peer_auth = getattr(self.authenticator, "authenticate_cert", None)
+        if peer_auth is not None and self._tls:
+            try:
+                der = h.connection.getpeercert(binary_form=True)
+            except Exception:
+                der = None
+            if der:
+                user = peer_auth(der)
         if user is None:
-            self._error(h, 401, "Unauthorized", "invalid bearer token")
+            user = self.authenticator.authenticate(
+                h.headers.get("Authorization", ""))
+        if user is None:
+            self._error(h, 401, "Unauthorized", "invalid credentials")
             return False, None
         if self.authorizer is not None:
             verb = request_verb(method, req.query.get("watch") in

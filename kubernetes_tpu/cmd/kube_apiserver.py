@@ -29,7 +29,18 @@ def main(argv=None) -> int:
                         "(+ default-deny RBAC; system:masters gets all)")
     p.add_argument("--audit-log-path", default=None,
                    help="append one JSON audit line per request")
+    p.add_argument("--tls-cert-file", default=None)
+    p.add_argument("--tls-private-key-file", default=None)
+    p.add_argument("--client-ca-file", default=None,
+                   help="verify client certs against this CA; their "
+                        "CN/O become user/groups (x509 authn)")
     args = p.parse_args(argv)
+    if args.client_ca_file and not args.tls_cert_file:
+        # client certs can only arrive over TLS; without a serving cert
+        # the CA would silently never be consulted and every request
+        # would be rejected by default-deny RBAC
+        p.error("--client-ca-file requires --tls-cert-file/"
+                "--tls-private-key-file")
     store = None
     wal_file = None
     if args.data_dir:
@@ -40,7 +51,18 @@ def main(argv=None) -> int:
         wal_file = os.path.join(args.data_dir, "store.wal")
         store = Store(wal_path=wal_file, wal_sync=args.wal_sync)
     srv = APIServer(store=store, host=args.bind_address,
-                    port=args.port, audit_log_path=args.audit_log_path)
+                    port=args.port, audit_log_path=args.audit_log_path,
+                    tls_cert_file=args.tls_cert_file,
+                    tls_key_file=args.tls_private_key_file,
+                    client_ca_file=args.client_ca_file)
+    if args.client_ca_file and not args.token_auth_file:
+        # x509-only authn: cert identities + default-deny RBAC
+        from ..apiserver.auth import CertAuthenticator, RBACAuthorizer
+        srv.authenticator = CertAuthenticator()
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        authz.use_store(srv.client)
+        srv.authorizer = authz
     if args.token_auth_file:
         from ..apiserver.auth import (RBACAuthorizer, TokenAuthenticator,
                                       UserInfo)
@@ -67,6 +89,9 @@ def main(argv=None) -> int:
         authz.grant("group:system:masters", ["*"], ["*"])
         # stored Role/ClusterRole(+Binding) objects feed the live policy
         authz.use_store(srv.client)
+        if args.client_ca_file:
+            from ..apiserver.auth import CertAuthenticator
+            authn = CertAuthenticator(fallback=authn)
         srv.authenticator = authn
         srv.authorizer = authz
     srv.start()

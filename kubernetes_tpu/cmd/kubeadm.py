@@ -1,0 +1,244 @@
+"""kubeadm — cluster bootstrap.
+
+Ref: cmd/kubeadm/app (init: PKI + control-plane bring-up + bootstrap
+tokens + RBAC; join: TLS bootstrap via CSR). Here init generates the
+cluster PKI, writes kubeconfigs, and runs the whole control plane
+(apiserver with TLS + x509/token authn + stored-RBAC authz, controller
+manager incl. the CSR approver/signer, scheduler) in one process; join
+performs the reference's kubelet TLS bootstrap: authenticate with the
+bootstrap token, POST a CertificateSigningRequest
+(CN=system:node:<name>, O=system:nodes), wait for the auto-approved +
+signed certificate, then run the node agent with its x509 identity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+from typing import Optional, Tuple
+
+
+def _write(path: str, data: bytes) -> str:
+    # key material must never be world-readable (the reference's
+    # keyutil.WriteKey uses 0600); harmless extra strictness for certs
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    return path
+
+
+def generate_pki(pki_dir: str, server_sans=("127.0.0.1", "localhost")):
+    """CA + apiserver serving cert + admin client cert (ref: kubeadm's
+    certs phase). Returns a dict of paths."""
+    from ..utils import certs as certutil
+    os.makedirs(pki_dir, exist_ok=True)
+    ca_cert, ca_key = certutil.new_ca()
+    srv_cert, srv_key = certutil.issue_cert(
+        ca_cert, ca_key, "kube-apiserver", sans=tuple(server_sans),
+        server=True, client=False)
+    adm_cert, adm_key = certutil.issue_cert(
+        ca_cert, ca_key, "kubernetes-admin",
+        organizations=("system:masters",))
+    paths = {
+        "ca_cert": _write(os.path.join(pki_dir, "ca.crt"), ca_cert),
+        "ca_key": _write(os.path.join(pki_dir, "ca.key"), ca_key),
+        "server_cert": _write(os.path.join(pki_dir, "apiserver.crt"),
+                              srv_cert),
+        "server_key": _write(os.path.join(pki_dir, "apiserver.key"),
+                             srv_key),
+        "admin_cert": _write(os.path.join(pki_dir, "admin.crt"), adm_cert),
+        "admin_key": _write(os.path.join(pki_dir, "admin.key"), adm_key),
+    }
+    return paths
+
+
+class ControlPlane:
+    """Everything `kubeadm init` brings up, embeddable for tests."""
+
+    def __init__(self, data_dir: str, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from ..apiserver.auth import (CertAuthenticator, RBACAuthorizer,
+                                      TokenAuthenticator, UserInfo)
+        from ..apiserver.server import APIServer
+        from ..state.store import Store
+        os.makedirs(data_dir, exist_ok=True)
+        self.pki = generate_pki(os.path.join(data_dir, "pki"),
+                                server_sans=(host, "localhost",
+                                             "127.0.0.1"))
+        store = Store(wal_path=os.path.join(data_dir, "store.wal"))
+        self.server = APIServer(
+            store=store, host=host, port=port,
+            tls_cert_file=self.pki["server_cert"],
+            tls_key_file=self.pki["server_key"],
+            client_ca_file=self.pki["ca_cert"],
+            audit_log_path=os.path.join(data_dir, "audit.log"))
+        self._store = store
+        # bootstrap token (ref: kubeadm token): lets joiners create CSRs
+        self.bootstrap_token = secrets.token_hex(8)
+        tokens = TokenAuthenticator()
+        tokens.add(self.bootstrap_token, UserInfo(
+            "system:bootstrap:kubeadm", ("system:bootstrappers",)))
+        authz = RBACAuthorizer()
+        authz.grant("group:system:masters", ["*"], ["*"])
+        # bootstrappers may create and read CSRs, nothing else
+        authz.grant("group:system:bootstrappers",
+                    ["create", "get", "list", "watch"],
+                    ["certificatesigningrequests"])
+        # node identities run kubelets (ref: the Node authorizer's scope,
+        # expressed as RBAC here)
+        authz.grant("group:system:nodes",
+                    ["get", "list", "watch", "create", "update", "patch",
+                     "delete"],
+                    ["nodes", "nodes/status", "pods", "pods/status",
+                     "leases", "events"])
+        authz.use_store(self.server.client)
+        self.server.authenticator = CertAuthenticator(fallback=tokens)
+        self.server.authorizer = authz
+        self.manager = None
+        self.scheduler = None
+
+    def start(self) -> "ControlPlane":
+        from ..apiserver.httpclient import HTTPClient
+        from ..controllers import ControllerManager
+        from ..scheduler import Scheduler
+        self.server.start()
+        ca = (open(self.pki["ca_cert"], "rb").read(),
+              open(self.pki["ca_key"], "rb").read())
+        self.admin_client = HTTPClient(
+            self.server.address, ca_file=self.pki["ca_cert"],
+            cert_file=self.pki["admin_cert"],
+            key_file=self.pki["admin_key"])
+        self.manager = ControllerManager(self.admin_client, cluster_ca=ca)
+        self.manager.start()
+        self.scheduler = Scheduler(self.admin_client)
+        self.scheduler.start()
+        return self
+
+    def stop(self) -> None:
+        if self.scheduler is not None:
+            self.scheduler.stop()
+        if self.manager is not None:
+            self.manager.stop()
+        self.server.stop()
+        self._store.close()
+
+
+def join_node(server_url: str, token: str, node_name: str,
+              work_dir: str, ca_file: Optional[str] = None,
+              timeout: float = 60.0):
+    """The kubelet TLS bootstrap (ref: kubeadm join + kubelet
+    certificate.Manager): CSR with the node identity, wait for the signed
+    cert, start the agent with it. Returns the running NodeAgent."""
+    from ..api.certificates import (SIGNER_KUBELET_CLIENT,
+                                    CertificateSigningRequest,
+                                    CertificateSigningRequestSpec)
+    from ..api.meta import ObjectMeta
+    from ..apiserver.httpclient import HTTPClient
+    from ..utils import certs as certutil
+    os.makedirs(work_dir, exist_ok=True)
+    csr_pem, key_pem = certutil.new_csr(
+        f"system:node:{node_name}", organizations=("system:nodes",))
+    key_file = _write(os.path.join(work_dir, f"{node_name}.key"), key_pem)
+    boot = HTTPClient(server_url, token=token, ca_file=ca_file,
+                      insecure_skip_tls_verify=ca_file is None)
+    rc = boot.certificate_signing_requests()
+    name = f"node-csr-{node_name}"
+    rc.create(CertificateSigningRequest(
+        metadata=ObjectMeta(name=name),
+        spec=CertificateSigningRequestSpec(
+            request=base64.b64encode(csr_pem).decode(),
+            signer_name=SIGNER_KUBELET_CLIENT,
+            usages=["digital signature", "client auth"],
+            username=f"system:node:{node_name}",
+            groups=["system:nodes"])))
+    deadline = time.time() + timeout
+    cert_b64 = ""
+    while time.time() < deadline:
+        csr = rc.get(name)
+        if csr.status.certificate:
+            cert_b64 = csr.status.certificate
+            break
+        time.sleep(0.2)
+    if not cert_b64:
+        raise TimeoutError(f"CSR {name} was never signed")
+    cert_file = _write(os.path.join(work_dir, f"{node_name}.crt"),
+                       base64.b64decode(cert_b64))
+    client = HTTPClient(server_url, ca_file=ca_file,
+                        cert_file=cert_file, key_file=key_file,
+                        insecure_skip_tls_verify=ca_file is None)
+    return JoinedNode(client, node_name)
+
+
+class JoinedNode:
+    """A kubelet running under its CSR-issued x509 identity."""
+
+    def __init__(self, client, node_name: str):
+        from ..node.agent import NodeAgent
+        from ..state.informer import SharedInformerFactory
+        self.client = client
+        self.informers = SharedInformerFactory(client)
+        self.agent = NodeAgent(client, node_name, self.informers)
+
+    def start(self) -> "JoinedNode":
+        self.informers.start()
+        self.informers.wait_for_cache_sync()
+        self.agent.start()
+        return self
+
+    def stop(self) -> None:
+        self.agent.stop()
+        self.informers.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubeadm")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    i = sub.add_parser("init")
+    i.add_argument("--data-dir", required=True)
+    i.add_argument("--port", type=int, default=6443)
+    i.add_argument("--bind-address", default="127.0.0.1")
+    j = sub.add_parser("join")
+    j.add_argument("server")
+    j.add_argument("--token", required=True)
+    j.add_argument("--node-name", required=True)
+    j.add_argument("--work-dir", required=True)
+    j.add_argument("--ca-file", default=None)
+    args = p.parse_args(argv)
+
+    if args.cmd == "init":
+        cp = ControlPlane(args.data_dir, port=args.port,
+                          host=args.bind_address).start()
+        print(json.dumps({
+            "server": cp.server.address,
+            "token": cp.bootstrap_token,
+            "ca_file": cp.pki["ca_cert"],
+            "admin_cert": cp.pki["admin_cert"],
+            "admin_key": cp.pki["admin_key"]}), flush=True)
+        stop = threading.Event()
+        import signal
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        stop.wait()
+        cp.stop()
+        return 0
+    if args.cmd == "join":
+        node = join_node(args.server, args.token, args.node_name,
+                         args.work_dir, ca_file=args.ca_file).start()
+        print(f"node {args.node_name} joined", flush=True)
+        stop = threading.Event()
+        import signal
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        stop.wait()
+        node.stop()
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ device round trip has nothing to amortize.
 """
 
 from .base import Controller
+from .certificates import CSRApprovingController, CSRSigningController
 from .clusterroleaggregation import ClusterRoleAggregationController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
@@ -48,6 +49,7 @@ from .statefulset import StatefulSetController
 from .volume import PersistentVolumeBinder
 
 __all__ = ["Controller", "ControllerManager",
+           "CSRApprovingController", "CSRSigningController",
            "ClusterRoleAggregationController", "CronJobController",
            "NodeIpamController", "PVCProtectionController",
            "PVProtectionController", "ServiceAccountController",

@@ -1,0 +1,142 @@
+"""CSR controllers — approving and signing.
+
+Ref: pkg/controller/certificates/{approver/sarapprove.go,signer/signer.go}
+(+ cleaner). The approver auto-approves kubelet client/serving requests
+whose subject matches the requesting identity (the reference gates on a
+subject-access-review; here the kubelet signer names carry the policy);
+the signer issues certificates for approved CSRs from the cluster CA.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from ..api.certificates import (SIGNER_KUBELET_CLIENT,
+                                SIGNER_KUBELET_SERVING,
+                                CertificateSigningRequest,
+                                CertificateSigningRequestCondition,
+                                is_approved, is_denied)
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import NotFoundError
+from ..utils import certs as certutil
+from ..utils.clock import now_iso
+from .base import Controller
+
+
+class CSRApprovingController(Controller):
+    """Auto-approves kubelet bootstrap CSRs whose subject encodes a node
+    identity (CN=system:node:<name>, O=system:nodes), the reference's
+    self-nodeclient/selfnodeserver recognizers."""
+
+    name = "csrapproving"
+
+    AUTO_SIGNERS = (SIGNER_KUBELET_CLIENT, SIGNER_KUBELET_SERVING)
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.csr_informer = informers.informer_for(
+            CertificateSigningRequest)
+        self.csr_informer.add_event_handlers(EventHandlers(
+            on_add=lambda c: self.enqueue(c.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name)))
+
+    def sync(self, key: str) -> None:
+        csr = self.csr_informer.indexer.get_by_key(key)
+        if csr is None or is_approved(csr) or is_denied(csr):
+            return
+        if csr.spec.signer_name not in self.AUTO_SIGNERS:
+            return  # generic client signer needs a human/admin approval
+        try:
+            pem = base64.b64decode(csr.spec.request)
+            cn, orgs = certutil.csr_subject_of(pem)
+        except Exception:
+            self._condition(key, "Failed", "InvalidRequest",
+                            "request is not a parseable PEM CSR")
+            return
+        if not (cn.startswith("system:node:") and
+                orgs == ("system:nodes",)):
+            # EXACT organization match (ref: the approver's recognizers):
+            # allowing extra orgs would let a bootstrap token mint a cert
+            # carrying system:masters — a straight privilege escalation
+            self._condition(key, "Denied", "SubjectMismatch",
+                            "kubelet signer requires CN=system:node:* and "
+                            "O=[system:nodes] exactly")
+            return
+        self._condition(key, "Approved", "AutoApproved",
+                        "kubelet node certificate")
+
+    def _condition(self, name: str, ctype: str, reason: str,
+                   message: str) -> None:
+        def mutate(cur):
+            if not any(c.type == ctype for c in cur.status.conditions):
+                cur.status.conditions.append(
+                    CertificateSigningRequestCondition(
+                        type=ctype, status="True", reason=reason,
+                        message=message, last_update_time=now_iso()))
+            return cur
+        try:
+            self.client.resource(CertificateSigningRequest).patch(
+                name, mutate)
+        except NotFoundError:
+            pass
+
+
+class CSRSigningController(Controller):
+    """Signs approved CSRs with the cluster CA (ref: signer/signer.go)."""
+
+    name = "csrsigning"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 ca_cert_pem: bytes, ca_key_pem: bytes, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.ca_cert_pem = ca_cert_pem
+        self.ca_key_pem = ca_key_pem
+        self.csr_informer = informers.informer_for(
+            CertificateSigningRequest)
+        self.csr_informer.add_event_handlers(EventHandlers(
+            on_add=lambda c: self.enqueue(c.metadata.name),
+            on_update=lambda old, new: self.enqueue(new.metadata.name)))
+
+    def sync(self, key: str) -> None:
+        csr = self.csr_informer.indexer.get_by_key(key)
+        if csr is None or not is_approved(csr) or is_denied(csr) or \
+                csr.status.certificate or \
+                any(c.type == "Failed" for c in csr.status.conditions):
+            # a Failed CSR is terminal (re-signing the same broken request
+            # would loop forever appending conditions)
+            return
+        try:
+            pem = base64.b64decode(csr.spec.request)
+            cert = certutil.sign_csr(
+                self.ca_cert_pem, self.ca_key_pem, pem,
+                server=(csr.spec.signer_name == SIGNER_KUBELET_SERVING))
+        except Exception as e:
+            def fail(cur):
+                if not any(c.type == "Failed"
+                           for c in cur.status.conditions):
+                    cur.status.conditions.append(
+                        CertificateSigningRequestCondition(
+                            type="Failed", status="True",
+                            reason="SigningError", message=str(e),
+                            last_update_time=now_iso()))
+                return cur
+            try:
+                self.client.resource(CertificateSigningRequest).patch(
+                    key, fail)
+            except NotFoundError:
+                pass
+            return
+
+        def mutate(cur):
+            if not cur.status.certificate:
+                cur.status.certificate = \
+                    base64.b64encode(cert).decode()
+            return cur
+        try:
+            self.client.resource(CertificateSigningRequest).patch(
+                key, mutate)
+        except NotFoundError:
+            pass

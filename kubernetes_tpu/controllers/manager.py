@@ -22,6 +22,7 @@ from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import HorizontalController, MetricsClient
 from .podgc import PodGCController
+from .certificates import CSRApprovingController, CSRSigningController
 from .clusterroleaggregation import ClusterRoleAggregationController
 from .nodeipam import NodeIpamController
 from .replicaset import ReplicaSetController
@@ -42,7 +43,8 @@ class ControllerManager:
                  terminated_pod_gc_threshold: int = 12500,
                  podgc_period: float = 20.0,
                  cronjob_period: float = 10.0,
-                 metrics_client: Optional[MetricsClient] = None):
+                 metrics_client: Optional[MetricsClient] = None,
+                 cluster_ca: Optional[tuple] = None):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
         from ..api.core import ReplicationController
@@ -76,6 +78,13 @@ class ControllerManager:
         self.nodeipam = NodeIpamController(client, self.informers)
         self.pvc_protection = PVCProtectionController(client, self.informers)
         self.pv_protection = PVProtectionController(client, self.informers)
+        # the CSR pair needs the cluster CA keypair (cert_pem, key_pem);
+        # without one the cluster simply serves no certificate signing
+        self.csrapproving = self.csrsigning = None
+        if cluster_ca is not None:
+            self.csrapproving = CSRApprovingController(client, self.informers)
+            self.csrsigning = CSRSigningController(
+                client, self.informers, cluster_ca[0], cluster_ca[1])
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -89,6 +98,8 @@ class ControllerManager:
             self.resourcequota, self.podautoscaler, self.serviceaccount,
             self.clusterrole_aggregation, self.nodeipam,
             self.pvc_protection, self.pv_protection]
+        if self.csrapproving is not None:
+            self.controllers += [self.csrapproving, self.csrsigning]
 
     def start(self) -> None:
         self.informers.start()

@@ -141,6 +141,10 @@ def default_scheme() -> Scheme:
     from ..api.autoscaling import HorizontalPodAutoscaler
     s.register(HorizontalPodAutoscaler, "autoscaling/v1",
                "HorizontalPodAutoscaler", "horizontalpodautoscalers")
+    from ..api.certificates import CertificateSigningRequest
+    s.register(CertificateSigningRequest, "certificates.k8s.io/v1",
+               "CertificateSigningRequest", "certificatesigningrequests",
+               namespaced=False)
     return s
 
 

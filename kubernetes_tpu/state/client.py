@@ -359,3 +359,7 @@ class Client:
     def horizontal_pod_autoscalers(self, namespace: Optional[str] = None) -> ResourceClient:
         from ..api.autoscaling import HorizontalPodAutoscaler
         return self.resource(HorizontalPodAutoscaler, namespace)
+
+    def certificate_signing_requests(self) -> ResourceClient:
+        from ..api.certificates import CertificateSigningRequest
+        return self.resource(CertificateSigningRequest)

@@ -1,0 +1,151 @@
+"""Cluster PKI helpers — CA, serving/client certs, CSR signing.
+
+Ref: the reference's cert machinery spread over cmd/kubeadm/app/phases/
+certs, staging/src/k8s.io/client-go/util/cert and
+pkg/controller/certificates/signer. Backed by the `cryptography` package;
+PEM in, PEM out so the artifacts interoperate with openssl.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption())
+
+
+def _pem_cert(cert) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def new_ca(common_name: str = "kubernetes-ca",
+           days: int = 3650) -> Tuple[bytes, bytes]:
+    """(cert_pem, key_pem) for a self-signed CA."""
+    key = _key()
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    return _pem_cert(cert), _pem_key(key)
+
+
+def issue_cert(ca_cert_pem: bytes, ca_key_pem: bytes, common_name: str,
+               organizations: Tuple[str, ...] = (),
+               sans: Tuple[str, ...] = (), days: int = 365,
+               server: bool = False, client: bool = True
+               ) -> Tuple[bytes, bytes]:
+    """(cert_pem, key_pem) signed by the CA. CN -> user name, O -> groups
+    (the reference's x509 authenticator mapping)."""
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    key = _key()
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+        + [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o)
+           for o in organizations])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    usages = []
+    if client:
+        usages.append(ExtendedKeyUsageOID.CLIENT_AUTH)
+    if server:
+        usages.append(ExtendedKeyUsageOID.SERVER_AUTH)
+    builder = (x509.CertificateBuilder()
+               .subject_name(name).issuer_name(ca_cert.subject)
+               .public_key(key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - _ONE_DAY)
+               .not_valid_after(now + datetime.timedelta(days=days))
+               .add_extension(x509.ExtendedKeyUsage(usages), critical=False))
+    if sans:
+        alts: List[x509.GeneralName] = []
+        for s in sans:
+            try:
+                import ipaddress
+                alts.append(x509.IPAddress(ipaddress.ip_address(s)))
+            except ValueError:
+                alts.append(x509.DNSName(s))
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(alts), critical=False)
+    cert = builder.sign(ca_key, hashes.SHA256())
+    return _pem_cert(cert), _pem_key(key)
+
+
+def new_csr(common_name: str,
+            organizations: Tuple[str, ...] = ()) -> Tuple[bytes, bytes]:
+    """(csr_pem, key_pem) — what a kubelet submits as a
+    CertificateSigningRequest."""
+    key = _key()
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+        + [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o)
+           for o in organizations])
+    csr = (x509.CertificateSigningRequestBuilder()
+           .subject_name(name).sign(key, hashes.SHA256()))
+    return csr.public_bytes(serialization.Encoding.PEM), _pem_key(key)
+
+
+def sign_csr(ca_cert_pem: bytes, ca_key_pem: bytes, csr_pem: bytes,
+             days: int = 365, server: bool = False) -> bytes:
+    """cert_pem for a CSR, preserving its subject (the csrsigning
+    controller's core)."""
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    csr = x509.load_pem_x509_csr(csr_pem)
+    if not csr.is_signature_valid:
+        raise ValueError("CSR signature invalid")
+    now = datetime.datetime.now(datetime.timezone.utc)
+    usages = [ExtendedKeyUsageOID.SERVER_AUTH] if server \
+        else [ExtendedKeyUsageOID.CLIENT_AUTH]
+    cert = (x509.CertificateBuilder()
+            .subject_name(csr.subject).issuer_name(ca_cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.ExtendedKeyUsage(usages), critical=False)
+            .sign(ca_key, hashes.SHA256()))
+    return _pem_cert(cert)
+
+
+def _subject(name: x509.Name) -> Tuple[str, Tuple[str, ...]]:
+    cn = ""
+    orgs: List[str] = []
+    for attr in name:
+        if attr.oid == NameOID.COMMON_NAME:
+            cn = str(attr.value)
+        elif attr.oid == NameOID.ORGANIZATION_NAME:
+            orgs.append(str(attr.value))
+    return cn, tuple(orgs)
+
+
+def subject_of(cert_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
+    """(common_name, organizations) — the x509 authenticator's user
+    mapping (ref: authentication/request/x509: CommonNameUserConversion)."""
+    return _subject(x509.load_pem_x509_certificate(cert_pem).subject)
+
+
+def csr_subject_of(csr_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
+    return _subject(x509.load_pem_x509_csr(csr_pem).subject)

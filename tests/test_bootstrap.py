@@ -1,0 +1,180 @@
+"""Cluster bootstrap: PKI, TLS serving, x509 authn, CSR approve+sign,
+kubeadm init/join.
+
+Ref: cmd/kubeadm e2e flows + pkg/controller/certificates tests +
+apiserver authentication/request/x509 tests.
+"""
+
+import base64
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.utils import certs as certutil
+
+
+class TestCertHelpers:
+    def test_ca_issue_subject_roundtrip(self):
+        ca_cert, ca_key = certutil.new_ca()
+        cert, key = certutil.issue_cert(
+            ca_cert, ca_key, "alice", organizations=("devs", "admins"))
+        cn, orgs = certutil.subject_of(cert)
+        assert cn == "alice"
+        assert set(orgs) == {"devs", "admins"}
+
+    def test_csr_sign_preserves_subject(self):
+        ca_cert, ca_key = certutil.new_ca()
+        csr, key = certutil.new_csr("system:node:n1",
+                                    organizations=("system:nodes",))
+        cert = certutil.sign_csr(ca_cert, ca_key, csr)
+        cn, orgs = certutil.subject_of(cert)
+        assert cn == "system:node:n1"
+        assert orgs == ("system:nodes",)
+
+
+class TestCSRControllers:
+    def test_kubelet_csr_approved_and_signed(self):
+        from kubernetes_tpu.api.certificates import (
+            SIGNER_KUBELET_CLIENT, CertificateSigningRequest,
+            CertificateSigningRequestSpec, is_approved)
+        from kubernetes_tpu.controllers.certificates import (
+            CSRApprovingController, CSRSigningController)
+        from kubernetes_tpu.state import Client, SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        ca_cert, ca_key = certutil.new_ca()
+        approver = CSRApprovingController(client, informers)
+        signer = CSRSigningController(client, informers, ca_cert, ca_key)
+        csr_pem, _ = certutil.new_csr("system:node:n1",
+                                      organizations=("system:nodes",))
+        client.certificate_signing_requests().create(
+            CertificateSigningRequest(
+                metadata=api.ObjectMeta(name="n1-csr"),
+                spec=CertificateSigningRequestSpec(
+                    request=base64.b64encode(csr_pem).decode(),
+                    signer_name=SIGNER_KUBELET_CLIENT)))
+        # a non-node subject must be denied
+        bad_pem, _ = certutil.new_csr("impostor")
+        client.certificate_signing_requests().create(
+            CertificateSigningRequest(
+                metadata=api.ObjectMeta(name="bad-csr"),
+                spec=CertificateSigningRequestSpec(
+                    request=base64.b64encode(bad_pem).decode(),
+                    signer_name=SIGNER_KUBELET_CLIENT)))
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            approver.sync("n1-csr")
+            approver.sync("bad-csr")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                got = signer.csr_informer.indexer.get_by_key("n1-csr")
+                if got is not None and is_approved(got):
+                    break
+                time.sleep(0.02)
+            signer.sync("n1-csr")
+            signer.sync("bad-csr")
+            signed = client.certificate_signing_requests().get("n1-csr")
+            assert signed.status.certificate
+            cn, orgs = certutil.subject_of(
+                base64.b64decode(signed.status.certificate))
+            assert cn == "system:node:n1"
+            bad = client.certificate_signing_requests().get("bad-csr")
+            assert not bad.status.certificate
+            assert any(c.type == "Denied" for c in bad.status.conditions)
+        finally:
+            informers.stop()
+
+
+class TestCSRPrivilegeBoundaries:
+    def test_extra_orgs_denied(self):
+        """A kubelet CSR smuggling system:masters alongside system:nodes
+        must be DENIED — exact-org matching, or a bootstrap token
+        escalates to cluster admin through the auto-approver."""
+        from kubernetes_tpu.api.certificates import (
+            SIGNER_KUBELET_CLIENT, CertificateSigningRequest,
+            CertificateSigningRequestSpec, is_approved, is_denied)
+        from kubernetes_tpu.controllers.certificates import \
+            CSRApprovingController
+        from kubernetes_tpu.state import Client, SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        approver = CSRApprovingController(client, informers)
+        evil_pem, _ = certutil.new_csr(
+            "system:node:evil",
+            organizations=("system:nodes", "system:masters"))
+        client.certificate_signing_requests().create(
+            CertificateSigningRequest(
+                metadata=api.ObjectMeta(name="evil"),
+                spec=CertificateSigningRequestSpec(
+                    request=base64.b64encode(evil_pem).decode(),
+                    signer_name=SIGNER_KUBELET_CLIENT)))
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            approver.sync("evil")
+            got = client.certificate_signing_requests().get("evil")
+            assert is_denied(got)
+            assert not is_approved(got)
+        finally:
+            informers.stop()
+
+    def test_https_without_ca_or_insecure_flag_fails(self):
+        from kubernetes_tpu.apiserver.httpclient import HTTPClient
+        with pytest.raises(ValueError, match="ca_file"):
+            HTTPClient("https://127.0.0.1:9")
+
+
+class TestKubeadm:
+    def test_init_and_tls_bootstrap_join(self, tmp_path):
+        """The full aha-flow: kubeadm init brings up a TLS control plane;
+        a node joins via bootstrap token -> CSR -> signed x509 identity;
+        a scheduled pod runs on it."""
+        from kubernetes_tpu.cmd.kubeadm import ControlPlane, join_node
+        cp = ControlPlane(str(tmp_path / "cp")).start()
+        node = None
+        try:
+            assert cp.server.address.startswith("https://")
+            # x509 admin identity works over TLS
+            assert cp.admin_client.namespaces().get("default")
+            # anonymous is denied
+            from kubernetes_tpu.apiserver.httpclient import HTTPClient
+            anon = HTTPClient(cp.server.address,
+                              insecure_skip_tls_verify=True)
+            with pytest.raises(PermissionError):
+                anon.pods("default").list()
+            # join: bootstrap token -> CSR -> cert -> running kubelet
+            node = join_node(cp.server.address, cp.bootstrap_token, "n1",
+                             str(tmp_path / "n1"),
+                             ca_file=cp.pki["ca_cert"],
+                             timeout=30.0).start()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                nodes = cp.admin_client.nodes().list()
+                if nodes and any(n.metadata.name == "n1" for n in nodes):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("joined node never registered")
+            # end-to-end: a pod lands on the joined node and runs
+            cp.admin_client.pods("default").create(api.Pod(
+                metadata=api.ObjectMeta(name="p", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img")])))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                p = cp.admin_client.pods("default").get("p")
+                if p.spec.node_name == "n1" and \
+                        p.status.phase == "Running":
+                    break
+                time.sleep(0.2)
+            else:
+                p = cp.admin_client.pods("default").get("p")
+                raise AssertionError(
+                    f"pod never ran: node={p.spec.node_name!r} "
+                    f"phase={p.status.phase!r}")
+        finally:
+            if node is not None:
+                node.stop()
+            cp.stop()
