@@ -12,7 +12,7 @@ from .core import (Affinity, Binding, ConfigMap, Container, ContainerImage,
                    NodeSelectorTerm, NodeSpec, NodeStatus, ObjectReference,
                    PersistentVolume, PersistentVolumeClaim,
                    PersistentVolumeClaimSpec, PersistentVolumeClaimVolumeSource,
-                   PersistentVolumeSpec, Pod, PodAffinity,
+                   PersistentVolumeSpec, Pod, PodAffinity, Probe,
                    PodAffinityTerm, PodAntiAffinity, PodCondition, PodSpec,
                    PodStatus, PodTemplateSpec, PreferredSchedulingTerm,
                    LimitRange, LimitRangeItem, LimitRangeSpec,

@@ -187,3 +187,29 @@ def is_node_ready(node: Node) -> bool:
 
 def pod_is_terminal(pod: Pod) -> bool:
     return pod.status.phase in ("Succeeded", "Failed")
+
+
+def pod_qos(pod: Pod) -> str:
+    """Ref: pkg/apis/core/v1/helper/qos.GetPodQOS — the ONE QoS
+    classifier (scheduler predicates, admission scopes and kubelet
+    eviction all consume this; diverging copies would class the same pod
+    differently per subsystem)."""
+    requests: Dict[str, int] = {}
+    limits: Dict[str, int] = {}
+    guaranteed = True
+    for c in pod.spec.containers:
+        for name, q in c.resources.requests.items():
+            if name in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY):
+                requests[name] = requests.get(name, 0) + q.value()
+        for name, q in c.resources.limits.items():
+            if name in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY):
+                limits[name] = limits.get(name, 0) + q.value()
+        cl = {n for n in c.resources.limits
+              if n in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY)}
+        if cl != {wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY}:
+            guaranteed = False
+    if not requests and not limits:
+        return "BestEffort"
+    if guaranteed and requests == limits:
+        return "Guaranteed"
+    return "Burstable"

@@ -369,6 +369,55 @@ def cmd_rollout(args) -> int:
                       file=sys.stderr)
                 return 1
             _t.sleep(0.2)
+    elif args.action == "history":
+        if resource != "deployments":
+            print("error: rollout history supports deployments",
+                  file=sys.stderr)
+            return 1
+        from ..controllers.deployment import REVISION_ANN, HASH_LABEL
+        d = rc.get(args.name, namespace=args.namespace)
+        rows = []
+        for rs in _owned_rses(_client(args), d):
+            rev = rs.metadata.annotations.get(REVISION_ANN, "0")
+            rows.append([rev, rs.metadata.name,
+                         rs.spec.template.spec.containers[0].image
+                         if rs.spec.template.spec.containers else ""])
+        rows.sort(key=lambda r: int(r[0]))
+        _print_table(rows, ["REVISION", "REPLICASET", "IMAGE"])
+        return 0
+    elif args.action == "undo":
+        if resource != "deployments":
+            print("error: rollout undo supports deployments",
+                  file=sys.stderr)
+            return 1
+        from ..controllers.deployment import (HASH_LABEL, REVISION_ANN,
+                                              DeploymentController)
+        d = rc.get(args.name, namespace=args.namespace)
+        rses = _owned_rses(_client(args), d)
+        if not rses:
+            print("error: no rollout history", file=sys.stderr)
+            return 1
+        cur_rev = int(d.metadata.annotations.get(REVISION_ANN, "0"))
+        if args.to_revision:
+            target = next((rs for rs in rses
+                           if int(rs.metadata.annotations.get(
+                               REVISION_ANN, "0")) == args.to_revision),
+                          None)
+        else:
+            older = [rs for rs in rses
+                     if int(rs.metadata.annotations.get(REVISION_ANN,
+                                                        "0")) < cur_rev]
+            target = max(older, key=DeploymentController.revision_of) \
+                if older else None
+        if target is None:
+            print("error: revision not found", file=sys.stderr)
+            return 1
+        tmpl = serde.encode(target.spec.template)
+        tmpl.get("metadata", {}).get("labels", {}).pop(HASH_LABEL, None)
+        rc.merge_patch(args.name, {"spec": {"template": tmpl}},
+                       namespace=args.namespace, strategic=False)
+        print(f"deployment.apps/{args.name} rolled back")
+        return 0
     elif args.action == "restart":
         if resource not in ("deployments", "statefulsets", "daemonsets"):
             print(f"error: rollout restart supports deployments/"
@@ -387,6 +436,18 @@ def cmd_rollout(args) -> int:
         return 0
     print(f"error: unknown rollout action {args.action}", file=sys.stderr)
     return 1
+
+
+def _owned_rses(client, d):
+    from ..api.apps import ReplicaSet
+    from ..api.meta import controller_ref
+    out = []
+    for rs in client.resource(ReplicaSet,
+                              d.metadata.namespace).list():
+        ref = controller_ref(rs.metadata)
+        if ref is not None and ref.uid == d.metadata.uid:
+            out.append(rs)
+    return out
 
 
 def cmd_api_resources(args) -> int:
@@ -497,10 +558,12 @@ def main(argv=None) -> int:
         c.set_defaults(fn=fn)
 
     ro = sub.add_parser("rollout")
-    ro.add_argument("action", choices=["status", "restart"])
+    ro.add_argument("action", choices=["status", "restart", "history",
+                                       "undo"])
     ro.add_argument("resource")  # deployment (the rollout-managed kind)
     ro.add_argument("name")
     ro.add_argument("--timeout", type=float, default=60.0)
+    ro.add_argument("--to-revision", type=int, default=0)
     ro.set_defaults(fn=cmd_rollout)
 
     ar = sub.add_parser("api-resources")

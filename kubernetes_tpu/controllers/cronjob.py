@@ -63,6 +63,8 @@ class CronJobController:
         self.client = client
         self.period = period
         self.clock = clock
+        #: cronjob uid -> last wall minute the missed-run scan ran
+        self._missed_scan_memo = {}
         self.informer = informers.informer_for(CronJob)
         self.job_informer = informers.informer_for(Job)
         self._stop = threading.Event()
@@ -106,13 +108,61 @@ class CronJobController:
             except Exception:
                 traceback.print_exc()
 
+    def _missed_run(self, cj: CronJob, now: float):
+        """The missed-run backstop (ref: cronjob_controllerv2
+        mostRecentScheduleTime + the startingDeadlineSeconds gate): a
+        schedule minute that passed while the controller was down or
+        wedged still fires, as long as it is within the starting
+        deadline. Returns the missed minute's timestamp or None."""
+        last = parse_iso(cj.status.last_schedule_time or "")
+        if last is None:
+            # never fired: only look back within the deadline window (an
+            # unbounded scan would fire ancient schedules on first sight)
+            window = cj.spec.starting_deadline_seconds or 0
+            start = now - window
+        else:
+            start = last + 60
+        # never before the object existed — a fresh CronJob must not
+        # "catch up" schedule minutes that predate it (ref: the
+        # controller's earliestTime = CreationTimestamp floor)
+        created = parse_iso(cj.metadata.creation_timestamp or "")
+        if created is not None:
+            start = max(start, created)
+        deadline = cj.spec.starting_deadline_seconds
+        if deadline is not None:
+            start = max(start, now - deadline)
+        # scan backward from the previous minute for the MOST RECENT
+        # missed schedule (the reference fires one catch-up, not all)
+        minute = int(now // 60) * 60 - 60
+        scanned = 0
+        while minute >= start and scanned < 512:
+            if schedule_due(cj.spec.schedule, minute + 1):
+                return float(minute)
+            minute -= 60
+            scanned += 1
+        return None
+
     def sync_one(self, cj: CronJob) -> None:
         if cj.spec.suspend or cj.metadata.deletion_timestamp is not None:
             return
         now = self.clock.now()
         owned = self._owned_jobs(cj)
         active = [j for j in owned if not self._job_finished(j)]
-        if schedule_due(cj.spec.schedule, now) and not self._fired_this_minute(cj, now):
+        due_now = schedule_due(cj.spec.schedule, now) and \
+            not self._fired_this_minute(cj, now)
+        if not due_now:
+            # memoize per (cronjob, wall minute): the backward scan is
+            # O(window) and would otherwise run on every 10s poll tick
+            memo_key = cj.metadata.uid
+            this_minute = int(now // 60)
+            if self._missed_scan_memo.get(memo_key) != this_minute:
+                self._missed_scan_memo[memo_key] = this_minute
+                missed = self._missed_run(cj, now)
+                if missed is not None and not self._fired_this_minute(
+                        cj, missed):
+                    now = missed  # fire the catch-up under its own minute
+                    due_now = True
+        if due_now:
             if active and cj.spec.concurrency_policy == "Forbid":
                 pass
             else:
@@ -145,8 +195,14 @@ class CronJobController:
             self.client.jobs(cj.metadata.namespace).create(job)
         except Exception:
             return
+        from datetime import datetime, timezone
+        fired_at = datetime.fromtimestamp(now, tz=timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%S.%fZ")
+
         def stamp(cur):
-            cur.status.last_schedule_time = now_iso(self.clock)
+            # the SCHEDULED minute, not wall-now: a catch-up fire for a
+            # missed window must not suppress the current minute's run
+            cur.status.last_schedule_time = fired_at
             return cur
         try:
             self.client.resource(CronJob, cj.metadata.namespace).patch(

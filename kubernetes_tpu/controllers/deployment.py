@@ -23,6 +23,8 @@ from ..state.informer import EventHandlers, SharedInformerFactory
 from .base import Controller
 
 HASH_LABEL = "pod-template-hash"  # ref: DefaultDeploymentUniqueLabelKey
+#: ref: deployment_util.go RevisionAnnotation — the rollback anchor
+REVISION_ANN = "deployment.kubernetes.io/revision"
 
 
 def resolve_int_or_percent(value: Optional[str], total: int,
@@ -124,10 +126,12 @@ class DeploymentController(Controller):
             new_rs = self._create_new_rs(d, owned)
             if new_rs is None:
                 return
+        new_rs = self._ensure_revision(d, new_rs, old_rss)
         if d.spec.strategy.type == "Recreate":
             self._rollout_recreate(d, new_rs, old_rss)
         else:
             self._rollout_rolling(d, new_rs, old_rss)
+        self._cleanup_history(d, new_rs, old_rss)
         self._sync_status(d, new_rs, old_rss)
 
     def _owned_replica_sets(self, d: Deployment) -> List[ReplicaSet]:
@@ -181,6 +185,62 @@ class DeploymentController(Controller):
             # backoff instead of silently forgetting the key
             return self.rs_informer.indexer.get_by_key(
                 f"{d.metadata.namespace}/{rs.metadata.name}")
+
+    @staticmethod
+    def revision_of(obj) -> int:
+        try:
+            return int(obj.metadata.annotations.get(REVISION_ANN, "0"))
+        except ValueError:
+            return 0
+
+    def _ensure_revision(self, d: Deployment, new_rs: ReplicaSet,
+                         old_rss: List[ReplicaSet]) -> ReplicaSet:
+        """Stamp the revision annotation on the new RS and the deployment
+        (ref: sync.go getNewReplicaSet's SetNewReplicaSetAnnotations): a
+        ROLLBACK re-adopts an old RS as new, which must then take
+        max(old)+1 so history keeps moving forward."""
+        max_old = max([self.revision_of(rs) for rs in old_rss] or [0])
+        cur = self.revision_of(new_rs)
+        if cur <= max_old:
+            target = max_old + 1
+
+            def bump(live):
+                live.metadata.annotations[REVISION_ANN] = str(target)
+                return live
+            new_rs = self.client.replica_sets(
+                new_rs.metadata.namespace).patch(new_rs.metadata.name, bump)
+        if d.metadata.annotations.get(REVISION_ANN) != \
+                new_rs.metadata.annotations.get(REVISION_ANN):
+            rev = new_rs.metadata.annotations.get(REVISION_ANN, "1")
+
+            def ann(live):
+                live.metadata.annotations[REVISION_ANN] = rev
+                return live
+            try:
+                self.client.deployments(d.metadata.namespace).patch(
+                    d.metadata.name, ann)
+            except Exception:
+                pass
+        return new_rs
+
+    def _cleanup_history(self, d: Deployment, new_rs: ReplicaSet,
+                         old_rss: List[ReplicaSet]) -> None:
+        """Ref: sync.go cleanupDeployment — drop empty old RSes beyond
+        revisionHistoryLimit (oldest revisions first)."""
+        limit = d.spec.revision_history_limit
+        if limit is None:
+            limit = 10  # the reference's default
+        empties = [rs for rs in old_rss
+                   if rs.spec.replicas == 0 and rs.status.replicas == 0
+                   and rs.metadata.deletion_timestamp is None]
+        excess = sorted(empties, key=self.revision_of)[
+            :max(0, len(empties) - limit)]
+        for rs in excess:
+            try:
+                self.client.replica_sets(rs.metadata.namespace).delete(
+                    rs.metadata.name)
+            except Exception:
+                pass
 
     def _scale_rs(self, rs: ReplicaSet, replicas: int) -> ReplicaSet:
         """Returns the patched copy; `rs` (a frozen canonical store object)
@@ -277,11 +337,20 @@ class DeploymentController(Controller):
         # reported as observed with stale counts (rollout waiters check
         # observedGeneration >= generation)
         observed = d.metadata.generation
+        complete = (updated >= d.spec.replicas
+                    and available >= d.spec.replicas
+                    and replicas == updated)
+        want_reason, want_status = self._desired_progress(d, complete)
+        cur_cond = next((c for c in st.conditions
+                         if c.type == "Progressing"), None)
+        cond_fresh = cur_cond is not None and \
+            (cur_cond.reason, cur_cond.status) == (want_reason, want_status)
         if (st.replicas == replicas and st.updated_replicas == updated
                 and st.ready_replicas == ready
                 and st.available_replicas == available
-                and st.observed_generation == observed):
+                and st.observed_generation == observed and cond_fresh):
             return
+
         def mutate(cur):
             cur.status.replicas = replicas
             cur.status.updated_replicas = updated
@@ -291,9 +360,62 @@ class DeploymentController(Controller):
                 0, cur.spec.replicas - available)
             cur.status.observed_generation = max(
                 cur.status.observed_generation, observed)
+            self._progress_condition(cur, complete)
             return cur
         try:
             self.client.deployments(d.metadata.namespace).patch(
                 d.metadata.name, mutate)
         except Exception:
             pass
+        if not complete and d.spec.progress_deadline_seconds is not None \
+                and want_reason == "ReplicaSetUpdated":
+            # the deadline can only be OBSERVED by a sync; with no event
+            # due, schedule one just past the deadline so a fully stalled
+            # rollout still flips to ProgressDeadlineExceeded
+            self.enqueue_after(d.metadata.key(),
+                               d.spec.progress_deadline_seconds + 1)
+
+    def _desired_progress(self, d: Deployment,
+                          complete: bool) -> Tuple[str, str]:
+        """What the Progressing condition should read right now (ref:
+        progress.go syncRolloutStatus: NewRSAvailable when complete,
+        ProgressDeadlineExceeded when lastUpdateTime stalls past
+        progressDeadlineSeconds)."""
+        import time as _time
+
+        from ..utils.clock import parse_iso
+        if complete:
+            return "NewReplicaSetAvailable", "True"
+        cond = next((c for c in d.status.conditions
+                     if c.type == "Progressing"), None)
+        if cond is not None and cond.reason == "ProgressDeadlineExceeded":
+            # exceeded is sticky until the rollout actually completes
+            # (flipping back on the fresh transition stamp would oscillate)
+            return "ProgressDeadlineExceeded", "False"
+        deadline = d.spec.progress_deadline_seconds
+        if deadline is not None and cond is not None and \
+                cond.reason != "NewReplicaSetAvailable":
+            t = parse_iso(cond.last_update_time or "")
+            if t is not None and _time.time() - t > deadline:
+                return "ProgressDeadlineExceeded", "False"
+        return "ReplicaSetUpdated", "True"
+
+    def _progress_condition(self, d: Deployment, complete: bool) -> None:
+        from ..api.apps import DeploymentCondition
+        from ..utils.clock import now_iso
+        cond = next((c for c in d.status.conditions
+                     if c.type == "Progressing"), None)
+        reason, status = self._desired_progress(d, complete)
+        if cond is None:
+            d.status.conditions.append(DeploymentCondition(
+                type="Progressing", status=status, reason=reason,
+                last_update_time=now_iso(),
+                last_transition_time=now_iso()))
+            return
+        # lastUpdateTime moves only when the rollout makes PROGRESS
+        # (reason/status change or completion) — it is the deadline clock
+        if (cond.reason, cond.status) != (reason, status):
+            cond.last_update_time = now_iso()
+            cond.last_transition_time = now_iso()
+            cond.reason = reason
+            cond.status = status

@@ -21,6 +21,17 @@ from ..state.informer import EventHandlers, SharedInformerFactory
 from .base import Controller
 from .replicaset import pod_is_active, pod_is_ready
 
+#: ref: apps.ControllerRevisionHashLabelKey
+REVISION_LABEL = "controller-revision-hash"
+
+
+def revision_hash(tmpl) -> str:
+    """Stable short hash of the pod template (the ControllerRevision
+    analog — our revisions are content-addressed, not stored objects)."""
+    import hashlib
+    return hashlib.sha256(
+        serde.to_json_str(tmpl).encode()).hexdigest()[:10]
+
 
 def ordinal_of(set_name: str, pod_name: str) -> Optional[int]:
     m = re.fullmatch(re.escape(set_name) + r"-(\d+)", pod_name)
@@ -77,21 +88,63 @@ class StatefulSetController(Controller):
             return
         # scale up / replace: lowest missing ordinal; OrderedReady waits for
         # every predecessor to be Running/Ready first
+        created = False
         for o in range(replicas):
             if o in owned:
                 if ordered and not pod_is_ready(owned[o]):
                     break  # wait for this ordinal before creating the next
                 continue
             self._create_pod(st, o)
+            created = True
             if ordered:
-                break
+                self._update_status(st, owned)
+                return
+        if created:
+            # Parallel mode: the pods just created are not in `owned`, so
+            # the rolling update's all-ready gate would not see them and
+            # could take a SECOND pod down in the same sync
+            self._update_status(st, owned)
+            return
+        self._rolling_update(st, owned)
         self._update_status(st, owned)
+
+    def _rolling_update(self, st: StatefulSet, owned: Dict[int, Pod]) -> None:
+        """Template-change rollout (ref: stateful_set_control.go
+        updateStatefulSet's update phase): RollingUpdate deletes stale
+        pods HIGHEST ordinal first, one at a time, only while every pod
+        is ready — and never below spec.updateStrategy.rollingUpdate.
+        partition (the canary mechanism). OnDelete leaves stale pods for
+        the operator. Divergence from the reference: revisions here are
+        content-addressed labels, not stored ControllerRevision objects —
+        the partition blocks UPDATES (deletions of stale pods), but an
+        ordinal below the partition that dies is recreated on the CURRENT
+        template (the reference recreates from the old revision)."""
+        strategy = st.spec.update_strategy or {}
+        if strategy.get("type", "RollingUpdate") != "RollingUpdate":
+            return
+        partition = int((strategy.get("rollingUpdate") or {})
+                        .get("partition", 0) or 0)
+        cur_rev = revision_hash(st.spec.template)
+        stale = [o for o, p in owned.items()
+                 if o >= partition and
+                 p.metadata.labels.get(REVISION_LABEL, "") != cur_rev]
+        if not stale:
+            return
+        if not all(pod_is_ready(p) for p in owned.values()):
+            return  # one disruption at a time; wait for the fleet
+        victim = owned[max(stale)]
+        try:
+            self.client.pods(st.metadata.namespace).delete(
+                victim.metadata.name)
+        except Exception:
+            pass
 
     def _create_pod(self, st: StatefulSet, ordinal: int) -> None:
         name = f"{st.metadata.name}-{ordinal}"
         tmpl = st.spec.template
         labels = dict(tmpl.metadata.labels)
         labels["statefulset.kubernetes.io/pod-name"] = name
+        labels[REVISION_LABEL] = revision_hash(tmpl)
         spec = serde.deepcopy_obj(tmpl.spec)
         spec.hostname = name
         spec.subdomain = st.spec.service_name

@@ -41,7 +41,7 @@ class NodeAgent:
                  labels: Optional[Dict[str, str]] = None,
                  runtime: Optional[ContainerRuntime] = None,
                  heartbeat_period: float = 10.0,
-                 pleg_period: float = 1.0):
+                 pleg_period: float = 1.0, eviction=None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity or DEFAULT_CAPACITY)
@@ -59,6 +59,12 @@ class NodeAgent:
         self._threads = []
         #: pod uid -> last written (phase, ready) to suppress no-op writes
         self._reported: Dict[str, tuple] = {}
+        from .eviction import EvictionManager
+        from .prober import ProbeManager
+        #: liveness/readiness probe workers (ref: pkg/kubelet/prober)
+        self.prober = ProbeManager(self.runtime)
+        #: node-pressure eviction; disabled until a signal source is set
+        self.eviction = eviction or EvictionManager()
 
     def _on_pod_event(self, pod: Pod) -> None:
         if pod.spec.node_name == self.node_name:
@@ -120,22 +126,58 @@ class NodeAgent:
     def heartbeat(self) -> None:
         """Refresh the Ready condition's heartbeat (monitorNodeHealth's
         staleness input) + the node lease."""
+        pressure = self.eviction.under_pressure()
+
         def beat(cur):
+            seen = set()
             for cond in cur.status.conditions:
                 if cond.type == "Ready":
                     cond.status = "True"
                     cond.reason = "KubeletReady"
                     cond.last_heartbeat_time = now_iso()
-                    return cur
-            cur.status.conditions.append(NodeCondition(
-                type="Ready", status="True", reason="KubeletReady",
-                last_heartbeat_time=now_iso()))
+                    seen.add("Ready")
+                elif cond.type == "MemoryPressure":
+                    cond.status = "True" if pressure else "False"
+                    cond.reason = "KubeletHasInsufficientMemory" \
+                        if pressure else "KubeletHasSufficientMemory"
+                    cond.last_heartbeat_time = now_iso()
+                    seen.add("MemoryPressure")
+            if "Ready" not in seen:
+                cur.status.conditions.append(NodeCondition(
+                    type="Ready", status="True", reason="KubeletReady",
+                    last_heartbeat_time=now_iso()))
+            if "MemoryPressure" not in seen:
+                cur.status.conditions.append(NodeCondition(
+                    type="MemoryPressure",
+                    status="True" if pressure else "False",
+                    last_heartbeat_time=now_iso()))
             return cur
         try:
             self.client.nodes().patch(self.node_name, beat)
         except Exception:
             pass
         self._renew_lease()
+        self._maybe_evict()
+
+    def _maybe_evict(self) -> None:
+        """One eviction per heartbeat under pressure (ref:
+        eviction_manager.go synchronize evicting at most one pod)."""
+        if not self.eviction.under_pressure():
+            return  # the common case pays zero pod/sandbox scanning
+        sandbox_uids = {sb.pod_uid for sb in self.runtime.list_sandboxes()}
+        my_pods = [p for p in self.pod_informer.indexer.by_index(
+                       "nodeName", self.node_name)
+                   if p.metadata.uid in sandbox_uids]
+        victim = self.eviction.maybe_evict(my_pods)
+        if victim is None:
+            return
+        # the kubelet marks the pod Failed/Evicted and kills it; the
+        # owning controller replaces it elsewhere
+        self._write_status(victim, "Failed", ready=False,
+                           reason="Evicted")
+        self.runtime.stop_pod_sandbox(victim.metadata.uid)
+        self.prober.forget(victim.metadata.uid)
+        self._reported.pop(victim.metadata.uid, None)
 
     # ---------------------------------------------------------- pod sync
 
@@ -149,10 +191,12 @@ class NodeAgent:
             uid = self._uid_for(key, pod)
             if uid is not None:
                 self.runtime.stop_pod_sandbox(uid)
+                self.prober.forget(uid)
                 self._reported.pop(uid, None)
             return
         if helpers.pod_is_terminal(pod):
             self.runtime.stop_pod_sandbox(pod.metadata.uid)
+            self.prober.forget(pod.metadata.uid)
             self._reported.pop(pod.metadata.uid, None)
             return
         sb = self.runtime.pod_sandbox(pod.metadata.uid)
@@ -175,7 +219,9 @@ class NodeAgent:
 
     def pleg_relist(self) -> None:
         """Ref: pleg/generic.go:188 — diff runtime container states and
-        surface exits as pod status (the Job completion path)."""
+        surface exits as pod status (the Job completion path), then drive
+        the probe workers (prober results feed the Ready condition;
+        liveness failures restart containers)."""
         if hasattr(self.runtime, "tick"):
             self.runtime.tick()
         for sb in self.runtime.list_sandboxes():
@@ -186,20 +232,39 @@ class NodeAgent:
                     f"{sb.namespace}/{sb.name}")
                 if pod is None or pod.metadata.uid != sb.pod_uid:
                     self.runtime.stop_pod_sandbox(sb.pod_uid)
+                    self.prober.forget(sb.pod_uid)
                     continue
                 failed = any((c.exit_code or 0) != 0
                              for c in sb.containers.values())
                 phase = "Failed" if failed else "Succeeded"
                 self._write_status(pod, phase, ready=False)
                 self.runtime.stop_pod_sandbox(sb.pod_uid)
+                self.prober.forget(sb.pod_uid)
                 # terminal pods never report again; drop the suppressor
                 # entry or a kubemark churn run leaks one per pod uid
                 self._reported.pop(sb.pod_uid, None)
+                continue
+            pod = self.pod_informer.indexer.get_by_key(
+                f"{sb.namespace}/{sb.name}")
+            if pod is None or pod.metadata.uid != sb.pod_uid or \
+                    not any(c.liveness_probe or c.readiness_probe
+                            for c in pod.spec.containers):
+                continue
+            ready, to_restart = self.prober.evaluate(pod)
+            for cname in to_restart:
+                if hasattr(self.runtime, "restart_container"):
+                    self.runtime.restart_container(sb.pod_uid, cname)
+                    self.prober.reset_container(sb.pod_uid, cname)
+            self._write_status(pod, "Running", ready=ready)
 
-    def _write_status(self, pod: Pod, phase: str, ready: bool) -> None:
+    def _write_status(self, pod: Pod, phase: str, ready: bool,
+                      reason: str = "") -> None:
         uid = pod.metadata.uid
         if self._reported.get(uid) == (phase, ready):
             return
+        sb = self.runtime.pod_sandbox(uid)
+        restarts = {name: cs.restarts
+                    for name, cs in (sb.containers.items() if sb else ())}
         import hashlib
 
         def stable_ip(seed: str, prefix: str) -> str:
@@ -220,9 +285,12 @@ class NodeAgent:
             cur.status.pod_ip = stable_ip(cur.metadata.uid, "10.128")
             if cur.status.start_time is None:
                 cur.status.start_time = now_iso()
+            if reason:
+                cur.status.reason = reason
             cur.status.container_statuses = [
                 ContainerStatus(name=c.name, ready=ready,
-                                restart_count=0, image=c.image)
+                                restart_count=restarts.get(c.name, 0),
+                                image=c.image)
                 for c in cur.spec.containers]
             status = "True" if ready else "False"
             for cond in cur.status.conditions:

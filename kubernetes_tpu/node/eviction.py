@@ -1,0 +1,72 @@
+"""Eviction manager — node-pressure pod eviction.
+
+Ref: pkg/kubelet/eviction (eviction_manager.go synchronize :231 — observe
+signals, compare thresholds, rank and evict one pod per loop). The signal
+source is pluggable (`memory_available_fn`): real kubelets read cgroups;
+hollow nodes script the pressure. Ranking is the reference's memory
+ordering: pods EXCEEDING their requests first (by overage), then
+BestEffort, by usage (ref: rankMemoryPressure + qos comparators).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import helpers
+from ..api.core import Pod
+
+
+#: the tree's one GetPodQOS (api/helpers) under the local name
+qos_class = helpers.pod_qos
+
+
+class EvictionManager:
+    """One node's eviction loop body. The agent calls maybe_evict() on its
+    heartbeat cadence with the pods it runs; the manager decides whether
+    pressure exists and which single pod to kill this round (the
+    reference also evicts at most one per synchronize)."""
+
+    def __init__(self,
+                 memory_available_fn: Optional[Callable[[], int]] = None,
+                 memory_threshold: int = 100 << 20,
+                 usage_fn: Optional[Callable[[Pod], int]] = None):
+        #: None disables eviction (no signal source — default for hollow)
+        self.memory_available_fn = memory_available_fn
+        self.memory_threshold = memory_threshold
+        #: bytes of memory a pod uses; defaults to its requests (the only
+        #: number a fake runtime has)
+        self.usage_fn = usage_fn or (
+            lambda p: helpers.pod_requests(p).get("memory", 0))
+
+    def under_pressure(self) -> bool:
+        if self.memory_available_fn is None:
+            return False
+        return self.memory_available_fn() < self.memory_threshold
+
+    def pick_victim(self, pods: List[Pod]) -> Optional[Pod]:
+        """The memory ranking: usage-over-request overage first, then
+        BestEffort, then largest usage (ref: rankMemoryPressure)."""
+        candidates = [p for p in pods
+                      if p.status.phase not in ("Succeeded", "Failed")
+                      and p.metadata.deletion_timestamp is None]
+        if not candidates:
+            return None
+
+        def rank(p: Pod) -> Tuple:
+            usage = self.usage_fn(p)
+            req = helpers.pod_requests(p).get("memory", 0)
+            overage = max(0, usage - req)
+            qos = qos_class(p)
+            return (
+                -overage,                      # biggest overage first
+                0 if qos == "BestEffort" else
+                (1 if qos == "Burstable" else 2),
+                -usage,                        # then biggest consumer
+                helpers.pod_priority(p),       # lowest priority first
+            )
+        return sorted(candidates, key=rank)[0]
+
+    def maybe_evict(self, pods: List[Pod]) -> Optional[Pod]:
+        if not self.under_pressure():
+            return None
+        return self.pick_victim(pods)

@@ -1,0 +1,126 @@
+"""Probe manager — liveness/readiness probing for the node agent.
+
+Ref: pkg/kubelet/prober (prober.Manager, worker.go's per-container probe
+workers with initialDelay/period/thresholds; results feed the status
+manager's Ready condition, liveness failures restart the container).
+
+Probe execution is pluggable: the CRI boundary here is descriptor-based
+(v1.Probe's exec/httpGet/tcpSocket collapsed to `handler` strings), so
+hollow clusters script outcomes deterministically:
+
+    ""                  always succeeds
+    "always-fail"       always fails
+    "fail-after:N"      succeeds until N seconds after container start
+    "succeed-after:N"   fails until N seconds after container start
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from ..api.core import Pod, Probe
+
+
+def run_probe(handler: str, started_at: float, now: float) -> bool:
+    if not handler:
+        return True
+    if handler == "always-fail":
+        return False
+    kind, _, arg = handler.partition(":")
+    if kind == "fail-after":
+        return now - started_at < float(arg)
+    if kind == "succeed-after":
+        return now - started_at >= float(arg)
+    return True
+
+
+@dataclass
+class _WorkerState:
+    """Per (pod uid, container, probe-kind) thresholds accounting
+    (ref: prober/worker.go resultRun)."""
+    successes: int = 0
+    failures: int = 0
+    result: bool = True  # readiness starts unready in the reference; the
+    #                      caller seeds it per probe kind
+    last_probe: float = 0.0
+
+
+class ProbeManager:
+    """Drives every probed container on one node; returns aggregate
+    decisions to the agent's sync loop."""
+
+    def __init__(self, runtime, clock=time):
+        self.runtime = runtime
+        self.clock = clock
+        self._state: Dict[Tuple[str, str, str], _WorkerState] = {}
+
+    def _probe_once(self, kind: str, uid: str, cname: str, probe: Probe,
+                    started_at: float) -> bool:
+        """One threshold-aware evaluation; returns the CURRENT smoothed
+        result for this probe."""
+        key = (uid, cname, kind)
+        st = self._state.get(key)
+        if st is None:
+            # liveness assumes alive until proven dead; readiness assumes
+            # unready until proven ready (ref: worker.go initial results)
+            st = self._state[key] = _WorkerState(
+                result=(kind == "liveness"))
+        now = self.clock.time()
+        if now - started_at < probe.initial_delay_seconds:
+            return st.result
+        if now - st.last_probe < probe.period_seconds:
+            return st.result
+        st.last_probe = now
+        ok = run_probe(probe.handler, started_at, now)
+        if ok:
+            st.successes += 1
+            st.failures = 0
+            if st.successes >= probe.success_threshold:
+                st.result = True
+        else:
+            st.failures += 1
+            st.successes = 0
+            if st.failures >= probe.failure_threshold:
+                st.result = False
+        return st.result
+
+    def evaluate(self, pod: Pod):
+        """Probe every container of a running pod once (called from the
+        agent's PLEG cadence). Returns (all_ready, to_restart) where
+        to_restart is the list of container names whose liveness failed."""
+        sb = self.runtime.pod_sandbox(pod.metadata.uid)
+        if sb is None:
+            return True, []
+        all_ready = True
+        to_restart = []
+        for c in pod.spec.containers:
+            cs = sb.containers.get(c.name)
+            if cs is None or cs.state != "running":
+                all_ready = False
+                continue
+            started = cs.started_at or self.clock.time()
+            if c.liveness_probe is not None:
+                alive = self._probe_once("liveness", pod.metadata.uid,
+                                         c.name, c.liveness_probe, started)
+                if not alive:
+                    to_restart.append(c.name)
+                    all_ready = False
+                    continue
+            if c.readiness_probe is not None:
+                ready = self._probe_once("readiness", pod.metadata.uid,
+                                         c.name, c.readiness_probe,
+                                         started)
+                if not ready:
+                    all_ready = False
+        return all_ready, to_restart
+
+    def forget(self, uid: str) -> None:
+        for key in [k for k in self._state if k[0] == uid]:
+            del self._state[key]
+
+    def reset_container(self, uid: str, cname: str) -> None:
+        """A restarted container starts its probe history over."""
+        for kind in ("liveness", "readiness"):
+            self._state.pop((uid, cname, kind), None)

@@ -30,6 +30,7 @@ class ContainerStatusInfo:
     exit_code: Optional[int] = None
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
+    restarts: int = 0
 
 
 @dataclass
@@ -109,6 +110,22 @@ class FakeRuntime(ContainerRuntime):
                         cs.state = "exited"
                         cs.exit_code = 0
                         cs.finished_at = now
+
+    def restart_container(self, pod_uid: str, name: str) -> None:
+        """Kill + recreate one container (the liveness-failure path;
+        ref: kuberuntime killContainer + the next SyncPod start)."""
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+            if sb is None:
+                return
+            cs = sb.containers.get(name)
+            if cs is None:
+                return
+            cs.state = "running"
+            cs.started_at = time.time()
+            cs.exit_code = None
+            cs.finished_at = None
+            cs.restarts += 1
 
     def stop_pod_sandbox(self, pod_uid: str) -> None:
         with self._lock:

@@ -553,27 +553,9 @@ def default_max_volume_count_predicates(pvc_lister=None, pv_lister=None
     }
 
 
-def _pod_qos(pod: Pod) -> str:
-    """Ref: pkg/apis/core/v1/helper/qos.GetPodQOS."""
-    requests: Dict[str, int] = {}
-    limits: Dict[str, int] = {}
-    guaranteed = True
-    for c in pod.spec.containers:
-        for name, q in c.resources.requests.items():
-            if name in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY):
-                requests[name] = requests.get(name, 0) + q.value()
-        for name, q in c.resources.limits.items():
-            if name in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY):
-                limits[name] = limits.get(name, 0) + q.value()
-        cl = {n for n in c.resources.limits
-              if n in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY)}
-        if cl != {wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY}:
-            guaranteed = False
-    if not requests and not limits:
-        return "BestEffort"
-    if guaranteed and requests == limits:
-        return "Guaranteed"
-    return "Burstable"
+#: canonical GetPodQOS lives in api/helpers (shared with admission and
+#: kubelet eviction); the old name stays for in-package callers
+_pod_qos = helpers.pod_qos
 
 
 def _pressure_taint(key: str):

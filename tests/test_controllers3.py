@@ -201,3 +201,261 @@ class TestCronJobController:
             assert wait_for(pruned, timeout=20)
         finally:
             mgr.stop()
+
+
+def make_deployment(name, replicas, labels, image="img:v1"):
+    tmpl = api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(
+            name="app", image=image)]))
+    return api.Deployment(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.DeploymentSpec(
+            replicas=replicas,
+            selector=api.LabelSelector(match_labels=dict(labels)),
+            template=tmpl))
+
+
+class TestDeploymentDepth:
+    def test_revision_history_and_rollback(self):
+        """Rollouts stamp revisions; kubectl rollout undo restores the
+        previous template and the re-adopted RS takes a NEW revision."""
+        import time as _t
+
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.cmd import kubectl
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.controllers.deployment import REVISION_ANN
+        srv = APIServer().start()
+        client = HTTPClient(srv.address)
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.deployments("default").create(make_deployment(
+                "web", 2, {"app": "web"}, image="img:v1"))
+
+            def wait_rs(n):
+                deadline = _t.time() + 15
+                while _t.time() < deadline:
+                    rss = [rs for rs in
+                           client.replica_sets("default").list()]
+                    if len(rss) >= n:
+                        return rss
+                    _t.sleep(0.1)
+                raise AssertionError(f"never saw {n} replicasets")
+            wait_rs(1)
+            # roll to v2
+            client.deployments("default").merge_patch(
+                "web", {"spec": {"template": {"spec": {"containers": [
+                    {"name": "app", "image": "img:v2"}]}}}})
+            rss = wait_rs(2)
+            deadline = _t.time() + 15
+            while _t.time() < deadline:
+                d = client.deployments("default").get("web")
+                if d.metadata.annotations.get(REVISION_ANN) == "2":
+                    break
+                _t.sleep(0.1)
+            assert client.deployments("default").get("web") \
+                .metadata.annotations[REVISION_ANN] == "2"
+            # history shows both revisions; undo restores v1
+            assert kubectl.main(["-s", srv.address, "rollout", "history",
+                                 "deployment", "web"]) == 0
+            assert kubectl.main(["-s", srv.address, "rollout", "undo",
+                                 "deployment", "web"]) == 0
+            deadline = _t.time() + 15
+            while _t.time() < deadline:
+                d = client.deployments("default").get("web")
+                if d.spec.template.spec.containers[0].image == "img:v1" \
+                        and d.metadata.annotations.get(REVISION_ANN) == "3":
+                    break
+                _t.sleep(0.1)
+            d = client.deployments("default").get("web")
+            assert d.spec.template.spec.containers[0].image == "img:v1"
+            assert d.metadata.annotations[REVISION_ANN] == "3"
+        finally:
+            mgr.stop()
+            srv.stop()
+
+    def test_progress_deadline_condition(self):
+        """A rollout that cannot progress flips Progressing to
+        ProgressDeadlineExceeded after the deadline."""
+        import time as _t
+        from kubernetes_tpu.controllers.deployment import \
+            DeploymentController
+        from kubernetes_tpu.state import Client, SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        dc = DeploymentController(client, informers)
+        d = make_deployment("stuck", 2, {"app": "s"})
+        d.spec.progress_deadline_seconds = 0  # immediate deadline
+        client.deployments("default").create(d)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            dc.sync("default/stuck")  # creates the RS, stamps Progressing
+            _t.sleep(0.05)
+            # no pods ever become available; deadline (0s) passes
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                dc.sync("default/stuck")
+                live = client.deployments("default").get("stuck")
+                cond = next((c for c in live.status.conditions
+                             if c.type == "Progressing"), None)
+                if cond is not None and \
+                        cond.reason == "ProgressDeadlineExceeded":
+                    break
+                _t.sleep(0.1)
+            assert cond is not None
+            assert cond.reason == "ProgressDeadlineExceeded"
+            assert cond.status == "False"
+        finally:
+            informers.stop()
+
+
+class TestStatefulSetPartition:
+    def test_partitioned_rolling_update(self):
+        """Only ordinals >= partition roll to the new template (canary);
+        lowering the partition rolls the rest."""
+        import time as _t
+
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.controllers import ControllerManager
+        from kubernetes_tpu.controllers.statefulset import (REVISION_LABEL,
+                                                            revision_hash)
+        srv = APIServer().start()
+        client = HTTPClient(srv.address)
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            st = api.StatefulSet(
+                metadata=api.ObjectMeta(name="db", namespace="default"),
+                spec=api.StatefulSetSpec(
+                    replicas=3, service_name="db",
+                    selector=api.LabelSelector(match_labels={"app": "db"}),
+                    update_strategy={"type": "RollingUpdate",
+                                     "rollingUpdate": {"partition": 2}},
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "db"}),
+                        spec=api.PodSpec(containers=[api.Container(
+                            name="c", image="img:v1")]))))
+            client.stateful_sets("default").create(st)
+
+            def all_pods_ready():
+                pods = {p.metadata.name: p
+                        for p in client.pods("default").list()}
+                for i in range(3):
+                    p = pods.get(f"db-{i}")
+                    if p is None:
+                        return False
+                    # mark ready like a kubelet would
+                    if not any(c.type == "Ready" and c.status == "True"
+                               for c in p.status.conditions):
+                        p.status.phase = "Running"
+                        p.status.conditions = [api.PodCondition(
+                            type="Ready", status="True")]
+                        client.pods("default").update_status(p)
+                        return False
+                return True
+            deadline = _t.time() + 20
+            while _t.time() < deadline and not all_pods_ready():
+                _t.sleep(0.1)
+            assert all_pods_ready()
+            # roll to v2, partition=2: only db-2 updates
+            live = client.stateful_sets("default").get("db")
+            live.spec.template.spec.containers[0].image = "img:v2"
+            client.stateful_sets("default").update(live)
+            v2 = revision_hash(live.spec.template)
+
+            def revs():
+                return {p.metadata.name:
+                        p.metadata.labels.get(REVISION_LABEL, "")
+                        for p in client.pods("default").list()}
+            deadline = _t.time() + 25
+            while _t.time() < deadline:
+                all_pods_ready()
+                r = revs()
+                if r.get("db-2") == v2 and r.get("db-1") and \
+                        r.get("db-1") != v2 and r.get("db-0") and \
+                        r.get("db-0") != v2 and len(r) == 3:
+                    break
+                _t.sleep(0.1)
+            r = revs()
+            assert r.get("db-2") == v2, r
+            assert r.get("db-1") != v2 and r.get("db-0") != v2, r
+            # drop the partition: everything rolls
+            client.stateful_sets("default").merge_patch(
+                "db", {"spec": {"updateStrategy": {
+                    "type": "RollingUpdate",
+                    "rollingUpdate": {"partition": 0}}}}, strategic=False)
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                all_pods_ready()
+                r = revs()
+                if len(r) == 3 and all(v == v2 for v in r.values()):
+                    break
+                _t.sleep(0.1)
+            assert all(v == v2 for v in revs().values()), revs()
+        finally:
+            mgr.stop()
+            srv.stop()
+
+
+class TestCronJobBackstop:
+    def test_missed_run_fires_within_deadline(self):
+        """A schedule minute that passed while the controller was down
+        fires as a catch-up when within startingDeadlineSeconds."""
+        from kubernetes_tpu.controllers.cronjob import CronJobController
+        from kubernetes_tpu.state import Client, SharedInformerFactory
+        # park a fake clock mid-minute at a NON-schedule minute: 17 min
+        # past a 20-minute-aligned epoch (1_000_000 is 13:46:40 UTC; pick
+        # an absolute minute not divisible by 5)
+        base = (1_000_000 // 300) * 300 + 7 * 60 + 30  # minute % 5 == 2
+        clock = FakeClock(start=base)
+        client = Client()
+        informers = SharedInformerFactory(client)
+        from datetime import datetime, timezone
+        created = datetime.fromtimestamp(
+            base - 600, tz=timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+        cj = api.CronJob(
+            # creation predates the missed window: a catch-up never fires
+            # for schedule minutes before the object existed
+            metadata=api.ObjectMeta(name="tick", namespace="default",
+                                    creation_timestamp=created),
+            spec=api.CronJobSpec(
+                schedule="*/5 * * * *",  # every 5th minute
+                starting_deadline_seconds=3600,
+                job_template={"spec": {"template": {"spec": {
+                    "containers": [{"name": "c", "image": "i"}]}}}}))
+        client.resource(api.CronJob, "default").create(cj)
+        ctrl = CronJobController(client, informers, clock=clock)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            live = informers.informer_for(api.CronJob) \
+                .indexer.get_by_key("default/tick")
+            ctrl.sync_one(live)
+            jobs = client.jobs("default").list()
+            assert len(jobs) == 1  # the missed 5-minute mark fired
+            # a fresh CronJob created NOW does not fire for minutes that
+            # predate it
+            cj2 = api.CronJob(
+                metadata=api.ObjectMeta(name="fresh", namespace="default"),
+                spec=api.CronJobSpec(
+                    schedule="*/5 * * * *",
+                    starting_deadline_seconds=3600,
+                    job_template={"spec": {"template": {"spec": {
+                        "containers": [{"name": "c", "image": "i"}]}}}}))
+            # store stamps creation with REAL wall time (2026), far after
+            # the fake clock — so the floor suppresses any catch-up
+            client.resource(api.CronJob, "default").create(cj2)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                fresh = informers.informer_for(api.CronJob) \
+                    .indexer.get_by_key("default/fresh")
+                if fresh is not None:
+                    break
+                time.sleep(0.02)
+            ctrl.sync_one(fresh)
+            assert len(client.jobs("default").list()) == 1  # no new job
+        finally:
+            informers.stop()

@@ -311,3 +311,113 @@ class TestDensity:
                     except Exception:
                         pass
             srv.stop()
+
+
+class TestProber:
+    def _agent_with_pod(self, handler_field, probe):
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        agent = NodeAgent(client, "n1", informers, pleg_period=0.05)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                name="c", image="i", **{handler_field: probe})]))
+        created = client.pods("default").create(pod)
+        informers.start()
+        informers.wait_for_cache_sync()
+        return client, informers, agent, created
+
+    def test_readiness_failure_unreadies_pod(self):
+        client, informers, agent, pod = self._agent_with_pod(
+            "readiness_probe",
+            api.Probe(handler="always-fail", period_seconds=0,
+                      failure_threshold=1))
+        try:
+            agent.register()
+            agent.sync_pod("default/p")
+            agent.pleg_relist()
+            live = client.pods("default").get("p")
+            assert live.status.phase == "Running"
+            ready = next(c.status for c in live.status.conditions
+                         if c.type == "Ready")
+            assert ready == "False"
+        finally:
+            informers.stop()
+
+    def test_liveness_failure_restarts_container(self):
+        import time as _t
+        client, informers, agent, pod = self._agent_with_pod(
+            "liveness_probe",
+            api.Probe(handler="fail-after:0.1", period_seconds=0,
+                      failure_threshold=1))
+        try:
+            agent.register()
+            agent.sync_pod("default/p")
+            _t.sleep(0.15)
+            agent.pleg_relist()   # liveness fails -> restart
+            agent.pleg_relist()   # fresh container alive again
+            live = client.pods("default").get("p")
+            assert live.status.container_statuses[0].restart_count >= 1
+            sb = agent.runtime.pod_sandbox(pod.metadata.uid)
+            assert sb.containers["c"].restarts >= 1
+            assert sb.containers["c"].state == "running"
+        finally:
+            informers.stop()
+
+
+class TestEviction:
+    def test_pressure_evicts_besteffort_first(self):
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.node.eviction import EvictionManager
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        available = [50 << 20]  # below the 100Mi threshold
+        agent = NodeAgent(client, "n1", informers,
+                          eviction=EvictionManager(
+                              memory_available_fn=lambda: available[0]))
+        guaranteed = api.Pod(
+            metadata=api.ObjectMeta(name="g", namespace="default"),
+            spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("100m"),
+                              "memory": Quantity("128Mi")},
+                    limits={"cpu": Quantity("100m"),
+                            "memory": Quantity("128Mi")}))]))
+        besteffort = api.Pod(
+            metadata=api.ObjectMeta(name="be", namespace="default"),
+            spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                name="c", image="i")]))
+        client.pods("default").create(guaranteed)
+        client.pods("default").create(besteffort)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            agent.register()
+            agent.sync_pod("default/g")
+            agent.sync_pod("default/be")
+            agent.heartbeat()  # under pressure: evicts ONE pod
+            live_be = client.pods("default").get("be")
+            live_g = client.pods("default").get("g")
+            assert live_be.status.phase == "Failed"
+            assert live_be.status.reason == "Evicted"
+            assert live_g.status.phase == "Running"
+            # node reports MemoryPressure for the scheduler's filters
+            node = client.nodes().get("n1")
+            mp = next(c.status for c in node.status.conditions
+                      if c.type == "MemoryPressure")
+            assert mp == "True"
+            # pressure relieved: condition clears, guaranteed pod survives
+            available[0] = 500 << 20
+            agent.heartbeat()
+            node = client.nodes().get("n1")
+            mp = next(c.status for c in node.status.conditions
+                      if c.type == "MemoryPressure")
+            assert mp == "False"
+            assert client.pods("default").get("g").status.phase == \
+                "Running"
+        finally:
+            informers.stop()
