@@ -308,6 +308,12 @@ class NodeSystemInfo:
 
 
 @dataclass
+class AttachedVolume:
+    name: str = ""         # "kubernetes.io/<plugin>/<volume-name>"
+    device_path: str = ""
+
+
+@dataclass
 class NodeStatus:
     capacity: Dict[str, Quantity] = field(default_factory=dict)
     allocatable: Dict[str, Quantity] = field(default_factory=dict)
@@ -316,6 +322,8 @@ class NodeStatus:
     addresses: List[dict] = field(default_factory=list)
     node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
     images: List[ContainerImage] = field(default_factory=list)
+    volumes_attached: List[AttachedVolume] = field(default_factory=list)
+    volumes_in_use: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -1,0 +1,47 @@
+"""hyperkube — every component behind one entry point.
+
+Ref: cmd/hyperkube (the all-in-one multiplexer binary). Usage:
+
+    python -m kubernetes_tpu.cmd.hyperkube <component> [args...]
+
+where component is one of: kube-apiserver, kube-scheduler,
+kube-controller-manager, kube-proxy, kubectl, kubeadm.
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMPONENTS = {
+    "kube-apiserver": "kube_apiserver",
+    "apiserver": "kube_apiserver",
+    "kube-scheduler": "kube_scheduler",
+    "scheduler": "kube_scheduler",
+    "kube-controller-manager": "kube_controller_manager",
+    "controller-manager": "kube_controller_manager",
+    "kube-proxy": "kube_proxy",
+    "proxy": "kube_proxy",
+    "kubectl": "kubectl",
+    "kubeadm": "kubeadm",
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: hyperkube <component> [args...]\n"
+              f"components: {', '.join(sorted(set(COMPONENTS)))}")
+        return 0 if argv else 1
+    name = argv[0]
+    mod_name = COMPONENTS.get(name)
+    if mod_name is None:
+        print(f"unknown component {name!r}; one of "
+              f"{', '.join(sorted(set(COMPONENTS)))}", file=sys.stderr)
+        return 1
+    import importlib
+    mod = importlib.import_module(f".{mod_name}", package=__package__)
+    return mod.main(argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,14 +24,31 @@ def main(argv=None) -> int:
     p.add_argument("--node-monitor-period", type=float, default=5.0)
     p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
     p.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    p.add_argument("--cluster-signing-cert-file", default=None,
+                   help="cluster CA certificate for the CSR signer")
+    p.add_argument("--cluster-signing-key-file", default=None)
+    p.add_argument("--kubeconfig-token", default=None,
+                   help="bearer token for a secured master")
+    p.add_argument("--certificate-authority", default=None,
+                   help="CA file pinning an https master")
     args = p.parse_args(argv)
+    if bool(args.cluster_signing_cert_file) != \
+            bool(args.cluster_signing_key_file):
+        p.error("--cluster-signing-cert-file and "
+                "--cluster-signing-key-file must be given together")
 
-    client = HTTPClient(args.master)
+    client = HTTPClient(args.master, token=args.kubeconfig_token,
+                        ca_file=args.certificate_authority)
+    cluster_ca = None
+    if args.cluster_signing_cert_file:
+        cluster_ca = (open(args.cluster_signing_cert_file, "rb").read(),
+                      open(args.cluster_signing_key_file, "rb").read())
     mgr = ControllerManager(
         client,
         node_monitor_period=args.node_monitor_period,
         node_grace_period=args.node_monitor_grace_period,
-        pod_eviction_timeout=args.pod_eviction_timeout)
+        pod_eviction_timeout=args.pod_eviction_timeout,
+        cluster_ca=cluster_ca)
     stop = threading.Event()
 
     def shutdown(*_):

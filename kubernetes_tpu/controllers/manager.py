@@ -23,6 +23,8 @@ from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import HorizontalController, MetricsClient
 from .podgc import PodGCController
 from .certificates import CSRApprovingController, CSRSigningController
+from .misc import (AttachDetachController, RootCACertPublisher,
+                   TTLController)
 from .clusterroleaggregation import ClusterRoleAggregationController
 from .nodeipam import NodeIpamController
 from .replicaset import ReplicaSetController
@@ -80,11 +82,15 @@ class ControllerManager:
         self.pv_protection = PVProtectionController(client, self.informers)
         # the CSR pair needs the cluster CA keypair (cert_pem, key_pem);
         # without one the cluster simply serves no certificate signing
-        self.csrapproving = self.csrsigning = None
+        self.ttl = TTLController(client, self.informers)
+        self.attachdetach = AttachDetachController(client, self.informers)
+        self.csrapproving = self.csrsigning = self.root_ca_publisher = None
         if cluster_ca is not None:
             self.csrapproving = CSRApprovingController(client, self.informers)
             self.csrsigning = CSRSigningController(
                 client, self.informers, cluster_ca[0], cluster_ca[1])
+            self.root_ca_publisher = RootCACertPublisher(
+                client, self.informers, cluster_ca[0])
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -97,9 +103,11 @@ class ControllerManager:
             self.garbagecollector, self.podgc, self.disruption,
             self.resourcequota, self.podautoscaler, self.serviceaccount,
             self.clusterrole_aggregation, self.nodeipam,
-            self.pvc_protection, self.pv_protection]
+            self.pvc_protection, self.pv_protection, self.ttl,
+            self.attachdetach]
         if self.csrapproving is not None:
-            self.controllers += [self.csrapproving, self.csrsigning]
+            self.controllers += [self.csrapproving, self.csrsigning,
+                                 self.root_ca_publisher]
 
     def start(self) -> None:
         self.informers.start()

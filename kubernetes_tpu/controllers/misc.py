@@ -1,0 +1,196 @@
+"""Small reconcilers: TTL, root-CA publisher, attach/detach.
+
+Ref:
+  pkg/controller/ttl/ttl_controller.go — stamps every node with the
+  annotation controllers use to decide how long kubelets may cache
+  secrets/configmaps; the TTL scales with cluster size.
+  pkg/controller/certificates/rootcacertpublisher — copies the cluster CA
+  bundle into a kube-root-ca.crt ConfigMap in every namespace so
+  workloads can verify the apiserver.
+  pkg/controller/volume/attachdetach — reconciles which PV-backed volumes
+  are attached to which node from the pods scheduled there
+  (desired-state-of-world vs actual), surfacing node.status.volumesAttached.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..api.core import (AttachedVolume, ConfigMap, Namespace, Node,
+                        PersistentVolumeClaim, Pod)
+from ..api.meta import ObjectMeta
+from ..state.informer import EventHandlers, SharedInformerFactory
+from ..state.store import AlreadyExistsError, NotFoundError
+from .base import Controller
+
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+
+#: cluster-size -> seconds (ref: ttl_controller.go ttlBoundaries)
+TTL_BOUNDARIES = ((100, 0), (500, 15), (1000, 30), (5000, 60), (None, 300))
+
+ROOT_CA_CONFIGMAP = "kube-root-ca.crt"
+
+
+class TTLController(Controller):
+    name = "ttl"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.node_informer = informers.informer_for(Node)
+        self.node_informer.add_event_handlers(EventHandlers(
+            on_add=lambda n: self.enqueue(n.metadata.name),
+            on_update=lambda o, n: self.enqueue(n.metadata.name),
+            # size-bucket flips re-stamp everyone
+            on_delete=lambda n: [self.enqueue(m.metadata.name) for m in
+                                 self.node_informer.indexer.list(None)]))
+
+    def _desired_ttl(self) -> int:
+        n = len(self.node_informer.indexer.list(None))
+        for bound, ttl in TTL_BOUNDARIES:
+            if bound is None or n <= bound:
+                return ttl
+        return 300
+
+    def sync(self, key: str) -> None:
+        node = self.node_informer.indexer.get_by_key(key)
+        if node is None:
+            return
+        want = str(self._desired_ttl())
+        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
+            return
+
+        def mutate(cur):
+            cur.metadata.annotations[TTL_ANNOTATION] = want
+            return cur
+        try:
+            self.client.nodes().patch(key, mutate)
+        except NotFoundError:
+            pass
+
+
+class RootCACertPublisher(Controller):
+    name = "root-ca-cert-publisher"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 ca_cert_pem: bytes, workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.ca = ca_cert_pem.decode()
+        self.ns_informer = informers.informer_for(Namespace)
+        self.cm_informer = informers.informer_for(ConfigMap)
+        self.ns_informer.add_event_handlers(EventHandlers(
+            on_add=lambda ns: self.enqueue(ns.metadata.name),
+            on_update=lambda o, n: self.enqueue(n.metadata.name)))
+        self.cm_informer.add_event_handlers(EventHandlers(
+            on_delete=self._on_cm_delete,
+            on_update=lambda o, n: self._on_cm_delete(n)))
+
+    def _on_cm_delete(self, cm: ConfigMap) -> None:
+        if cm.metadata.name == ROOT_CA_CONFIGMAP:
+            self.enqueue(cm.metadata.namespace)
+
+    def sync(self, key: str) -> None:
+        ns = self.ns_informer.indexer.get_by_key(key)
+        if ns is None or ns.metadata.deletion_timestamp is not None or \
+                ns.status.phase == "Terminating":
+            return
+        rc = self.client.config_maps(key)
+        try:
+            cur = rc.get(ROOT_CA_CONFIGMAP, namespace=key)
+            if cur.data.get("ca.crt") == self.ca:
+                return
+
+            def mutate(live):
+                live.data["ca.crt"] = self.ca
+                return live
+            rc.patch(ROOT_CA_CONFIGMAP, mutate, namespace=key)
+        except NotFoundError:
+            try:
+                rc.create(ConfigMap(
+                    metadata=ObjectMeta(name=ROOT_CA_CONFIGMAP,
+                                        namespace=key),
+                    data={"ca.crt": self.ca}))
+            except (AlreadyExistsError, NotFoundError):
+                pass
+
+
+class AttachDetachController(Controller):
+    """Desired-state reconciler for node-attached volumes: every PV
+    backing a PVC mounted by a pod scheduled on a node should appear in
+    that node's status.volumesAttached; volumes no one uses detach.
+    (Our runtime has no real attach operations — the reconciled API state
+    IS the actuation, like the rest of the hollow dataplane.)"""
+
+    name = "attachdetach"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pod_informer = informers.informer_for(Pod)
+        self.pvc_informer = informers.informer_for(PersistentVolumeClaim)
+        self.node_informer = informers.informer_for(Node)
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pod,
+            on_update=lambda o, n: self._on_pod(n),
+            on_delete=self._on_pod))
+        # a PVC binding later (volume_name set by the PV binder) must
+        # re-reconcile the nodes of its consumers, and a node appearing
+        # after its pods' events must not stay un-synced forever
+        self.pvc_informer.add_event_handlers(EventHandlers(
+            on_add=self._on_pvc,
+            on_update=lambda o, n: self._on_pvc(n)))
+        self.node_informer.add_event_handlers(EventHandlers(
+            on_add=lambda n: self.enqueue(n.metadata.name)))
+
+    def _on_pod(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.enqueue(pod.spec.node_name)
+
+    def _on_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        for pod in self.pod_informer.indexer.list(pvc.metadata.namespace):
+            if pod.spec.node_name and any(
+                    v.persistent_volume_claim is not None and
+                    v.persistent_volume_claim.claim_name ==
+                    pvc.metadata.name
+                    for v in pod.spec.volumes):
+                self.enqueue(pod.spec.node_name)
+
+    def _desired(self, node_name: str) -> List[str]:
+        """PV names that should be attached, from the pods on the node."""
+        out: Set[str] = set()
+        for pod in self.pod_informer.indexer.by_index("nodeName",
+                                                      node_name):
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            for v in pod.spec.volumes:
+                if v.persistent_volume_claim is None:
+                    continue
+                pvc = self.pvc_informer.indexer.get_by_key(
+                    f"{pod.metadata.namespace}/"
+                    f"{v.persistent_volume_claim.claim_name}")
+                if pvc is not None and pvc.spec.volume_name:
+                    out.add(pvc.spec.volume_name)
+        return sorted(out)
+
+    def sync(self, key: str) -> None:
+        node = self.node_informer.indexer.get_by_key(key)
+        if node is None:
+            return
+        want = self._desired(key)
+        have = sorted(av.name for av in node.status.volumes_attached)
+        if want == have:
+            return
+
+        def mutate(cur):
+            cur.status.volumes_attached = [
+                AttachedVolume(name=n, device_path=f"/dev/disk/{n}")
+                for n in want]
+            cur.status.volumes_in_use = list(want)
+            return cur
+        try:
+            self.client.nodes().patch(key, mutate)
+        except NotFoundError:
+            pass

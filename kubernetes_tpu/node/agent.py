@@ -41,7 +41,8 @@ class NodeAgent:
                  labels: Optional[Dict[str, str]] = None,
                  runtime: Optional[ContainerRuntime] = None,
                  heartbeat_period: float = 10.0,
-                 pleg_period: float = 1.0, eviction=None):
+                 pleg_period: float = 1.0, eviction=None,
+                 static_pod_dir=None, serve_port=None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity or DEFAULT_CAPACITY)
@@ -65,6 +66,16 @@ class NodeAgent:
         self.prober = ProbeManager(self.runtime)
         #: node-pressure eviction; disabled until a signal source is set
         self.eviction = eviction or EvictionManager()
+        #: static-pod manifests (ref: kubelet config/file source); mirror
+        #: pods are published to the apiserver with the config.mirror
+        #: annotation so the control plane can SEE them
+        self.static_pod_dir = static_pod_dir
+        #: manifest file -> (mirror name, namespace, content hash)
+        self._static_state: Dict[str, tuple] = {}
+        #: kubelet HTTP endpoint (/pods, /healthz, /metrics,
+        #: /containerLogs) when a port is given (0 = ephemeral)
+        self.server = None
+        self._serve_port = serve_port
 
     def _on_pod_event(self, pod: Pod) -> None:
         if pod.spec.node_name == self.node_name:
@@ -312,8 +323,80 @@ class NodeAgent:
 
     # --------------------------------------------------------------- run
 
+    MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+    def sync_static_pods(self) -> None:
+        """File-source pods (ref: kubelet config/file.go + the mirror-pod
+        manager): each manifest becomes a mirror pod named <name>-<node>
+        pinned to this node; the normal sync loop then runs it. A CHANGED
+        manifest deletes and recreates its mirror; a REMOVED manifest
+        deletes it. Steady state issues no API writes (content hashes are
+        tracked per file)."""
+        if not self.static_pod_dir:
+            return
+        import hashlib
+        import json as _json
+        import os
+
+        from ..runtime.scheme import SCHEME
+        from ..state.store import AlreadyExistsError
+        try:
+            entries = sorted(os.listdir(self.static_pod_dir))
+        except OSError:
+            return
+        seen = set()
+        for fname in entries:
+            if not fname.endswith(".json"):
+                continue
+            seen.add(fname)
+            path = os.path.join(self.static_pod_dir, fname)
+            try:
+                raw = open(path, "rb").read()
+                digest = hashlib.sha256(raw).hexdigest()
+                prev = self._static_state.get(fname)
+                if prev is not None and prev[2] == digest:
+                    continue  # unchanged: no API traffic
+                pod = SCHEME.decode_any(_json.loads(raw))
+                if getattr(pod, "kind", "") != "Pod":
+                    continue
+                pod.metadata.name = f"{pod.metadata.name}-{self.node_name}"
+                ns = pod.metadata.namespace or "default"
+                pod.metadata.namespace = ns
+                pod.metadata.annotations[self.MIRROR_ANNOTATION] = digest
+                pod.spec.node_name = self.node_name
+                if prev is not None:
+                    # changed manifest: the reference deletes the mirror
+                    # and recreates from the new spec
+                    self._delete_mirror(prev)
+                try:
+                    self.client.pods(ns).create(pod)
+                except AlreadyExistsError:
+                    # pre-existing from a prior process life with the SAME
+                    # content? adopt; different content: replace
+                    cur = self.client.pods(ns).get(pod.metadata.name)
+                    if cur.metadata.annotations.get(
+                            self.MIRROR_ANNOTATION) != digest:
+                        self._delete_mirror((pod.metadata.name, ns, ""))
+                        self.client.pods(ns).create(pod)
+                self._static_state[fname] = (pod.metadata.name, ns, digest)
+            except Exception:
+                traceback.print_exc()  # malformed manifest or API reject
+        for fname in [f for f in self._static_state if f not in seen]:
+            self._delete_mirror(self._static_state.pop(fname))
+
+    def _delete_mirror(self, state) -> None:
+        name, ns, _ = state
+        try:
+            self.client.pods(ns).delete(name)
+        except Exception:
+            pass
+
     def start(self) -> None:
         self.register()
+        self.sync_static_pods()
+        if self._serve_port is not None:
+            from .server import KubeletServer
+            self.server = KubeletServer(self, port=self._serve_port).start()
         for pod in self.pod_informer.indexer.by_index("nodeName",
                                                       self.node_name):
             self.queue.add(pod.metadata.key())
@@ -345,6 +428,7 @@ class NodeAgent:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_period):
             self.heartbeat()
+            self.sync_static_pods()  # re-scan the manifest dir
 
     def _pleg_loop(self) -> None:
         while not self._stop.wait(self.pleg_period):
@@ -354,6 +438,8 @@ class NodeAgent:
                 traceback.print_exc()
 
     def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
         self._stop.set()
         self.queue.shutdown()
         for t in self._threads:

@@ -1,0 +1,105 @@
+"""Kubelet HTTP server — the node's introspection endpoint.
+
+Ref: pkg/kubelet/server/server.go (4,553 LoC): /pods, /healthz,
+/containerLogs/{ns}/{pod}/{container}, /metrics. Exec/attach/portforward
+need a real runtime and are out of scope for the hollow dataplane; logs
+come from the FakeRuntime's synthetic account of each container.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..api import serde
+
+
+class KubeletServer:
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0):
+        self.agent = agent
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                outer._get(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "KubeletServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"kubelet-http-{self.agent.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -------------------------------------------------------------- routes
+
+    def _get(self, h) -> None:
+        path = h.path.split("?")[0]  # every route ignores query params
+        parts = [p for p in path.split("/") if p]
+        if path == "/healthz":
+            self._raw(h, 200, b"ok", "text/plain")
+        elif path == "/pods":
+            pods = self.agent.pod_informer.indexer.by_index(
+                "nodeName", self.agent.node_name)
+            body = {"apiVersion": "v1", "kind": "PodList",
+                    "items": [serde.encode(p) for p in pods]}
+            self._raw(h, 200, json.dumps(body).encode(),
+                      "application/json")
+        elif path == "/metrics":
+            rt = self.agent.runtime
+            lines = [
+                "# TYPE kubelet_running_pods gauge",
+                f"kubelet_running_pods "
+                f"{len(rt.list_sandboxes())}",
+                "# TYPE kubelet_started_pods_total counter",
+                f"kubelet_started_pods_total "
+                f"{getattr(rt, 'started_count', 0)}",
+                "# TYPE kubelet_stopped_pods_total counter",
+                f"kubelet_stopped_pods_total "
+                f"{getattr(rt, 'stopped_count', 0)}",
+            ]
+            self._raw(h, 200, ("\n".join(lines) + "\n").encode(),
+                      "text/plain")
+        elif len(parts) == 4 and parts[0] == "containerLogs":
+            _, ns, pod_name, cname = parts
+            pod = self.agent.pod_informer.indexer.get_by_key(
+                f"{ns}/{pod_name}")
+            sb = self.agent.runtime.pod_sandbox(pod.metadata.uid) \
+                if pod is not None else None
+            cs = sb.containers.get(cname) if sb is not None else None
+            if cs is None:
+                self._raw(h, 404, b"container not found", "text/plain")
+                return
+            log = (f"{cname} state={cs.state} restarts={cs.restarts} "
+                   f"started_at={cs.started_at}\n")
+            self._raw(h, 200, log.encode(), "text/plain")
+        else:
+            self._raw(h, 404, b"not found", "text/plain")
+
+    def _raw(self, h, code: int, body: bytes, ctype: str) -> None:
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)

@@ -421,3 +421,156 @@ class TestEviction:
                 "Running"
         finally:
             informers.stop()
+
+
+class TestKubeletServerAndStaticPods:
+    def test_kubelet_http_endpoint(self):
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.state import SharedInformerFactory
+        import urllib.request, json as _json
+        client = Client()
+        informers = SharedInformerFactory(client)
+        agent = NodeAgent(client, "n1", informers, serve_port=0)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                name="c", image="i")]))
+        client.pods("default").create(pod)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            agent.register()
+            agent.sync_pod("default/p")
+            agent.start()
+            base = agent.server.address
+            assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+            pods = _json.loads(urllib.request.urlopen(
+                f"{base}/pods").read())
+            assert [p["metadata"]["name"] for p in pods["items"]] == ["p"]
+            metrics = urllib.request.urlopen(f"{base}/metrics").read()
+            assert b"kubelet_running_pods 1" in metrics
+            logs = urllib.request.urlopen(
+                f"{base}/containerLogs/default/p/c").read()
+            assert b"state=running" in logs
+        finally:
+            agent.stop()
+            informers.stop()
+
+    def test_static_pods_become_mirror_pods(self, tmp_path):
+        import json as _json
+
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.state import SharedInformerFactory
+        (tmp_path / "etcd.json").write_text(_json.dumps({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "etcd", "namespace": "kube-system"},
+            "spec": {"containers": [{"name": "etcd", "image": "etcd:3"}]}}))
+        client = Client()
+        informers = SharedInformerFactory(client)
+        agent = NodeAgent(client, "cp-1", informers,
+                          static_pod_dir=str(tmp_path), pleg_period=0.05)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            agent.register()
+            agent.start()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    mirror = client.pods("kube-system").get("etcd-cp-1")
+                    if mirror.status.phase == "Running":
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            mirror = client.pods("kube-system").get("etcd-cp-1")
+            assert mirror.spec.node_name == "cp-1"
+            assert "kubernetes.io/config.mirror" in \
+                mirror.metadata.annotations
+            assert mirror.status.phase == "Running"
+            # manifest CHANGE replaces the mirror with the new spec
+            (tmp_path / "etcd.json").write_text(_json.dumps({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "etcd", "namespace": "kube-system"},
+                "spec": {"containers": [
+                    {"name": "etcd", "image": "etcd:4"}]}}))
+            agent.sync_static_pods()
+            mirror = client.pods("kube-system").get("etcd-cp-1")
+            assert mirror.spec.containers[0].image == "etcd:4"
+            # manifest REMOVAL deletes the mirror
+            (tmp_path / "etcd.json").unlink()
+            agent.sync_static_pods()
+            from kubernetes_tpu.state.store import NotFoundError
+            import pytest as _pytest
+            with _pytest.raises(NotFoundError):
+                client.pods("kube-system").get("etcd-cp-1")
+        finally:
+            agent.stop()
+            informers.stop()
+
+
+class TestMiscControllers:
+    def test_ttl_and_attachdetach(self):
+        from kubernetes_tpu.controllers.misc import (AttachDetachController,
+                                                     TTL_ANNOTATION,
+                                                     TTLController)
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        ttl = TTLController(client, informers)
+        ad = AttachDetachController(client, informers)
+        client.nodes().create(api.Node(metadata=api.ObjectMeta(name="n1")))
+        client.persistent_volume_claims("default").create(
+            api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name="data", namespace="default"),
+                spec=api.PersistentVolumeClaimSpec(volume_name="pv-7")))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(node_name="n1", containers=[api.Container(
+                name="c", image="i")],
+                volumes=[api.Volume(
+                    name="v",
+                    persistent_volume_claim=
+                    api.PersistentVolumeClaimVolumeSource(
+                        claim_name="data"))]))
+        client.pods("default").create(pod)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            ttl.sync("n1")
+            ad.sync("n1")
+            node = client.nodes().get("n1")
+            assert node.metadata.annotations[TTL_ANNOTATION] == "0"
+            assert [v.name for v in node.status.volumes_attached] == \
+                ["pv-7"]
+            # pod goes away -> volume detaches
+            client.pods("default").delete("p")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if not ad.pod_informer.indexer.list("default"):
+                    break
+                time.sleep(0.02)
+            ad.sync("n1")
+            assert client.nodes().get("n1").status.volumes_attached == []
+        finally:
+            informers.stop()
+
+    def test_root_ca_published_to_namespaces(self):
+        from kubernetes_tpu.controllers.misc import (ROOT_CA_CONFIGMAP,
+                                                     RootCACertPublisher)
+        from kubernetes_tpu.state import SharedInformerFactory
+        from kubernetes_tpu.utils import certs
+        client = Client()
+        informers = SharedInformerFactory(client)
+        ca_cert, _ = certs.new_ca()
+        pub = RootCACertPublisher(client, informers, ca_cert)
+        client.namespaces().create(api.Namespace(
+            metadata=api.ObjectMeta(name="team")))
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            pub.sync("team")
+            cm = client.config_maps("team").get(ROOT_CA_CONFIGMAP)
+            assert cm.data["ca.crt"] == ca_cert.decode()
+        finally:
+            informers.stop()
