@@ -39,12 +39,23 @@ class TTLController(Controller):
         super().__init__(workers)
         self.client = client
         self.node_informer = informers.informer_for(Node)
+        self._last_ttl = None
         self.node_informer.add_event_handlers(EventHandlers(
-            on_add=lambda n: self.enqueue(n.metadata.name),
+            on_add=lambda n: self._on_membership(n.metadata.name),
             on_update=lambda o, n: self.enqueue(n.metadata.name),
-            # size-bucket flips re-stamp everyone
-            on_delete=lambda n: [self.enqueue(m.metadata.name) for m in
-                                 self.node_informer.indexer.list(None)]))
+            on_delete=lambda n: self._on_membership(None)))
+
+    def _on_membership(self, added: str) -> None:
+        """Cluster size changed: re-stamp EVERY node only when the ttl
+        BUCKET flipped (a blanket re-enqueue per delete would be O(n²)
+        during a scale-down)."""
+        ttl = self._desired_ttl()
+        if ttl != self._last_ttl:
+            self._last_ttl = ttl
+            for m in self.node_informer.indexer.list(None):
+                self.enqueue(m.metadata.name)
+        elif added is not None:
+            self.enqueue(added)
 
     def _desired_ttl(self) -> int:
         n = len(self.node_informer.indexer.list(None))

@@ -10,21 +10,30 @@ from __future__ import annotations
 
 from typing import List, Type
 
-from ..api.apps import DaemonSet, Deployment, ReplicaSet, StatefulSet
-from ..api.batch import CronJob, Job
-from ..api.core import (Endpoints, Event, Namespace,
-                        PersistentVolumeClaim, Pod, ReplicationController,
-                        Service)
-from ..api.policy import PodDisruptionBudget
+from ..api.core import Namespace
 from ..state.informer import EventHandlers, SharedInformerFactory
 from .base import Controller
 
-#: namespaced kinds emptied before finalization (ref: the discovery-driven
-#: group deletion in deletion/namespaced_resources_deleter.go)
-NAMESPACED_KINDS: List[Type] = [
-    Deployment, StatefulSet, DaemonSet, CronJob, Job, ReplicaSet,
-    ReplicationController, Pod, Service, Endpoints, PersistentVolumeClaim,
-    PodDisruptionBudget, Event]
+#: workload kinds drained FIRST so their controllers stop recreating the
+#: pods the sweep is deleting (the reference's deleter has no ordering —
+#: it retries until empty — but draining owners first converges faster)
+_OWNERS_FIRST = ("deployments", "statefulsets", "daemonsets", "cronjobs",
+                 "jobs", "replicasets", "replicationcontrollers")
+
+
+def namespaced_kinds() -> List[Type]:
+    """Every namespaced kind the scheme serves, discovery-style (ref:
+    deletion/namespaced_resources_deleter.go walking discovery) — a fixed
+    list would leak newly registered kinds incl. dynamic CRs."""
+    from ..api.core import Binding
+    from ..runtime.scheme import SCHEME
+    owners, rest = [], []
+    for resource in SCHEME.resources():
+        cls = SCHEME.type_for_resource(resource)
+        if cls is None or cls is Binding or not SCHEME.is_namespaced(cls):
+            continue  # Binding is virtual (never stored)
+        (owners if resource in _OWNERS_FIRST else rest).append(cls)
+    return owners + rest
 
 
 class NamespaceController(Controller):
@@ -53,7 +62,7 @@ class NamespaceController(Controller):
             except Exception:
                 pass
         remaining = 0
-        for cls in NAMESPACED_KINDS:
+        for cls in namespaced_kinds():
             rc = self.client.resource(cls, name)
             for obj in rc.list(namespace=name):
                 remaining += 1

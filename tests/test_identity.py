@@ -355,3 +355,49 @@ class TestKubectlRollout:
                                  "api-resources"]) == 0
         finally:
             mgr.stop()
+
+
+class TestNamespaceCleanupCoversAllKinds:
+    def test_terminating_namespace_drains_new_kinds(self, server):
+        """Namespace deletion must clean configmaps/secrets/quotas/roles —
+        a fixed kind list would leak every newly added type."""
+        from kubernetes_tpu.controllers import ControllerManager
+        client = HTTPClient(server.address)
+        mgr = ControllerManager(client)
+        mgr.start()
+        try:
+            client.namespaces().create(api.Namespace(
+                metadata=api.ObjectMeta(name="doomed")))
+            client.config_maps("doomed").create(api.ConfigMap(
+                metadata=api.ObjectMeta(name="cfg", namespace="doomed"),
+                data={"k": "v"}))
+            client.secrets("doomed").create(api.Secret(
+                metadata=api.ObjectMeta(name="sec", namespace="doomed"),
+                string_data={"t": "x"}))
+            client.roles("doomed").create(api.Role(
+                metadata=api.ObjectMeta(name="r", namespace="doomed"),
+                rules=[api.RBACPolicyRule(verbs=["get"],
+                                          resources=["pods"])]))
+            client.namespaces().delete("doomed")
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    client.namespaces().get("doomed")
+                except Exception:
+                    break  # fully gone
+                time.sleep(0.1)
+            else:
+                ns = client.namespaces().get("doomed")
+                raise AssertionError(
+                    f"namespace stuck in {ns.status.phase}")
+            from kubernetes_tpu.state.store import NotFoundError
+            for get in (lambda: client.config_maps("doomed").get(
+                            "cfg", namespace="doomed"),
+                        lambda: client.secrets("doomed").get(
+                            "sec", namespace="doomed"),
+                        lambda: client.roles("doomed").get(
+                            "r", namespace="doomed")):
+                with pytest.raises(NotFoundError):
+                    get()
+        finally:
+            mgr.stop()
