@@ -23,8 +23,8 @@ from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import HorizontalController, MetricsClient
 from .podgc import PodGCController
 from .certificates import CSRApprovingController, CSRSigningController
-from .misc import (AttachDetachController, RootCACertPublisher,
-                   TTLController)
+from .misc import (AttachDetachController, PVExpanderController,
+                   RootCACertPublisher, TTLController)
 from .clusterroleaggregation import ClusterRoleAggregationController
 from .nodeipam import NodeIpamController
 from .replicaset import ReplicaSetController
@@ -84,6 +84,7 @@ class ControllerManager:
         # without one the cluster simply serves no certificate signing
         self.ttl = TTLController(client, self.informers)
         self.attachdetach = AttachDetachController(client, self.informers)
+        self.pv_expander = PVExpanderController(client, self.informers)
         self.csrapproving = self.csrsigning = self.root_ca_publisher = None
         if cluster_ca is not None:
             self.csrapproving = CSRApprovingController(client, self.informers)
@@ -104,7 +105,7 @@ class ControllerManager:
             self.resourcequota, self.podautoscaler, self.serviceaccount,
             self.clusterrole_aggregation, self.nodeipam,
             self.pvc_protection, self.pv_protection, self.ttl,
-            self.attachdetach]
+            self.attachdetach, self.pv_expander]
         if self.csrapproving is not None:
             self.controllers += [self.csrapproving, self.csrsigning,
                                  self.root_ca_publisher]

@@ -205,3 +205,66 @@ class AttachDetachController(Controller):
             self.client.nodes().patch(key, mutate)
         except NotFoundError:
             pass
+
+
+class PVExpanderController(Controller):
+    """Volume expansion (ref: pkg/controller/volume/expand
+    expand_controller.go): a bound PVC whose requested storage grew past
+    its recorded capacity expands the backing PV and then the claim's
+    status — with no real storage backend, the API reconciliation IS the
+    resize, like the rest of the hollow dataplane. Shrinks are rejected
+    by the reference's validation; here they are simply ignored."""
+
+    name = "pv-expander"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pvc_informer = informers.informer_for(PersistentVolumeClaim)
+        self.pvc_informer.add_event_handlers(EventHandlers(
+            on_add=lambda c: self.enqueue(c.metadata.key()),
+            on_update=lambda o, n: self.enqueue(n.metadata.key())))
+
+    def sync(self, key: str) -> None:
+        pvc = self.pvc_informer.indexer.get_by_key(key)
+        if pvc is None or pvc.status.phase != "Bound" or \
+                not pvc.spec.volume_name:
+            return
+        want = pvc.spec.resources.requests.get("storage")
+        if want is None:
+            return
+        ns, name = key.split("/", 1)
+        try:
+            pv = self.client.persistent_volumes().get(pvc.spec.volume_name)
+        except NotFoundError:
+            return
+        pv_cap = pv.spec.capacity.get("storage")
+        if pv_cap is None or pv_cap < want:
+            # only a REAL growth patches the PV — an unconditional patch
+            # would bump its rv and wake every PV watcher per bound claim
+
+            def grow_pv(cur):
+                if cur.spec.capacity.get("storage") is None or \
+                        cur.spec.capacity["storage"] < want:
+                    cur.spec.capacity["storage"] = want
+                return cur
+            try:
+                pv = self.client.persistent_volumes().patch(
+                    pvc.spec.volume_name, grow_pv)
+            except NotFoundError:
+                return
+            pv_cap = pv.spec.capacity.get("storage")
+        # a bound claim reports the PV's actual size (the reference stamps
+        # status.capacity from the volume, which may exceed the request)
+        if pvc.status.capacity.get("storage") == pv_cap:
+            return
+
+        def stamp_claim(cur):
+            cur.status.capacity["storage"] = pv_cap
+            return cur
+        try:
+            self.client.persistent_volume_claims(ns).patch(
+                name, stamp_claim, namespace=ns)
+        except NotFoundError:
+            pass

@@ -212,6 +212,16 @@ class NodeAgent:
             return
         sb = self.runtime.pod_sandbox(pod.metadata.uid)
         if sb is None:
+            # volume sources gate container CREATION only (ref:
+            # kuberuntime's CreateContainerConfigError) — a ref deleted
+            # under an already-running pod never demotes it, and running
+            # pods pay no per-sync API reads
+            missing = self._missing_volume_refs(pod)
+            if missing:
+                self._write_status(pod, "Pending", ready=False,
+                                   reason="CreateContainerConfigError")
+                raise RuntimeError(
+                    f"pod {key} waiting for volume sources: {missing}")
             sb = self.runtime.run_pod_sandbox(pod)
             self.runtime.start_containers(sb, pod)
         # status write runs on EVERY sync, not only sandbox creation — the
@@ -219,6 +229,25 @@ class NodeAgent:
         # (patch conflicts under a density burst) must retry through the
         # workqueue instead of leaving the pod Pending forever
         self._write_status(pod, "Running", ready=True)
+
+    def _missing_volume_refs(self, pod: Pod) -> list:
+        """ConfigMap/Secret names the pod mounts that do not exist yet
+        (the volumemanager's resolution step, hollow-sized)."""
+        out = []
+        ns = pod.metadata.namespace
+        for v in pod.spec.volumes:
+            try:
+                if v.config_map is not None:
+                    name = v.config_map.get("name", "")
+                    if name and not v.config_map.get("optional"):
+                        self.client.config_maps(ns).get(name, namespace=ns)
+                elif v.secret is not None:
+                    name = v.secret.get("secretName", "")
+                    if name and not v.secret.get("optional"):
+                        self.client.secrets(ns).get(name, namespace=ns)
+            except NotFoundError:
+                out.append(v.name)
+        return out
 
     def _uid_for(self, key: str, pod: Optional[Pod]) -> Optional[str]:
         if pod is not None:
@@ -296,8 +325,7 @@ class NodeAgent:
             cur.status.pod_ip = stable_ip(cur.metadata.uid, "10.128")
             if cur.status.start_time is None:
                 cur.status.start_time = now_iso()
-            if reason:
-                cur.status.reason = reason
+            cur.status.reason = reason  # empty CLEARS a stale error
             cur.status.container_statuses = [
                 ContainerStatus(name=c.name, ready=ready,
                                 restart_count=restarts.get(c.name, 0),

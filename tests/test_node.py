@@ -574,3 +574,116 @@ class TestMiscControllers:
             assert cm.data["ca.crt"] == ca_cert.decode()
         finally:
             informers.stop()
+
+
+class TestVolumeSourceResolution:
+    def test_pod_waits_for_configmap_then_runs(self):
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        agent = NodeAgent(client, "n1", informers)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(
+                node_name="n1",
+                containers=[api.Container(name="c", image="i")],
+                volumes=[api.Volume(name="cfg",
+                                    config_map={"name": "app-config"})]))
+        client.pods("default").create(pod)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            agent.register()
+            import pytest as _pytest
+            with _pytest.raises(RuntimeError, match="volume sources"):
+                agent.sync_pod("default/p")
+            live = client.pods("default").get("p")
+            assert live.status.phase == "Pending"
+            assert live.status.reason == "CreateContainerConfigError"
+            # the configmap appears -> the retry starts the pod
+            client.config_maps("default").create(api.ConfigMap(
+                metadata=api.ObjectMeta(name="app-config",
+                                        namespace="default"),
+                data={"k": "v"}))
+            agent.sync_pod("default/p")
+            live = client.pods("default").get("p")
+            assert live.status.phase == "Running"
+            assert live.status.reason == ""  # stale error cleared
+        finally:
+            agent.stop()
+            informers.stop()
+
+
+class TestPVExpander:
+    def test_bound_claim_grows(self):
+        from kubernetes_tpu.controllers.misc import PVExpanderController
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        exp = PVExpanderController(client, informers)
+        client.persistent_volumes().create(api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv-1"),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": Quantity("1Gi")})))
+        pvc = api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="data", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec(
+                volume_name="pv-1",
+                resources=api.ResourceRequirements(
+                    requests={"storage": Quantity("2Gi")})))
+        pvc.status.phase = "Bound"
+        pvc.status.capacity = {"storage": Quantity("1Gi")}
+        client.persistent_volume_claims("default").create(pvc)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            exp.sync("default/data")
+            pv = client.persistent_volumes().get("pv-1")
+            assert pv.spec.capacity["storage"] == Quantity("2Gi")
+            live = client.persistent_volume_claims("default").get("data")
+            assert live.status.capacity["storage"] == Quantity("2Gi")
+        finally:
+            informers.stop()
+
+    def test_oversized_pv_reported_not_expanded(self):
+        """A 1Gi claim bound to a 10Gi PV reports the PV's size — and the
+        reconcile is a no-op on the PV (no rv churn)."""
+        from kubernetes_tpu.controllers.misc import PVExpanderController
+        from kubernetes_tpu.state import SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        exp = PVExpanderController(client, informers)
+        client.persistent_volumes().create(api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv-big"),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": Quantity("10Gi")})))
+        pvc = api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="small", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec(
+                volume_name="pv-big",
+                resources=api.ResourceRequirements(
+                    requests={"storage": Quantity("1Gi")})))
+        pvc.status.phase = "Bound"
+        client.persistent_volume_claims("default").create(pvc)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            rv_before = client.persistent_volumes().get(
+                "pv-big").metadata.resource_version
+            exp.sync("default/small")
+            pv = client.persistent_volumes().get("pv-big")
+            assert pv.metadata.resource_version == rv_before  # no churn
+            live = client.persistent_volume_claims("default").get("small")
+            assert live.status.capacity["storage"] == Quantity("10Gi")
+            # once the informer observes the stamped claim, further syncs
+            # are zero-write
+            assert wait_for(lambda: (exp.pvc_informer.indexer.get_by_key(
+                "default/small").status.capacity.get("storage")
+                == Quantity("10Gi")))
+            rv_claim = live.metadata.resource_version
+            exp.sync("default/small")
+            assert client.persistent_volume_claims("default").get(
+                "small").metadata.resource_version == rv_claim
+        finally:
+            informers.stop()
