@@ -129,10 +129,24 @@ def _ptr_parts(pointer: str) -> List[str]:
             for p in pointer[1:].split("/")]
 
 
+def _list_index(token: str, length: int, insert: bool = False) -> int:
+    """JSON-Pointer array index per RFC 6901: digits only (no sign, so
+    negative indices are rejected), and in range — `length` itself is
+    legal only when inserting. list.insert would otherwise clamp
+    out-of-range adds into silent appends."""
+    if not token.isdigit():
+        raise JSONPatchError(f"invalid array index {token!r}")
+    idx = int(token)
+    if idx > length or (idx == length and not insert):
+        raise JSONPatchError(
+            f"array index {idx} out of range (length {length})")
+    return idx
+
+
 def _ptr_get(doc: Any, parts: List[str]) -> Any:
     for p in parts:
         if isinstance(doc, list):
-            doc = doc[int(p)]
+            doc = doc[_list_index(p, len(doc))]
         elif isinstance(doc, dict):
             if p not in doc:
                 raise JSONPatchError(f"path segment {p!r} not found")
@@ -146,7 +160,8 @@ def _ptr_set(doc: Any, parts: List[str], value: Any, insert: bool) -> None:
     parent = _ptr_get(doc, parts[:-1])
     last = parts[-1]
     if isinstance(parent, list):
-        idx = len(parent) if last == "-" else int(last)
+        idx = len(parent) if last == "-" \
+            else _list_index(last, len(parent), insert=insert)
         if insert:
             parent.insert(idx, value)
         else:
@@ -161,7 +176,7 @@ def _ptr_remove(doc: Any, parts: List[str]) -> Any:
     parent = _ptr_get(doc, parts[:-1])
     last = parts[-1]
     if isinstance(parent, list):
-        return parent.pop(int(last))
+        return parent.pop(_list_index(last, len(parent)))
     if isinstance(parent, dict):
         if last not in parent:
             raise JSONPatchError(f"path segment {last!r} not found")

@@ -80,14 +80,14 @@ def evaluate_usage(resource: str, obj: Any) -> Dict[str, Quantity]:
 
 
 def pod_qos_best_effort(pod: Pod) -> bool:
-    """BestEffort = no container carries any cpu/memory request or limit
-    (ref: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS)."""
-    for c in list(pod.spec.containers) + list(pod.spec.init_containers):
-        for res in (c.resources.requests, c.resources.limits):
-            for name in res:
-                if name in ("cpu", "memory"):
-                    return False
-    return True
+    """BestEffort per the ONE shared classifier (helpers.pod_qos) — quota
+    scope matching must agree with the scheduler predicates and kubelet
+    eviction on what BestEffort means, or the same pod is classed
+    differently per subsystem. Like the reference's GetPodQOS
+    (pkg/apis/core/v1/helper/qos/qos.go:44) this inspects REGULAR
+    containers only; init-container resources do not affect QoS class."""
+    from ..api.helpers import pod_qos
+    return pod_qos(pod) == "BestEffort"
 
 
 def scope_matches(scope: str, pod: Pod) -> bool:
@@ -118,8 +118,25 @@ class ResourceQuotaAdmission:
 
     def __init__(self, client):
         self.client = client
+        # per-thread record of the last request's committed charges so the
+        # server can refund them if storage rejects the create AFTER
+        # admission (AlreadyExists, CRD validation…) — otherwise the
+        # namespace is falsely throttled until the controller's resync
+        import threading
+        self._last = threading.local()
+
+    def refund_last(self) -> None:
+        """Undo the charges committed by the most recent validate() on
+        this thread (called by the server when create fails post-admission)."""
+        rec = getattr(self._last, "rec", None)
+        self._last.rec = None
+        if rec:
+            charged, delta = rec
+            for q, keys in charged:
+                self._refund(q, delta, keys)
 
     def validate(self, operation: str, resource: str, obj: Any) -> None:
+        self._last.rec = None
         if operation != "CREATE" or resource == "resourcequotas":
             return
         ns = getattr(getattr(obj, "metadata", None), "namespace", "")
@@ -153,6 +170,8 @@ class ResourceQuotaAdmission:
                     self._refund(q, delta, keys)
                 raise
             charged.append((quota, interesting))
+        if charged:
+            self._last.rec = (charged, delta)
 
     def _charge(self, quota: ResourceQuota, delta: Dict[str, Quantity],
                 keys: List[str]) -> None:

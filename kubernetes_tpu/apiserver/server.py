@@ -111,8 +111,8 @@ class APIServer:
         sa = ServiceAccountAdmission(self.client)
         self.admission.mutators.append(sa.admit)
         self.admission.validators.append(sa.validate)
-        self.admission.validators.append(
-            ResourceQuotaAdmission(self.client).validate)
+        self._quota = ResourceQuotaAdmission(self.client)
+        self.admission.validators.append(self._quota.validate)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -579,18 +579,35 @@ class APIServer:
                             f"body kind {data.get('kind')} does not match "
                             f"resource {req.resource}")
                 return
+            if req.resource == "certificatesigningrequests":
+                # the requester identity is SERVER-stamped from the
+                # authenticated user; client-supplied values are discarded
+                # UNCONDITIONALLY (ref: pkg/registry/certificates
+                # PrepareForCreate) — the CSR approver's policy keys off
+                # these fields, so an open hub must clear them rather than
+                # let a client forge a node identity into auto-approval
+                obj.spec.username = user.name if user is not None else ""
+                obj.spec.groups = list(user.groups) \
+                    if user is not None else []
             obj = self.admission.admit("CREATE", req.resource, obj)
-            if req.resource == "customresourcedefinitions":
-                # pre-validate WITHOUT registering: a create that fails
-                # after registration would leave a phantom served type
-                from ..runtime.crd import register_crd, validate_crd
-                validate_crd(obj, self.scheme)
+            try:
+                if req.resource == "customresourcedefinitions":
+                    # pre-validate WITHOUT registering: a create that fails
+                    # after registration would leave a phantom served type
+                    from ..runtime.crd import validate_crd
+                    validate_crd(obj, self.scheme)
                 out = rc.create(obj)
+            except Exception:
+                # admission already charged quota for this object; a
+                # failed create must hand the charge back or the
+                # namespace stays falsely throttled until the quota
+                # controller's resync
+                self._quota.refund_last()
+                raise
+            if req.resource == "customresourcedefinitions":
+                from ..runtime.crd import register_crd
                 register_crd(out, self.scheme)
-                self._respond(h, 201, out)
-                return
-            out = rc.create(obj)
-            if req.resource == "namespaces":
+            elif req.resource == "namespaces":
                 self._ensure_default_sa(out.metadata.name)
             self._respond(h, 201, out)
         elif method == "PUT":

@@ -64,8 +64,80 @@ class CSRApprovingController(Controller):
                             "kubelet signer requires CN=system:node:* and "
                             "O=[system:nodes] exactly")
             return
+        # Gate on the RECORDED requester (spec.username/groups, stamped by
+        # the apiserver from the authenticated identity — ref: the
+        # approver's SubjectAccessReview on the stored user,
+        # sarapprove.go). Subject alone is forgeable by anyone who can
+        # create CSRs.
+        requester = csr.spec.username
+        groups = set(csr.spec.groups)
+        if not requester:
+            return  # unattributed request: leave Pending for an admin
+        if csr.spec.signer_name == SIGNER_KUBELET_SERVING:
+            # selfnodeserver only: the node itself renews its serving cert;
+            # a bootstrap token must never mint serving certs for
+            # arbitrary node names
+            if not (requester == cn and "system:nodes" in groups):
+                self._condition(
+                    key, "Denied", "RequesterMismatch",
+                    f"serving certificates are self-requested only "
+                    f"(requester {requester!r}, subject {cn!r})")
+                return
+            # SANs must name ONLY the requesting node: sign_csr preserves
+            # them wholesale, so an unvalidated SAN would let a node mint
+            # a cluster-CA cert for the apiserver's hostname (MITM). DNS
+            # SANs must equal the node name; IP SANs must appear on the
+            # stored Node's addresses. No Node object yet -> stay Pending.
+            node_name = cn[len("system:node:"):]
+            verdict = self._serving_sans_ok(pem, node_name)
+            if verdict is None:
+                return  # node not registered yet; retry on next sync
+            ok, why = verdict
+            if not ok:
+                self._condition(key, "Denied", "SANNotAllowed", why)
+                return
+        else:
+            # nodeclient (bootstrapper's initial cert) or selfnodeclient
+            # (the node renewing its own)
+            is_bootstrapper = "system:bootstrappers" in groups \
+                or "system:masters" in groups
+            is_self = requester == cn and "system:nodes" in groups
+            if not (is_bootstrapper or is_self):
+                self._condition(
+                    key, "Denied", "RequesterMismatch",
+                    f"client certificates for nodes require a bootstrap "
+                    f"or node identity (requester {requester!r})")
+                return
         self._condition(key, "Approved", "AutoApproved",
                         "kubelet node certificate")
+
+    def _serving_sans_ok(self, csr_pem: bytes, node_name: str):
+        """(ok, reason) once the Node is registered, None before. Every
+        requested SAN must be an identity of THIS node."""
+        from ..api.core import Node
+        try:
+            node: Node = self.client.nodes().get(node_name)
+        except NotFoundError:
+            return None
+        allowed_ips = {a.get("address") for a in node.status.addresses
+                       if a.get("type") in ("InternalIP", "ExternalIP")}
+        allowed_dns = {node_name} | {
+            a.get("address") for a in node.status.addresses
+            if a.get("type") == "Hostname"}
+        import ipaddress
+        for san in certutil.csr_sans_of(csr_pem):
+            try:
+                ipaddress.ip_address(san)
+                is_ip = True
+            except ValueError:
+                is_ip = False
+            if is_ip and san not in allowed_ips:
+                return False, f"IP SAN {san} is not an address of " \
+                              f"node {node_name}"
+            if not is_ip and san not in allowed_dns:
+                return False, f"DNS SAN {san!r} does not name " \
+                              f"node {node_name}"
+        return True, ""
 
     def _condition(self, name: str, ctype: str, reason: str,
                    message: str) -> None:

@@ -93,17 +93,34 @@ def issue_cert(ca_cert_pem: bytes, ca_key_pem: bytes, common_name: str,
     return _pem_cert(cert), _pem_key(key)
 
 
+def _san_entries(sans: Tuple[str, ...]) -> List[x509.GeneralName]:
+    import ipaddress
+    alts: List[x509.GeneralName] = []
+    for s in sans:
+        try:
+            alts.append(x509.IPAddress(ipaddress.ip_address(s)))
+        except ValueError:
+            alts.append(x509.DNSName(s))
+    return alts
+
+
 def new_csr(common_name: str,
-            organizations: Tuple[str, ...] = ()) -> Tuple[bytes, bytes]:
+            organizations: Tuple[str, ...] = (),
+            sans: Tuple[str, ...] = ()) -> Tuple[bytes, bytes]:
     """(csr_pem, key_pem) — what a kubelet submits as a
-    CertificateSigningRequest."""
+    CertificateSigningRequest. Serving CSRs carry the node's
+    hostnames/IPs as SubjectAlternativeNames (ref: the kubelet's
+    certificate.Manager requests SANs for kubelet-serving)."""
     key = _key()
     name = x509.Name(
         [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
         + [x509.NameAttribute(NameOID.ORGANIZATION_NAME, o)
            for o in organizations])
-    csr = (x509.CertificateSigningRequestBuilder()
-           .subject_name(name).sign(key, hashes.SHA256()))
+    builder = x509.CertificateSigningRequestBuilder().subject_name(name)
+    if sans:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(_san_entries(sans)), critical=False)
+    csr = builder.sign(key, hashes.SHA256())
     return csr.public_bytes(serialization.Encoding.PEM), _pem_key(key)
 
 
@@ -119,14 +136,26 @@ def sign_csr(ca_cert_pem: bytes, ca_key_pem: bytes, csr_pem: bytes,
     now = datetime.datetime.now(datetime.timezone.utc)
     usages = [ExtendedKeyUsageOID.SERVER_AUTH] if server \
         else [ExtendedKeyUsageOID.CLIENT_AUTH]
-    cert = (x509.CertificateBuilder()
-            .subject_name(csr.subject).issuer_name(ca_cert.subject)
-            .public_key(csr.public_key())
-            .serial_number(x509.random_serial_number())
-            .not_valid_before(now - _ONE_DAY)
-            .not_valid_after(now + datetime.timedelta(days=days))
-            .add_extension(x509.ExtendedKeyUsage(usages), critical=False)
-            .sign(ca_key, hashes.SHA256()))
+    builder = (x509.CertificateBuilder()
+               .subject_name(csr.subject).issuer_name(ca_cert.subject)
+               .public_key(csr.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - _ONE_DAY)
+               .not_valid_after(now + datetime.timedelta(days=days))
+               .add_extension(x509.ExtendedKeyUsage(usages),
+                              critical=False))
+    if server:
+        # serving certs are useless without SANs — TLS stacks (including
+        # Python's ssl) ignore CN for hostname verification, so the CSR's
+        # requested SubjectAlternativeName must survive signing (ref: the
+        # signer whitelists and preserves requested SANs)
+        try:
+            san = csr.extensions.get_extension_for_class(
+                x509.SubjectAlternativeName)
+            builder = builder.add_extension(san.value, critical=False)
+        except x509.ExtensionNotFound:
+            pass
+    cert = builder.sign(ca_key, hashes.SHA256())
     return _pem_cert(cert)
 
 
@@ -149,3 +178,17 @@ def subject_of(cert_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
 
 def csr_subject_of(csr_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
     return _subject(x509.load_pem_x509_csr(csr_pem).subject)
+
+
+def csr_sans_of(csr_pem: bytes) -> Tuple[str, ...]:
+    """Requested SubjectAlternativeNames (DNS names + IPs as strings)."""
+    csr = x509.load_pem_x509_csr(csr_pem)
+    try:
+        san = csr.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName)
+    except x509.ExtensionNotFound:
+        return ()
+    out: List[str] = []
+    for entry in san.value:
+        out.append(str(entry.value))
+    return tuple(out)

@@ -53,7 +53,9 @@ class TestCSRControllers:
                 metadata=api.ObjectMeta(name="n1-csr"),
                 spec=CertificateSigningRequestSpec(
                     request=base64.b64encode(csr_pem).decode(),
-                    signer_name=SIGNER_KUBELET_CLIENT)))
+                    signer_name=SIGNER_KUBELET_CLIENT,
+                    username="system:bootstrap:kubeadm",
+                    groups=["system:bootstrappers"])))
         # a non-node subject must be denied
         bad_pem, _ = certutil.new_csr("impostor")
         client.certificate_signing_requests().create(
@@ -61,7 +63,9 @@ class TestCSRControllers:
                 metadata=api.ObjectMeta(name="bad-csr"),
                 spec=CertificateSigningRequestSpec(
                     request=base64.b64encode(bad_pem).decode(),
-                    signer_name=SIGNER_KUBELET_CLIENT)))
+                    signer_name=SIGNER_KUBELET_CLIENT,
+                    username="system:bootstrap:kubeadm",
+                    groups=["system:bootstrappers"])))
         informers.start()
         informers.wait_for_cache_sync()
         try:
@@ -109,7 +113,9 @@ class TestCSRPrivilegeBoundaries:
                 metadata=api.ObjectMeta(name="evil"),
                 spec=CertificateSigningRequestSpec(
                     request=base64.b64encode(evil_pem).decode(),
-                    signer_name=SIGNER_KUBELET_CLIENT)))
+                    signer_name=SIGNER_KUBELET_CLIENT,
+                    username="system:bootstrap:kubeadm",
+                    groups=["system:bootstrappers"])))
         informers.start()
         informers.wait_for_cache_sync()
         try:
@@ -124,6 +130,103 @@ class TestCSRPrivilegeBoundaries:
         from kubernetes_tpu.apiserver.httpclient import HTTPClient
         with pytest.raises(ValueError, match="ca_file"):
             HTTPClient("https://127.0.0.1:9")
+
+    def _approver(self):
+        from kubernetes_tpu.controllers.certificates import \
+            CSRApprovingController
+        from kubernetes_tpu.state import Client, SharedInformerFactory
+        client = Client()
+        informers = SharedInformerFactory(client)
+        return client, informers, CSRApprovingController(client, informers)
+
+    def _submit(self, client, name, pem, signer, username, groups):
+        from kubernetes_tpu.api.certificates import (
+            CertificateSigningRequest, CertificateSigningRequestSpec)
+        client.certificate_signing_requests().create(
+            CertificateSigningRequest(
+                metadata=api.ObjectMeta(name=name),
+                spec=CertificateSigningRequestSpec(
+                    request=base64.b64encode(pem).decode(),
+                    signer_name=signer, username=username,
+                    groups=list(groups))))
+
+    def test_serving_cert_self_request_only(self):
+        """A bootstrap token must NOT mint serving certs for arbitrary
+        nodes — only the node identity itself may request its serving
+        cert (the reference never auto-approves kubelet-serving for
+        third parties), and requested SANs must name only that node
+        (sign_csr preserves them, so a foreign SAN would be a
+        cluster-CA-signed MITM cert for, say, the apiserver)."""
+        from kubernetes_tpu.api.certificates import (
+            SIGNER_KUBELET_SERVING, is_approved, is_denied)
+        client, informers, approver = self._approver()
+        client.nodes().create(api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(addresses=[
+                {"type": "InternalIP", "address": "10.0.0.5"},
+                {"type": "Hostname", "address": "n1"}])))
+        pem, _ = certutil.new_csr("system:node:n1",
+                                  organizations=("system:nodes",),
+                                  sans=("n1", "10.0.0.5"))
+        evil_pem, _ = certutil.new_csr(
+            "system:node:n1", organizations=("system:nodes",),
+            sans=("kubernetes.default.svc",))
+        self._submit(client, "via-token", pem, SIGNER_KUBELET_SERVING,
+                     "system:bootstrap:kubeadm", ["system:bootstrappers"])
+        self._submit(client, "self", pem, SIGNER_KUBELET_SERVING,
+                     "system:node:n1", ["system:nodes"])
+        self._submit(client, "mitm", evil_pem, SIGNER_KUBELET_SERVING,
+                     "system:node:n1", ["system:nodes"])
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            approver.sync("via-token")
+            approver.sync("self")
+            approver.sync("mitm")
+            rc = client.certificate_signing_requests()
+            assert is_denied(rc.get("via-token"))
+            assert is_approved(rc.get("self"))
+            mitm = rc.get("mitm")
+            assert is_denied(mitm)
+            assert any(c.reason == "SANNotAllowed"
+                       for c in mitm.status.conditions)
+        finally:
+            informers.stop()
+
+    def test_unattributed_csr_stays_pending(self):
+        """No spec.username (unauthenticated hub) -> no auto-approval;
+        an admin must approve by hand."""
+        from kubernetes_tpu.api.certificates import (
+            SIGNER_KUBELET_CLIENT, is_approved, is_denied)
+        client, informers, approver = self._approver()
+        pem, _ = certutil.new_csr("system:node:n1",
+                                  organizations=("system:nodes",))
+        self._submit(client, "anon", pem, SIGNER_KUBELET_CLIENT, "", [])
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            approver.sync("anon")
+            got = client.certificate_signing_requests().get("anon")
+            assert not is_approved(got) and not is_denied(got)
+        finally:
+            informers.stop()
+
+    def test_serving_cert_preserves_sans(self):
+        """kubelet-serving certs carry the CSR's SubjectAlternativeNames
+        — TLS stacks ignore CN for hostname verification."""
+        ca_cert, ca_key = certutil.new_ca()
+        csr, _ = certutil.new_csr("system:node:n1",
+                                  organizations=("system:nodes",),
+                                  sans=("n1.cluster.local", "10.0.0.5"))
+        assert set(certutil.csr_sans_of(csr)) == \
+            {"n1.cluster.local", "10.0.0.5"}
+        cert = certutil.sign_csr(ca_cert, ca_key, csr, server=True)
+        from cryptography import x509
+        parsed = x509.load_pem_x509_certificate(cert)
+        san = parsed.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName)
+        names = {str(e.value) for e in san.value}
+        assert names == {"n1.cluster.local", "10.0.0.5"}
 
 
 class TestKubeadm:

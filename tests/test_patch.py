@@ -69,6 +69,25 @@ class TestAlgorithms:
         with pytest.raises(JSONPatchError):
             json_patch({"a": 1}, [{"op": "test", "path": "/a", "value": 2}])
 
+    def test_json_patch_rejects_bad_array_indices(self):
+        """RFC 6901 array tokens are digits only and must be in range:
+        add at /arr/100 on a 2-element list must 422, not silently
+        append; negative indices are grammar violations."""
+        from kubernetes_tpu.api.patch import JSONPatchError
+        doc = {"arr": [1, 2]}
+        for ops in (
+                [{"op": "add", "path": "/arr/100", "value": 9}],
+                [{"op": "add", "path": "/arr/-1", "value": 9}],
+                [{"op": "replace", "path": "/arr/2", "value": 9}],
+                [{"op": "remove", "path": "/arr/5"}],
+                [{"op": "add", "path": "/arr/01x", "value": 9}],
+        ):
+            with pytest.raises(JSONPatchError):
+                json_patch(doc, ops)
+        # boundary: insert at exactly len() is legal, replace at len() not
+        assert json_patch(doc, [{"op": "add", "path": "/arr/2",
+                                 "value": 3}]) == {"arr": [1, 2, 3]}
+
     def test_three_way_deletes_only_owned_fields(self):
         original = {"metadata": {"labels": {"mine": "1", "dropme": "x"}}}
         modified = {"metadata": {"labels": {"mine": "2"}}}

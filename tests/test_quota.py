@@ -106,6 +106,24 @@ class TestQuotaAdmission:
 
 
 class TestQuotaAdmissionRollback:
+    def test_failed_create_refunds_charge(self, server):
+        """Admission charges before storage commits; an AlreadyExists
+        rejection must hand the charge back immediately — not strand it
+        until the quota controller's 30s resync (which would falsely
+        throttle the namespace)."""
+        client = HTTPClient(server.address)
+        client.resource_quotas("default").create(
+            make_quota("q", {"pods": "2"}))
+        client.pods("default").create(make_pod("p-0"))
+        with pytest.raises(Exception):
+            client.pods("default").create(make_pod("p-0"))  # duplicate
+        q = client.resource_quotas("default").get("q")
+        assert str(q.status.used.get("pods")) == "1"
+        # the freed slot is usable right now, no controller involved
+        client.pods("default").create(make_pod("p-1"))
+        q = client.resource_quotas("default").get("q")
+        assert str(q.status.used.get("pods")) == "2"
+
     def test_denial_refunds_earlier_quotas(self, server):
         """Quota A charges, quota B denies -> A must be refunded, and the
         namespace must not be falsely throttled afterwards."""
