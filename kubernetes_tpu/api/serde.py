@@ -395,6 +395,45 @@ def to_json_str(obj: Any, **kw) -> str:
     return json.dumps(encode(obj), **kw)
 
 
+def encode_cached(obj: Any) -> Any:
+    """encode() memoized per (object, resourceVersion) for store-frozen
+    objects.
+
+    The store keeps ONE canonical frozen object per key and stamps a fresh
+    resourceVersion on every write, so an rv-matched cache entry can never
+    be stale — invalidation is the rv re-stamp itself. This collapses the
+    hub's per-watcher/per-list/per-journal re-encodes of the same revision
+    into one: the reference pays the same cost once via the watch cache's
+    cached serializations (storage/cacher). Objects without an rv (not yet
+    stored) fall through to plain encode()."""
+    meta = getattr(obj, "metadata", None)
+    rv = getattr(meta, "resource_version", "") if meta is not None else ""
+    if not rv:
+        return encode(obj)
+    c = obj.__dict__.get("_enc_cache")
+    if c is not None and c[0] == rv:
+        return c[1]
+    d = encode(obj)
+    obj.__dict__["_enc_cache"] = (rv, d, None)
+    return d
+
+
+def to_json_cached(obj: Any) -> str:
+    """JSON string form of encode_cached(), itself cached — the watch
+    fan-out and list paths serve the identical bytes to every consumer."""
+    meta = getattr(obj, "metadata", None)
+    rv = getattr(meta, "resource_version", "") if meta is not None else ""
+    if not rv:
+        return json.dumps(encode(obj))
+    c = obj.__dict__.get("_enc_cache")
+    if c is not None and c[0] == rv and c[2] is not None:
+        return c[2]
+    d = c[1] if c is not None and c[0] == rv else encode(obj)
+    s = json.dumps(d)
+    obj.__dict__["_enc_cache"] = (rv, d, s)
+    return s
+
+
 def from_json_str(cls: Type[T], s: str) -> T:
     return decode(cls, json.loads(s))
 

@@ -128,8 +128,16 @@ class ResourceQuotaAdmission:
     def refund_last(self) -> None:
         """Undo the charges committed by the most recent validate() on
         this thread (called by the server when create fails post-admission)."""
+        self.refund_rec(self.take_last())
+
+    def take_last(self):
+        """Harvest (and clear) this thread's last charge record — bulk
+        create stashes one per slot so a failed slot refunds only its own."""
         rec = getattr(self._last, "rec", None)
         self._last.rec = None
+        return rec
+
+    def refund_rec(self, rec) -> None:
         if rec:
             charged, delta = rec
             for q, keys in charged:

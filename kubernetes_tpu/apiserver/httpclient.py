@@ -168,6 +168,37 @@ class HTTPResourceClient:
         return self._decode(self._request("POST", self._url(namespace=ns),
                                           obj))
 
+    def create_bulk(self, objs: List[Any],
+                    namespace: Optional[str] = None) -> List[Any]:
+        """One POST of a List to the collection -> one store transaction
+        server-side (mirrors state.ResourceClient.create_bulk). Result
+        slots are truthy success markers ({"name", "resourceVersion"}
+        dicts from the server's slim Status echo) or per-slot Exceptions.
+        Mass loaders (benchmarks, kubeadm addons, controllers stamping N
+        pods) stop paying one HTTP round trip per object."""
+        if not objs:
+            return []
+        ns = namespace if namespace is not None else self._effective_ns()
+        body = {"apiVersion": "v1", "kind": "List",
+                "items": [serde.encode(o) for o in objs]}
+        resp = self._request("POST", self._url(namespace=ns), body,
+                             content_type="application/json")
+        out: List[Any] = []
+        for item in resp.get("items", []):
+            if item.get("kind") == "Status" and \
+                    item.get("status") != "Success":
+                exc = {"NotFoundError": NotFoundError,
+                       "AlreadyExistsError": AlreadyExistsError,
+                       "ConflictError": ConflictError} \
+                    .get(item.get("reason", ""), RuntimeError)(
+                        item.get("message", ""))
+                out.append(exc)
+            else:
+                out.append(item.get("metadata", True))
+        while len(out) < len(objs):
+            out.append(RuntimeError("bulk create: missing result slot"))
+        return out
+
     def get(self, name: str, namespace: Optional[str] = None):
         return self._decode(self._request(
             "GET", self._url(name, namespace=namespace)))
@@ -308,8 +339,10 @@ class HTTPPodClient(HTTPResourceClient):
             by_ns.setdefault(ns, []).append((i, b))
         out: List[Any] = [None] * len(bindings)
         for ns, slots in by_ns.items():
-            body = {"apiVersion": "v1", "kind": "List",
-                    "items": [json.loads(serde.to_json_str(b))
+            # the slim BindList form: [name, nodeName] pairs — the server
+            # reconstructs Bindings without a per-item serde decode
+            body = {"apiVersion": "v1", "kind": "BindList",
+                    "items": [[b.metadata.name, b.target.name]
                               for _, b in slots]}
             url = (f"{self._base}/api/v1/namespaces/{ns}/bindings")
             try:

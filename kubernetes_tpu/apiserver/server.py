@@ -496,12 +496,16 @@ class APIServer:
             else:
                 items, rv = self.store.list(
                     req.resource, req.namespace or None)
-                body = {
-                    "apiVersion": "v1", "kind": "List",
-                    "metadata": {"resourceVersion": str(rv)},
-                    "items": [serde.encode(o) for o in items]}
-                self._respond_raw(h, 200, json.dumps(body).encode(),
-                                  "application/json")
+                # assemble from per-object cached JSON: the store's frozen
+                # objects encode once per revision (serde.to_json_cached),
+                # so a 20k-item list is a join, not 20k re-encodes
+                body = (
+                    b'{"apiVersion": "v1", "kind": "List", "metadata": '
+                    b'{"resourceVersion": "%d"}, "items": [' % rv
+                    + ", ".join(serde.to_json_cached(o)
+                                for o in items).encode()
+                    + b"]}")
+                self._respond_raw(h, 200, body, "application/json")
         elif method == "POST":
             data = self._read_body(h)
             if data is None:
@@ -513,22 +517,43 @@ class APIServer:
                 # analog of the in-process batch-bind path. A single
                 # Binding body binds one pod. Authorization already ran as
                 # create pods/binding (_authorized maps this resource).
-                items = data.get("items", [data]) \
-                    if data.get("kind") == "List" else [data]
-                bindings = []
-                for d in items:
-                    b = serde.decode(Binding, d)
-                    if req.namespace:
-                        if b.metadata.namespace and \
-                                b.metadata.namespace != req.namespace:
-                            self._error(
-                                h, 422, "Invalid",
-                                f"binding namespace "
-                                f"({b.metadata.namespace}) does not match "
-                                f"the request ({req.namespace})")
+                # "BindList" is the slim form: items are [name, nodeName]
+                # pairs under the request namespace — same semantics, no
+                # per-item object decode on the hot path.
+                if data.get("kind") == "BindList":
+                    from ..api.meta import ObjectMeta
+                    from ..api.core import ObjectReference
+                    ns = req.namespace or "default"
+                    bindings = []
+                    for it in data.get("items", []):
+                        if not (isinstance(it, list) and len(it) == 2 and
+                                isinstance(it[0], str) and
+                                isinstance(it[1], str)):
+                            self._error(h, 422, "Invalid",
+                                        "BindList items must be "
+                                        "[podName, nodeName] pairs")
                             return
-                        b.metadata.namespace = req.namespace
-                    bindings.append(b)
+                        bindings.append(Binding(
+                            metadata=ObjectMeta(name=it[0], namespace=ns),
+                            target=ObjectReference(kind="Node",
+                                                   name=it[1])))
+                else:
+                    items = data.get("items", [data]) \
+                        if data.get("kind") == "List" else [data]
+                    bindings = []
+                    for d in items:
+                        b = serde.decode(Binding, d)
+                        if req.namespace:
+                            if b.metadata.namespace and \
+                                    b.metadata.namespace != req.namespace:
+                                self._error(
+                                    h, 422, "Invalid",
+                                    f"binding namespace "
+                                    f"({b.metadata.namespace}) does not "
+                                    f"match the request ({req.namespace})")
+                                return
+                            b.metadata.namespace = req.namespace
+                        bindings.append(b)
                 outs = self.client.pods(req.namespace or None) \
                     .bind_bulk(bindings)
                 # slim per-slot results — the reference's bind returns
@@ -567,6 +592,14 @@ class APIServer:
                     return
                 out = self.client.pods(req.namespace or None).bind(binding)
                 self._respond(h, 201, out)
+                return
+            if data.get("kind") == "List" and \
+                    req.resource != "customresourcedefinitions":
+                # bulk create: a List posted to the collection creates all
+                # items in ONE store transaction (create_bulk) — the
+                # write-side analog of the bulk bindings path; per-request
+                # HTTP/serde overhead stops dominating mass loads
+                self._handle_bulk_create(h, req, cls, data, user)
                 return
             obj = self.scheme.decode_any(data) if "kind" in data \
                 else serde.decode(cls, data)
@@ -671,6 +704,71 @@ class APIServer:
         else:
             self._error(h, 405, "MethodNotAllowed", method)
 
+    def _handle_bulk_create(self, h, req: _Request, cls, data,
+                            user=None) -> None:
+        """POST of a List to a collection: decode + admit each item, then
+        commit every admitted item through ONE store transaction. A bad
+        item fails only its slot (mirrors create_bulk / the bulk bindings
+        endpoint); a slot whose create fails after admission refunds its
+        own quota charge. Responds with a List of slim per-slot Status."""
+        rc = self._rc(cls, req.namespace)
+        objs: List[Any] = []
+        slots: List[Any] = []  # int index into objs, or Exception
+        charges: List[Any] = []
+        new_namespaces: List[str] = []
+        for d in data.get("items", []):
+            try:
+                obj = self.scheme.decode_any(d) if "kind" in d \
+                    else serde.decode(cls, d)
+                if not isinstance(obj, cls):
+                    raise ValueError(
+                        f"item kind {d.get('kind')} does not match "
+                        f"resource {req.resource}")
+                if req.namespace and hasattr(obj, "metadata"):
+                    if obj.metadata.namespace and \
+                            obj.metadata.namespace != req.namespace:
+                        raise ValueError(
+                            f"item namespace ({obj.metadata.namespace}) "
+                            f"does not match the request ({req.namespace})")
+                    obj.metadata.namespace = req.namespace
+                if req.resource == "certificatesigningrequests":
+                    # same server-side stamp as the single-create path
+                    obj.spec.username = user.name if user is not None else ""
+                    obj.spec.groups = list(user.groups) \
+                        if user is not None else []
+                obj = self.admission.admit("CREATE", req.resource, obj)
+                rec = self._quota.take_last()
+            except Exception as e:
+                slots.append(e)
+                continue
+            slots.append(len(objs))
+            objs.append(obj)
+            charges.append(rec)
+        outs = rc.create_bulk(objs)
+        results = []
+        for s in slots:
+            if isinstance(s, Exception):
+                results.append(s)
+                continue
+            out = outs[s]
+            if isinstance(out, Exception):
+                self._quota.refund_rec(charges[s])
+            elif req.resource == "namespaces":
+                new_namespaces.append(out.metadata.name)
+            results.append(out)
+        for name in new_namespaces:
+            self._ensure_default_sa(name)
+        body = {"apiVersion": "v1", "kind": "List", "items": [
+            {"kind": "Status", "status": "Failure",
+             "reason": type(r).__name__, "message": str(r)}
+            if isinstance(r, Exception) else
+            {"kind": "Status", "status": "Success",
+             "metadata": {"name": r.metadata.name,
+                          "resourceVersion": r.metadata.resource_version}}
+            for r in results]}
+        self._respond_raw(h, 200, json.dumps(body).encode(),
+                          "application/json")
+
     def _apply_patch(self, req: _Request, rc, cls, ctype: str, data):
         """The PATCH verb (ref: apiserver/pkg/endpoints/handlers/patch.go:45
         — patcher.patchResource). Dispatches on content type:
@@ -770,10 +868,11 @@ class APIServer:
                         closing = True
                         break
                     batch.append(nxt)
+                # per-object cached JSON: one encode per revision shared
+                # across every watcher/list/journal of that revision
                 frames = b"".join(
-                    (json.dumps({"type": e.type,
-                                 "object": serde.encode(e.object)})
-                     + "\n").encode()
+                    (f'{{"type": "{e.type}", "object": '
+                     f"{serde.to_json_cached(e.object)}}}\n").encode()
                     for e in batch)
                 write_chunk(frames)
                 if closing:
@@ -790,7 +889,7 @@ class APIServer:
     # ------------------------------------------------------------ responses
 
     def _respond(self, h, code: int, obj: Any) -> None:
-        self._respond_raw(h, code, serde.to_json_str(obj).encode(),
+        self._respond_raw(h, code, serde.to_json_cached(obj).encode(),
                           "application/json")
 
     def _audit(self, h, method: str, req: _Request, user) -> None:

@@ -77,6 +77,7 @@ class ScheduleResult:
     node_name: Optional[str]          # None -> unschedulable (or retry)
     score: float = 0.0
     retry: bool = False               # lost an in-batch conflict; requeue
+    reassigned: bool = False          # repair moved it off the kernel's pick
 
 
 @dataclass
@@ -106,6 +107,128 @@ class PendingBatch:
     #: (repair demotions / commit drops): chained usage over-states, so
     #: kernel-unassigned pods here must RETRY, not park as unschedulable
     phantom: bool = False
+
+
+class _RepairReassigner:
+    """Host-side serial re-solve for pods the repair pass would demote.
+
+    The serial reference never demotes: pod i simply picks its best node
+    GIVEN pods 1..i-1 (scheduler.go:514 assume-between-iterations). The
+    kernel approximates that with a frozen constraint mask; when repair
+    finds pod i's kernel pick invalidated by an earlier winner, this class
+    reproduces the kernel's exact scoring on host numpy — running usage
+    including every surviving earlier winner, the same resource priorities
+    (kernels/batch.py _least_requested/_balanced_allocation, f32 floors),
+    static rows, and (row, seq) tie-break hash — and walks candidates in
+    that order so the repair can place the pod where the serial order
+    would have, instead of burning a retry round.
+
+    Usage base: mirror host truth + stale (chained predecessor) winners +
+    surviving winners of THIS batch. In the mid-drain chained case the
+    predecessor's winners may already be folded into host truth, making
+    the base conservatively overstated for reassigned pods — feasibility
+    never overpacks, and the single-batch (parity fixture) case is exact.
+    """
+
+    MAX_CANDIDATES = 64
+
+    def __init__(self, mirror: TensorMirror, batch: PodBatchTensors,
+                 stale_winners):
+        self.mirror = mirror
+        self.batch = batch
+        self._stale = list(stale_winners or [])
+        self._log: List[Tuple[int, str]] = []   # winners before materialize
+        self._used = None
+        self.reassigned_any = False
+
+    def add_winner(self, i: int, node_name: str) -> None:
+        if self._used is None:
+            self._log.append((i, node_name))
+        else:
+            self._apply(i, node_name)
+
+    def _apply(self, i: int, node_name: str) -> None:
+        row = self.mirror.row_of.get(node_name)
+        if row is None:
+            return
+        self._used[row] += self.batch.req[i]
+        self._nz[row] += self.batch.nonzero_req[i]
+        self._cnt[row] += 1.0
+
+    def _materialize(self) -> None:
+        from .nodeinfo import pod_resource, pod_resource_nonzero
+        from .tensorize import COL_CPU, COL_EPH, COL_MEM, _f32_ceil
+        t = self.mirror.t
+        self._used = t.used.copy()
+        self._nz = t.nonzero_used.copy()
+        self._cnt = t.pod_count.copy()
+        self._rows = np.arange(t.capacity, dtype=np.int64)
+        for w_pod, w_node in self._stale:
+            row = self.mirror.row_of.get(w_node)
+            if row is None:
+                continue
+            r = pod_resource(w_pod)
+            self._used[row, COL_CPU] += _f32_ceil(r.milli_cpu)
+            self._used[row, COL_MEM] += _f32_ceil(r.memory)
+            self._used[row, COL_EPH] += _f32_ceil(r.ephemeral_storage)
+            for rname, v in r.scalar_resources.items():
+                self._used[row, self.mirror.vocab.col(rname)] += _f32_ceil(v)
+            nz_cpu, nz_mem = pod_resource_nonzero(w_pod)
+            self._nz[row, 0] += nz_cpu
+            self._nz[row, 1] += nz_mem
+            self._cnt[row] += 1.0
+        for i, node_name in self._log:
+            self._apply(i, node_name)
+        self._log = []
+
+    def candidates(self, i: int):
+        """Yield node names in the kernel's (score - tie penalty) order,
+        feasible against the running usage; capped."""
+        if self._used is None:
+            self._materialize()
+        from .tensorize import COL_CPU, COL_MEM
+        t = self.mirror.t
+        b = self.batch
+        req = b.req[i]
+        fits = b.unique_masks[b.mask_idx[i]] & t.node_ok & t.valid
+        if b.mem_pressure_blocked[i]:
+            fits = fits & ~t.mem_pressure
+        fits = fits & ((self._used + req[None, :]) <= t.alloc).all(axis=1)
+        fits = fits & (self._cnt + 1.0 <= t.max_pods)
+        if not fits.any():
+            return
+        cap_cpu = t.alloc[:, COL_CPU]
+        cap_mem = t.alloc[:, COL_MEM]
+        nzr = b.nonzero_req[i]
+        req_cpu = self._nz[:, 0] + nzr[0]
+        req_mem = self._nz[:, 1] + nzr[1]
+        safe_cpu = np.maximum(cap_cpu, 1.0)
+        safe_mem = np.maximum(cap_mem, 1.0)
+        lr_c = np.where((cap_cpu > 0) & (req_cpu <= cap_cpu),
+                        np.floor((cap_cpu - req_cpu) * 10.0 / safe_cpu), 0.0)
+        lr_m = np.where((cap_mem > 0) & (req_mem <= cap_mem),
+                        np.floor((cap_mem - req_mem) * 10.0 / safe_mem), 0.0)
+        lr = np.floor((lr_c + lr_m) / 2.0)
+        cpu_frac = np.where(cap_cpu > 0, req_cpu / safe_cpu, 1.0)
+        mem_frac = np.where(cap_mem > 0, req_mem / safe_mem, 1.0)
+        ba = np.floor((1.0 - np.abs(cpu_frac - mem_frac)) * 10.0)
+        ba = np.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, ba)
+        rw = b.resource_weights
+        score = rw[0] * lr + rw[1] * ba + b.unique_scores[b.score_idx[i]]
+        # bit-identical tie-break to the kernel: low 16 bits are invariant
+        # under int32 wraparound, so int64 + mask matches
+        h = ((self._rows * -1640531527 + int(b.seq[i]) * 40503)
+             & 0xFFFF).astype(np.float32)
+        ranked = np.where(fits, score - h * np.float64(0.5 / 65536.0),
+                          -np.inf)
+        order = np.argsort(-ranked, kind="stable")
+        for row in order[:self.MAX_CANDIDATES]:
+            row = int(row)
+            if not fits[row]:
+                return
+            name = self.mirror.name_of.get(row)
+            if name is not None:
+                yield name
 
 
 def _pod_has_conflict_volumes(pod: Pod) -> bool:
@@ -423,9 +546,57 @@ class BatchScheduler:
         batch.set_static_scores(
             np.arange(len(pods), dtype=np.int32), base + ext)
 
+    #: max batch size for pods whose soft scores drift in-batch (spread
+    #: counts freeze at batch start); env-tunable. 256 over hundreds of
+    #: nodes bounds the frozen-window imbalance to ~1 pod per domain.
+    SOFT_SCORE_CHUNK = 256
+
+    def soft_batch_limit(self, pods: List[Pod]) -> int:
+        """How many of these pods may schedule in ONE kernel batch without
+        visible soft-score drift. SelectorSpread scores change with every
+        in-batch winner (the serial reference re-counts per pod via
+        assume-between-iterations, selector_spreading.go:277); pods carrying
+        spread selectors therefore schedule in SOFT_SCORE_CHUNK sub-batches
+        so the counts refresh between chunks. Batches without spread
+        carriers (no owning service/controller) keep the full size — the
+        uniform/affinity hot paths are unaffected."""
+        import os as _os
+        chunk = int(_os.environ.get("SCHED_SOFT_SCORE_CHUNK",
+                                    str(self.SOFT_SCORE_CHUNK)))
+        if len(pods) <= chunk or chunk <= 0:
+            return len(pods)
+        listers = self.scorer.listers
+        if listers is None or \
+                not self.scorer.weights.get("SelectorSpreadPriority"):
+            return len(pods)
+        memo: Dict[Tuple, bool] = {}
+        for pod in pods:
+            key = (pod.metadata.namespace,
+                   tuple(sorted(pod.metadata.labels.items())))
+            v = memo.get(key)
+            if v is None:
+                v = bool(listers.selectors_for_pod(pod))
+                memo[key] = v
+            if v:
+                return chunk
+        return len(pods)
+
+    def _make_reassigner(self, batch: Optional[PodBatchTensors],
+                         stale_winners):
+        """A host-side serial re-solver for repair losers, or None when the
+        batch can't support one (no tensors, or nominated reservations are
+        in play — the kernel's nom handling has no host replica, so those
+        rare cycles keep the retry path)."""
+        if batch is None:
+            return None
+        if self.nominated is not None and self.nominated.by_node():
+            return None
+        return _RepairReassigner(self.mirror, batch, stale_winners)
+
     def _repair_batch(self, results: List[ScheduleResult],
                       profiles: Dict[int, AffinityProfile],
-                      stale_winners=None) -> None:
+                      stale_winners=None,
+                      batch: Optional[PodBatchTensors] = None) -> None:
         """Validate host-evaluated predicates against earlier winners in the
         same batch; losers are demoted to retry. Skipped when nothing in the
         batch carries ports/affinity/disk constraints. Affinity interactions
@@ -459,6 +630,7 @@ class BatchScheduler:
         # reserves via AssumePodVolumes between scheduleOne iterations)
         taken_pvs: set = set()
         empty_profile = AffinityProfile()
+        reassigner = self._make_reassigner(batch, stale_winners)
 
         def overlay_node(name: str) -> Optional[NodeInfo]:
             ni = overlay.get(name)
@@ -470,48 +642,76 @@ class BatchScheduler:
                 overlay[name] = ni
             return ni
 
+        def node_passes(i: int, pod: Pod, name: str, has_ports: bool,
+                        has_disk: bool, has_attach: bool):
+            """(ok, pvs) for placing pod i on `name` given earlier winners
+            — the SAME checks the kernel pick runs through below."""
+            pvs_local: List[str] = []
+            if _pod_has_pvc(pod):
+                ni = overlay_node(name)
+                if ni is None or ni.node is None:
+                    return False, pvs_local
+                found = self.volume_binder.preview_bindings(
+                    pod, ni.node, exclude=taken_pvs)
+                if found is None:
+                    return False, pvs_local
+                pvs_local = found
+            if any_winners and (has_ports or has_disk or has_attach):
+                ni = overlay_node(name)
+                if ni is None:
+                    return False, pvs_local
+                if has_ports:
+                    ok, _ = preds.pod_fits_host_ports(pod, None, ni)
+                    if not ok:
+                        return False, pvs_local
+                if has_disk:
+                    ok, _ = preds.no_disk_conflict(pod, None, ni)
+                    if not ok:
+                        return False, pvs_local
+                if has_attach:
+                    # earlier winners on this node count against limits
+                    for fn in self._volume_count_preds.values():
+                        ok, _ = fn(pod, None, ni)
+                        if not ok:
+                            return False, pvs_local
+            if aff_overlay is not None and any_winners and \
+                    aff_overlay.conflicts(pod, profiles.get(i, empty_profile),
+                                          name):
+                return False, pvs_local
+            return True, pvs_local
+
+        def try_reassign(i: int, res: ScheduleResult, has_ports: bool,
+                         has_disk: bool, has_attach: bool):
+            """Serial re-solve: walk candidates in kernel score order until
+            one passes every check. Returns that node's pvs, or None."""
+            if reassigner is None:
+                return None
+            for cand in reassigner.candidates(i):
+                if cand == res.node_name:
+                    continue  # the failed pick
+                ok, pvs_c = node_passes(i, res.pod, cand, has_ports,
+                                        has_disk, has_attach)
+                if ok:
+                    res.node_name = cand
+                    res.reassigned = True
+                    reassigner.reassigned_any = True
+                    return pvs_c
+            return None
+
         for i, res in enumerate(results):
             if res.node_name is None:
                 continue
             pod = res.pod
             has_ports = bool(helpers.pod_host_ports(pod))
             has_disk = _pod_has_conflict_volumes(pod)
-            pvs: List[str] = []
-            if _pod_has_pvc(pod):
-                ni = overlay_node(res.node_name)
-                found = None
-                if ni is not None and ni.node is not None:
-                    found = self.volume_binder.preview_bindings(
-                        pod, ni.node, exclude=taken_pvs)
-                if found is None:
-                    res.node_name = None
-                    res.retry = True
-                    continue
-                # not committed to taken_pvs yet: a later demotion of THIS
-                # pod must not block these PVs for the rest of the batch
-                pvs = found
             has_attach = _pod_has_attach_volumes(pod) or _pod_has_pvc(pod)
-            if any_winners and (has_ports or has_disk or has_attach):
-                ni = overlay_node(res.node_name)
-                ok = ni is not None
-                if ok and has_ports:
-                    ok, _ = preds.pod_fits_host_ports(pod, None, ni)
-                if ok and has_disk:
-                    ok, _ = preds.no_disk_conflict(pod, None, ni)
-                if ok and has_attach:
-                    # earlier winners on this node count against attach limits
-                    for fn in self._volume_count_preds.values():
-                        ok, _ = fn(pod, None, ni)
-                        if not ok:
-                            break
-                if not ok:
-                    res.node_name = None
-                    res.retry = True
-                    continue
-            if aff_overlay is not None and any_winners and \
-                    (i in profiles or aff_overlay.has_anti):
-                if aff_overlay.conflicts(pod, profiles.get(i, empty_profile),
-                                         res.node_name):
+            ok, pvs = node_passes(i, pod, res.node_name, has_ports,
+                                  has_disk, has_attach)
+            if not ok:
+                # the serial reference would just have picked the next-best
+                # node for this pod; do that here instead of a retry round
+                pvs = try_reassign(i, res, has_ports, has_disk, has_attach)
+                if pvs is None:
                     res.node_name = None
                     res.retry = True
                     continue
@@ -525,7 +725,14 @@ class BatchScheduler:
                     ni.add_pod(bound)
             if aff_overlay is not None:
                 aff_overlay.add_winner(pod, res.node_name)
+            if reassigner is not None:
+                reassigner.add_winner(i, res.node_name)
             any_winners = True
+        if reassigner is not None and reassigner.reassigned_any:
+            # reassigned pods sit on different rows than the kernel's
+            # adopted usage counted them on; no dirty row repairs that —
+            # drop device usage so the next launch re-uploads host truth
+            self.mirror.invalidate_usage()
 
     # ------------------------------------------------------------- schedule
 
@@ -675,7 +882,8 @@ class BatchScheduler:
             for r in out:
                 if r.node_name is None:
                     r.retry = True
-        self._repair_batch(out, pending.profiles, pending.stale_winners)
+        self._repair_batch(out, pending.profiles, pending.stale_winners,
+                           batch=pending.batch)
         if not any(r.retry for r in out) and \
                 pending.usage_epoch == self.mirror.usage_epoch:
             # every surviving assignment flows through cache.assume_pod, so

@@ -81,6 +81,27 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
+        #: async binding (the reference's bind goroutine, scheduler.go:521):
+        #: assume synchronously, POST the bulk bind from a single binder
+        #: thread so the hub chews batch N's binds while this process
+        #: computes batch N+1. Enabled only across a REAL process boundary
+        #: (HTTP client) — in-process binds are microseconds and the thread
+        #: hop would cost more than it hides. Failures discovered on the
+        #: binder thread forget the assumed pod + invalidate device usage
+        #: (same self-heal as the reference's Forget on bind error,
+        #: scheduler.go:556; assumed-TTL covers anything missed).
+        self._async_bind = (getattr(client, "base_url", None) is not None
+                            and self._bind_extender is None)
+        self._bind_pool = None
+        self._bind_futures: list = []
+        self._count_lock = threading.Lock()
+        if self._async_bind:
+            from concurrent.futures import ThreadPoolExecutor
+            # two workers: consecutive batches' POSTs overlap in the hub
+            # (binds of different batches touch disjoint pods, so
+            # transaction order between them is immaterial)
+            self._bind_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="binder")
         from ..state.record import EventRecorder
         from .debugger import CacheDebugger
         #: correlating recorder (ref: client-go tools/record): dedup by
@@ -167,6 +188,13 @@ class Scheduler:
         elif self._responsible(pod):
             if pod.metadata.deletion_timestamp is not None:
                 return  # deleting pods never enter the queue (scheduleOne skip)
+            # feature extraction on THIS (informer) thread: tensorization
+            # then reads a cached signature instead of burning drain time
+            from .tensorize import precompute_pod_features
+            try:
+                precompute_pod_features(pod)
+            except Exception:
+                pass  # tensorize recomputes inline if the cache is absent
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
@@ -207,7 +235,13 @@ class Scheduler:
         if not pods:
             return []
         try:
-            results = self._schedule_batch_locked(pods, cycle)
+            results: List[ScheduleResult] = []
+            while pods:
+                # spread-carrying pods sub-chunk so soft scores refresh
+                # between chunks (core.soft_batch_limit)
+                limit = self.algorithm.soft_batch_limit(pods)
+                chunk, pods = pods[:limit], pods[limit:]
+                results.extend(self._schedule_batch_locked(chunk, cycle))
         finally:
             self._in_flight = 0
         return results
@@ -265,16 +299,30 @@ class Scheduler:
         start = self.scheduled_count
         prev: Optional[tuple] = None        # (PendingBatch, cycle)
         expected_seq: Optional[int] = None
+        carry: List[Pod] = []               # soft-score sub-batch tail
         def _mark(n: int) -> None:
             self._in_flight += n
         try:
             while True:
                 cycle = self.queue.scheduling_cycle
-                pods = self.queue.pop_batch(self.batch_size, timeout=0,
-                                            on_pop=_mark)
+                if carry:
+                    pods, carry = carry, []
+                else:
+                    pods = self.queue.pop_batch(self.batch_size, timeout=0,
+                                                on_pop=_mark)
+                if pods:
+                    # spread-carrying pods schedule in sub-chunks so their
+                    # soft scores refresh as winners land (core.soft_batch_limit)
+                    limit = self.algorithm.soft_batch_limit(pods)
+                    if limit < len(pods):
+                        pods, carry = pods[:limit], pods[limit:]
                 if pods:
                     self.metrics.batch_size.observe(len(pods))
                 if not pods and prev is None:
+                    # drain the binder thread before declaring done: a
+                    # failed async bind may have requeued its pod
+                    if self._flush_binds():
+                        continue
                     break
                 pending = None
                 if pods:
@@ -399,6 +447,8 @@ class Scheduler:
             fresh.append(res)
         bound = fresh
         import time as _time
+        if self._async_bind and self._bind_pool is not None:
+            return self._assume_then_bind_async(bound)
         t_bind = _time.perf_counter()
         if self._bind_extender is not None:
             # extender-managed binding (ref: scheduler.go:411 GetBinder):
@@ -473,6 +523,95 @@ class Scheduler:
             self.queue.add_unschedulable_if_not_present(
                 pod, self.queue.scheduling_cycle)
         return n_assumed
+
+    def _assume_then_bind_async(self, bound: List[ScheduleResult]) -> int:
+        """Assume local clones NOW (the batch analog of scheduler.go:382's
+        assume-releases-the-loop), ship the bulk bind from the binder
+        thread. Returns the number of assumes (chain bookkeeping)."""
+        import time as _time
+        n_assumed = 0
+        pairs = []  # (result, assumed clone)
+        for res in bound:
+            out = serde.shallow_bind_clone(res.pod)
+            out.spec.node_name = res.node_name
+            self.queue.nominated.delete(out)
+            try:
+                self.cache.assume_pod(out)
+                n_assumed += 1
+            except ValueError:
+                if self.cache.assigned_node(
+                        out.metadata.key()) == res.node_name:
+                    pass  # already counted once on the right node
+                else:
+                    self.algorithm.mirror.invalidate_usage()
+                    continue
+            pairs.append((res, out))
+            with self._count_lock:
+                self.scheduled_count += 1
+            self.metrics.schedule_attempts.inc(result="scheduled")
+        if not pairs:
+            return n_assumed
+        bindings = [Binding(
+            metadata=ObjectMeta(name=res.pod.metadata.name,
+                                namespace=res.pod.metadata.namespace),
+            target=ObjectReference(kind="Node", name=res.node_name))
+            for res, _ in pairs]
+
+        def job():
+            t0 = _time.perf_counter()
+            try:
+                outs = self.client.pods().bind_bulk(bindings)
+            except Exception as e:
+                outs = [e] * len(pairs)
+            self.metrics.binding_duration.observe(_time.perf_counter() - t0)
+            self._reconcile_bind_outcomes(pairs, outs)
+        fut = self._bind_pool.submit(job)
+        # prune settled futures so the service-mode run loop (which never
+        # calls _flush_binds between cycles) doesn't grow this unboundedly
+        self._bind_futures = [f for f in self._bind_futures
+                              if not f.done()]
+        self._bind_futures.append(fut)
+        return n_assumed
+
+    def _reconcile_bind_outcomes(self, pairs, outs) -> None:
+        """Binder-thread half: a failed slot's pod was optimistically
+        assumed and counted — forget it, drop the adopted device usage
+        (a kernel winner that never lands is unrepairable by dirty rows),
+        and requeue unless it vanished."""
+        from ..state.store import ConflictError, NotFoundError
+        for (res, clone), out in zip(pairs, outs):
+            if not isinstance(out, Exception):
+                self.cache.finish_binding(clone)
+                continue
+            try:
+                self.cache.forget_pod(clone)
+            except Exception:
+                pass
+            self.algorithm.mirror.invalidate_usage()
+            with self._count_lock:
+                self.scheduled_count -= 1
+            self.metrics.schedule_attempts.inc(result="error")
+            self.metrics.pod_scheduling_errors.inc()
+            if isinstance(out, (NotFoundError, ConflictError)):
+                continue  # deleted in flight / already bound elsewhere
+            if res.pod.metadata.deletion_timestamp is not None:
+                continue
+            self.queue.add_unschedulable_if_not_present(
+                res.pod, self.queue.scheduling_cycle)
+
+    def _flush_binds(self) -> bool:
+        """Wait out every in-flight bind POST. True if any bind failed
+        (its pod may have been requeued — the drain loop re-checks)."""
+        futures, self._bind_futures = self._bind_futures, []
+        if not futures:
+            return False
+        before = self.metrics.pod_scheduling_errors.value()
+        for f in futures:
+            try:
+                f.result()
+            except Exception:
+                pass
+        return self.metrics.pod_scheduling_errors.value() > before
 
     def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
         self.unschedulable_count += 1
@@ -571,6 +710,9 @@ class Scheduler:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._bind_pool is not None:
+            self._flush_binds()
+            self._bind_pool.shutdown(wait=True)
         self.informers.stop()
 
     def wait_for_idle(self, timeout: float = 30.0,

@@ -397,6 +397,44 @@ def _canon_node_selector(pod: Pod) -> Tuple:
     return (sel, terms)
 
 
+def precompute_pod_features(pod: Pod) -> Tuple:
+    """Host-side per-pod feature extraction, cached on the pod object.
+
+    Everything here depends only on the pod spec — not on the mirror,
+    batch, or cluster state — so the scheduler's event handlers call it
+    from the INFORMER thread as pods enter the queue, taking this work off
+    the drain thread's critical path (the wire path's drain competes for
+    the GIL with watch decode; every microsecond moved off it is wall
+    time). PodBatchTensors reuses the signature; pods arriving without one
+    (direct queue adds in tests) compute it inline.
+
+    Cached on __dict__ under "_tsig"; a clone made via shallow_bind_clone
+    carries the cache but bound clones never re-enter tensorization (the
+    signature's node_name component would be stale there).
+    """
+    sig = pod.__dict__.get("_tsig")
+    if sig is not None:
+        return sig
+    from .nodeinfo import pod_resource, pod_resource_nonzero
+    reqs = helpers.pod_requests(pod)
+    # warm the per-spec memos consumed by assume/add_pod on the commit path
+    pod_resource(pod)
+    pod_resource_nonzero(pod)
+    helpers.pod_host_ports(pod)
+    helpers.pod_requests_nonzero(pod)
+    ckey0 = (_canon_tolerations(pod), _canon_node_selector(pod),
+             tuple(sorted(helpers.pod_host_ports(pod))),
+             pod.spec.node_name or "")
+    qos_be = _pod_qos(pod) == "BestEffort"
+    blocked = qos_be and not helpers.tolerates_taints(
+        pod.spec.tolerations,
+        [_pressure_taint(wellknown.TAINT_NODE_MEMORY_PRESSURE)],
+        effects=["NoSchedule"])
+    sig = (reqs, tuple(sorted(reqs.items())), qos_be, blocked, ckey0)
+    pod.__dict__["_tsig"] = sig
+    return sig
+
+
 class TermCompiler:
     """Compiles pod-side constraint terms into cached [capacity] bool vectors
     over the mirror's rows. Cache entries are invalidated by mirror epoch."""
@@ -481,27 +519,25 @@ class PodBatchTensors:
     def __init__(self, pods: List[Pod], mirror: TensorMirror,
                  terms: TermCompiler, extra_mask: Optional[np.ndarray] = None,
                  min_bucket: int = 8, seq_base: int = 0):
-        from .nodeinfo import pod_resource, pod_resource_nonzero
         self.pods = pods
         P = _bucket(len(pods), min_bucket)
         vocab = mirror.vocab
         # intern every requested resource FIRST so the mirror's column axis
-        # covers the batch (a dropped column would silently zero a request)
-        pod_reqs = []
+        # covers the batch (a dropped column would silently zero a request).
+        # The per-pod signature (requests, QoS, constraint key, warmed
+        # memos) is normally precomputed on the informer thread
+        # (precompute_pod_features); computing it here is the fallback.
+        sigs = []
         for pod in pods:
-            reqs = helpers.pod_requests(pod)
-            for rname in reqs:
+            sig = pod.__dict__.get("_tsig")
+            if sig is None:
+                sig = precompute_pod_features(pod)
+            sigs.append(sig)
+            for rname in sig[0]:
                 if rname not in (wellknown.RESOURCE_CPU, wellknown.RESOURCE_MEMORY,
                                  wellknown.RESOURCE_EPHEMERAL_STORAGE,
                                  wellknown.RESOURCE_PODS):
                     vocab.col(rname)
-            pod_reqs.append(reqs)
-            # warm the per-spec Resource/nonzero/ports memos on the canonical
-            # pod here, off the assume path: the bind clone copies spec's
-            # __dict__, so cache.assume_pod's NodeInfo.add_pod re-uses them
-            pod_resource(pod)
-            pod_resource_nonzero(pod)
-            helpers.pod_host_ports(pod)
         mirror.ensure_cols()
         R = mirror.t.n_cols
         N = mirror.t.capacity
@@ -535,17 +571,13 @@ class PodBatchTensors:
         tmpl_mask: List[int] = []
         tmpl_idx = np.zeros((P,), np.int32)
         for i, pod in enumerate(pods):
-            reqs = pod_reqs[i]
+            reqs, reqs_key, qos_be, blocked_sig, ckey0 = sigs[i]
             has_extra = extra_mask is not None and not extra_mask[i].all()
-            ckey = (_canon_tolerations(pod), _canon_node_selector(pod),
-                    tuple(sorted(helpers.pod_host_ports(pod))),
-                    pod.spec.node_name or "",
-                    extra_mask[i].tobytes() if has_extra else None)
-            # _pod_qos inspects per-container requests/limits (aggregate maps
-            # can't distinguish init-container-only BestEffort pods), so the
-            # QoS class itself is the template key component
-            tkey = (tuple(sorted(reqs.items())),
-                    _pod_qos(pod) == "BestEffort", ckey)
+            ckey = ckey0 + (extra_mask[i].tobytes() if has_extra else None,)
+            # the QoS class itself is a template key component (aggregate
+            # request maps can't distinguish init-container-only
+            # BestEffort pods)
+            tkey = (reqs_key, qos_be, ckey)
             t_i = tmpl.get(tkey)
             if t_i is None:
                 req_row = np.zeros((R,), np.float32)
@@ -561,11 +593,7 @@ class PodBatchTensors:
                     else:
                         req_row[vocab.col(rname)] = _f32_ceil(v)
                 nz = helpers.pod_requests_nonzero(pod)
-                blocked = (
-                    _pod_qos(pod) == "BestEffort" and not helpers.tolerates_taints(
-                        pod.spec.tolerations,
-                        [_pressure_taint(wellknown.TAINT_NODE_MEMORY_PRESSURE)],
-                        effects=["NoSchedule"]))
+                blocked = blocked_sig
                 u = uniq.get(ckey)
                 if u is None:
                     mask = terms.tolerations_vector(pod) & \

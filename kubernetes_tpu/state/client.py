@@ -51,6 +51,30 @@ class ResourceClient:
             validate_obj(obj)
         return self._store.create(self._resource, obj)
 
+    def create_bulk(self, objs) -> list:
+        """N creates, one store transaction (defaulting/validation still
+        per item). Result slots are stored objects or the Exception that
+        rejected that slot — a bad item does not abort its siblings."""
+        prepared = []
+        slots = []  # index into prepared, or an Exception
+        for obj in objs:
+            try:
+                obj = serde.deepcopy_obj(obj)
+                if self._namespaced and not obj.metadata.namespace:
+                    obj.metadata.namespace = self._effective_ns()
+                apply_defaults(obj)
+                if isinstance(obj, corev1.Service) and obj.spec.cluster_ip:
+                    self._resolve_cluster_ip_collision(obj)
+                if self._validate:
+                    validate_obj(obj)
+            except Exception as e:
+                slots.append(e)
+                continue
+            slots.append(len(prepared))
+            prepared.append(obj)
+        stored = self._store.create_bulk(self._resource, prepared)
+        return [s if isinstance(s, Exception) else stored[s] for s in slots]
+
     def _resolve_cluster_ip_collision(self, svc) -> None:
         """The ipallocator's uniqueness guarantee: the hash-derived default
         is salted until it collides with no existing service."""

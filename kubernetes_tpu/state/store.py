@@ -113,7 +113,12 @@ class Store:
         if wal_path is not None:
             self._replay_wal(wal_path)
             from .wal import WalWriter
-            self._wal = WalWriter(wal_path, sync=wal_sync)
+            # deferred mode (sync off): record encoding + file writes run
+            # on the WAL worker, off the write path's latency. wal_sync
+            # keeps the synchronous writer so flush() can fdatasync per txn
+            self._wal = WalWriter(wal_path, sync=wal_sync,
+                                  deferred=not wal_sync,
+                                  encoder=serde.encode_cached)
 
     # ---------------------------------------------------------------- wal
 
@@ -172,14 +177,25 @@ class Store:
                 f.truncate(clean_offset)
 
     def _journal(self, op: str, resource: str, obj: Any, rv: int) -> None:
-        """Called under the lock after a committed mutation."""
+        """Called under the lock after a committed mutation. The frozen
+        object is handed to the writer as-is; encoding (serde.encode_cached
+        — shared with the watch/list fan-out for the same revision) runs on
+        the WAL worker in deferred mode, immediately otherwise."""
         if self._wal is not None:
-            self._wal.append(op, resource, rv, serde.encode(obj),
+            self._wal.append(op, resource, rv, obj,
                              uid_counter=self._uid_counter)
 
     def _wal_commit(self) -> None:
         if self._wal is not None:
             self._wal.flush()
+
+    def flush_wal(self) -> None:
+        """Wait until every journaled record is in the file. In deferred
+        mode the worker lags the write path by design (a process crash can
+        lose that tail, same class as the OS buffer in non-sync mode);
+        graceful shutdown, compaction, and tests drain through here."""
+        if self._wal is not None:
+            self._wal.drain()
 
     def compact(self) -> None:
         """Rewrite the log as one PUT per live object (snapshot analog)."""
@@ -202,12 +218,13 @@ class Store:
                      uid_counter=self._uid_counter)
             for resource, bucket in self._data.items():
                 for (ns, name), (obj, rv) in bucket.items():
-                    w.append("PUT", resource, rv, serde.encode(obj),
+                    w.append("PUT", resource, rv, serde.encode_cached(obj),
                              uid_counter=self._uid_counter)
             w.flush()
             w.close()
             os.replace(tmp, path)
-            self._wal = WalWriter(path, sync=sync)
+            self._wal = WalWriter(path, sync=sync, deferred=not sync,
+                                  encoder=serde.encode_cached)
 
     def close(self) -> None:
         with self._lock:
@@ -220,34 +237,65 @@ class Store:
 
     def create(self, resource: str, obj: Any) -> Any:
         with self._lock:
-            # copy BEFORE any stamping: the caller may be holding a canonical
-            # object from get()/list(), which must never be written through
-            stored = serde.deepcopy_obj(obj)
-            meta = stored.metadata
-            if meta.generate_name and not meta.name:
-                self._uid_counter += 1
-                meta.name = f"{meta.generate_name}{self._uid_counter:x}"
-            key = (meta.namespace, meta.name)
-            bucket = self._data.setdefault(resource, {})
-            # an object pending finalization still owns its key (ref: the
-            # apiserver returns 409 AlreadyExists until finalizers clear)
-            if key in bucket:
-                raise AlreadyExistsError(f"{resource} {key} already exists")
-            self._rv += 1
-            if not meta.uid:
-                self._uid_counter += 1
-                meta.uid = f"uid-{self._uid_counter:08x}"
-            if meta.creation_timestamp is None:
-                from ..utils.clock import now_iso
-                meta.creation_timestamp = now_iso()
-            if meta.generation == 0 and hasattr(stored, "spec"):
-                meta.generation = 1  # ref: registry strategies PrepareForCreate
-            meta.resource_version = str(self._rv)
-            bucket[key] = (stored, self._rv)
-            self._journal("PUT", resource, stored, self._rv)
+            stored = self._create_locked(resource, obj)
             self._wal_commit()
-            self._publish(resource, WatchEvent(ADDED, stored, self._rv))
+            self._publish(resource,
+                          WatchEvent(ADDED, stored,
+                                     int(stored.metadata.resource_version)))
             return stored
+
+    def _create_locked(self, resource: str, obj: Any) -> Any:
+        """One create under the held lock — journaled but NOT wal-committed
+        or published; the caller batches those."""
+        # copy BEFORE any stamping: the caller may be holding a canonical
+        # object from get()/list(), which must never be written through
+        stored = serde.deepcopy_obj(obj)
+        meta = stored.metadata
+        if meta.generate_name and not meta.name:
+            self._uid_counter += 1
+            meta.name = f"{meta.generate_name}{self._uid_counter:x}"
+        key = (meta.namespace, meta.name)
+        bucket = self._data.setdefault(resource, {})
+        # an object pending finalization still owns its key (ref: the
+        # apiserver returns 409 AlreadyExists until finalizers clear)
+        if key in bucket:
+            raise AlreadyExistsError(f"{resource} {key} already exists")
+        self._rv += 1
+        if not meta.uid:
+            self._uid_counter += 1
+            meta.uid = f"uid-{self._uid_counter:08x}"
+        if meta.creation_timestamp is None:
+            from ..utils.clock import now_iso
+            meta.creation_timestamp = now_iso()
+        if meta.generation == 0 and hasattr(stored, "spec"):
+            meta.generation = 1  # ref: registry strategies PrepareForCreate
+        meta.resource_version = str(self._rv)
+        bucket[key] = (stored, self._rv)
+        self._journal("PUT", resource, stored, self._rv)
+        return stored
+
+    def create_bulk(self, resource: str, objs: List[Any]) -> List[Any]:
+        """N creates under ONE lock acquisition and ONE durability point —
+        the write-side analog of bulk_apply. Result slots are the stored
+        objects or the Exception that rejected that slot (AlreadyExists);
+        accepted items commit even when siblings fail, exactly like N
+        independent creates."""
+        out: List[Any] = []
+        events: List[WatchEvent] = []
+        with self._lock:
+            for obj in objs:
+                try:
+                    stored = self._create_locked(resource, obj)
+                except Exception as e:
+                    out.append(e)
+                    continue
+                out.append(stored)
+                events.append(WatchEvent(
+                    ADDED, stored, int(stored.metadata.resource_version)))
+            self._wal_commit()
+            for ev in events:
+                self._publish(resource, ev)
+        return out
 
     def update(self, resource: str, obj: Any, *, enforce_rv: bool = True) -> Any:
         with self._lock:

@@ -74,12 +74,24 @@ class _NativeAppender:
 
 
 class WalWriter:
-    """Append-side of the log. `native` reports which path is active."""
+    """Append-side of the log. `native` reports which path is active.
 
-    def __init__(self, path: str, sync: bool = False):
+    `deferred=True` moves record encoding + file writes onto a single
+    background worker: append() only enqueues (the store calls it under
+    its write lock, so queue order == rv order and the worker preserves
+    it). This takes the serialization cost off the write path's latency —
+    the same durability class as the buffered non-sync mode (a process
+    crash loses the unflushed tail either way; etcd's guarantee needs
+    wal_sync=True, where flush() drains the queue and fdatasyncs).
+    `encoder` converts non-dict payloads (frozen store objects) to
+    JSON-able dicts, worker-side when deferred."""
+
+    def __init__(self, path: str, sync: bool = False,
+                 deferred: bool = False, encoder=None):
         self.path = path
         self.sync = sync
         self.native = False
+        self._encoder = encoder
         from ..native import load
         lib = load("walcore")
         if lib is not None:
@@ -90,17 +102,87 @@ class WalWriter:
                 self._a = _PyAppender(path)
         else:
             self._a = _PyAppender(path)
+        self._q = None
+        self._worker = None
+        if deferred:
+            import queue as queue_mod
+            import threading
+            self._q = queue_mod.SimpleQueue()
+            # drain tracking by sequence number: a bare "drained" event
+            # races append (worker could flag empty between an appender's
+            # flag-clear and its put); written >= enqueued cannot
+            self._seq_lock = threading.Lock()
+            self._written_cond = threading.Condition(self._seq_lock)
+            self._enqueued_seq = 0
+            self._written_seq = 0
+            self._worker = threading.Thread(
+                target=self._run, daemon=True, name="wal-writer")
+            self._worker.start()
+
+    def _encode_record(self, op, resource, rv, obj_data, uid_counter):
+        if obj_data is not None and not isinstance(obj_data, dict) \
+                and self._encoder is not None:
+            obj_data = self._encoder(obj_data)
+        return json.dumps(
+            {"op": op, "resource": resource, "rv": rv, "uc": uid_counter,
+             "object": obj_data}, separators=(",", ":")).encode()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._a.append(self._encode_record(*item))
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            flush = self._q.empty()
+            if flush:
+                self._a.flush(False)
+            with self._seq_lock:
+                self._written_seq += 1
+                self._written_cond.notify_all()
 
     def append(self, op: str, resource: str, rv: int, obj_data,
                uid_counter: int = 0) -> None:
-        self._a.append(json.dumps(
-            {"op": op, "resource": resource, "rv": rv, "uc": uid_counter,
-             "object": obj_data}, separators=(",", ":")).encode())
+        if self._q is not None:
+            with self._seq_lock:
+                self._enqueued_seq += 1
+            self._q.put((op, resource, rv, obj_data, uid_counter))
+            return
+        self._a.append(self._encode_record(op, resource, rv, obj_data,
+                                           uid_counter))
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Wait until every record enqueued BEFORE this call hit the file
+        (deferred mode)."""
+        if self._q is None:
+            return
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._seq_lock:
+            target = self._enqueued_seq
+            while self._written_seq < target:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return
+                self._written_cond.wait(remaining)
+        self._a.flush(False)
 
     def flush(self) -> None:
+        if self._q is not None:
+            if not self.sync:
+                return  # worker flushes as its queue empties
+            self.drain()
         self._a.flush(self.sync)
 
     def close(self) -> None:
+        if self._q is not None:
+            self.drain()
+            self._q.put(None)
+            self._worker.join(timeout=30)
+            self._q = None
         self._a.close()
 
 

@@ -418,3 +418,58 @@ class TestBulkBindings:
             assert isinstance(outs2[0], ConflictError)
         finally:
             srv.stop()
+
+
+class TestBulkCreate:
+    def test_create_bulk_one_post_per_slot_results(self, server):
+        """A List POSTed to the collection creates every item in one store
+        transaction; a bad slot fails alone (and refunds its own quota
+        charge) while siblings commit."""
+        client = HTTPClient(server.address)
+        outs = client.pods("default").create_bulk(
+            [make_pod(f"m{i}") for i in range(5)])
+        assert len(outs) == 5
+        assert all(o and not isinstance(o, Exception) for o in outs)
+        assert len(client.pods("default").list()) == 5
+        # duplicate name fails its slot only
+        outs2 = client.pods("default").create_bulk(
+            [make_pod("m0"), make_pod("m9")])
+        assert isinstance(outs2[0], Exception)
+        assert outs2[1] and not isinstance(outs2[1], Exception)
+        assert client.pods("default").get("m9")
+        # watchers saw one ADDED per created pod
+        w = client.pods("default").watch(resource_version=0)
+        seen = set()
+        deadline = time.time() + 5
+        while len(seen) < 6 and time.time() < deadline:
+            try:
+                ev = w.events.get(timeout=1)
+            except Exception:
+                break
+            if ev is not None and ev.type == "ADDED":
+                seen.add(ev.object.metadata.name)
+        w.stop()
+        assert {f"m{i}" for i in range(5)} | {"m9"} <= seen
+
+    def test_create_bulk_quota_refund_per_slot(self, server):
+        client = HTTPClient(server.address)
+        client.resource_quotas("default").create(api.ResourceQuota(
+            metadata=api.ObjectMeta(name="q", namespace="default"),
+            spec=api.ResourceQuotaSpec(hard={"pods": Quantity(3)})))
+        client.pods("default").create(make_pod("dup"))
+        outs = client.pods("default").create_bulk(
+            [make_pod("dup"), make_pod("ok")])  # dup fails post-admission
+        assert isinstance(outs[0], Exception)
+        q = client.resource_quotas("default").get("q")
+        # dup's charge was refunded: only "dup" (pre-existing) + "ok" count
+        assert str(q.status.used.get("pods")) == "2"
+
+    def test_create_bulk_in_process(self):
+        from kubernetes_tpu.state import Client
+        c = Client()
+        outs = c.pods("default").create_bulk(
+            [make_pod("a"), make_pod("a"), make_pod("b")])
+        assert not isinstance(outs[0], Exception)
+        assert isinstance(outs[1], Exception)  # duplicate in same batch
+        assert not isinstance(outs[2], Exception)
+        assert outs[2].metadata.resource_version
