@@ -546,40 +546,197 @@ class BatchScheduler:
         batch.set_static_scores(
             np.arange(len(pods), dtype=np.int32), base + ext)
 
-    #: max batch size for pods whose soft scores drift in-batch (spread
-    #: counts freeze at batch start); env-tunable. 256 over hundreds of
-    #: nodes bounds the frozen-window imbalance to ~1 pod per domain.
+    #: max batch size for pods whose soft scores drift in-batch;
+    #: env-tunable. SelectorSpread is handled IN-SCAN by the kernel
+    #: (running group counts), so only preferred inter-pod (anti-)affinity
+    #: — whose topology credits still freeze at batch start — sub-chunks.
     SOFT_SCORE_CHUNK = 256
 
     def soft_batch_limit(self, pods: List[Pod]) -> int:
         """How many of these pods may schedule in ONE kernel batch without
-        visible soft-score drift. SelectorSpread scores change with every
-        in-batch winner (the serial reference re-counts per pod via
-        assume-between-iterations, selector_spreading.go:277); pods carrying
-        spread selectors therefore schedule in SOFT_SCORE_CHUNK sub-batches
-        so the counts refresh between chunks. Batches without spread
-        carriers (no owning service/controller) keep the full size — the
-        uniform/affinity hot paths are unaffected."""
+        visible soft-score drift. Preferred inter-pod (anti-)affinity
+        scores change with every in-batch winner; the serial reference
+        re-scores per pod via assume-between-iterations. Pods carrying
+        preferred terms schedule in SOFT_SCORE_CHUNK sub-batches so the
+        credits refresh between chunks; everything else (uniform, required
+        affinity, spread — the latter in-scan) keeps the full batch."""
         import os as _os
         chunk = int(_os.environ.get("SCHED_SOFT_SCORE_CHUNK",
                                     str(self.SOFT_SCORE_CHUNK)))
         if len(pods) <= chunk or chunk <= 0:
             return len(pods)
+        if self.scorer.weights.get("InterPodAffinityPriority"):
+            for pod in pods:
+                aff = pod.spec.affinity
+                if aff is None:
+                    continue
+                if (aff.pod_affinity is not None and
+                        aff.pod_affinity.preferred_during_scheduling_ignored_during_execution) or \
+                   (aff.pod_anti_affinity is not None and
+                        aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution):
+                    return chunk
+        # spread carriers beyond the in-scan group cap would otherwise run
+        # the whole batch on frozen counts — chunk so they refresh
         listers = self.scorer.listers
-        if listers is None or \
-                not self.scorer.weights.get("SelectorSpreadPriority"):
-            return len(pods)
-        memo: Dict[Tuple, bool] = {}
-        for pod in pods:
+        if listers is not None and \
+                self.scorer.weights.get("SelectorSpreadPriority"):
+            memo: Dict[Tuple, bool] = {}
+            n_groups = 0
+            for pod in pods:
+                key = (pod.metadata.namespace,
+                       tuple(sorted(pod.metadata.labels.items())))
+                v = memo.get(key)
+                if v is None:
+                    v = bool(listers.selectors_for_pod(pod))
+                    memo[key] = v
+                    if v:
+                        n_groups += 1
+                        if n_groups > self.SPREAD_GROUP_CAP:
+                            return chunk
+        return len(pods)
+
+    #: in-scan spread group cap per batch; overflow groups fall back to
+    #: the static (batch-start) spread row
+    SPREAD_GROUP_CAP = 7
+
+    def _assign_spread_groups(self, pods: List[Pod],
+                              batch: PodBatchTensors) -> bool:
+        """Group pods by (namespace, labels) whose selectors make them
+        spread carriers; install per-group base counts + zone ids so the
+        kernel scores SelectorSpread from RUNNING counts (the serial
+        semantics — selector_spreading.go:277 re-counts per pod)."""
+        listers = self.scorer.listers
+        weight = self.scorer.weights.get("SelectorSpreadPriority", 0)
+        if listers is None or not weight:
+            return False
+        from . import priorities as prios
+        self.scorer._refresh_epoch()
+        base_rows: List[np.ndarray] = []
+        group_sel: List[Tuple[str, list]] = []   # (namespace, selectors)
+        memo: Dict[Tuple, Optional[int]] = {}
+        for i, pod in enumerate(pods):
             key = (pod.metadata.namespace,
                    tuple(sorted(pod.metadata.labels.items())))
-            v = memo.get(key)
-            if v is None:
-                v = bool(listers.selectors_for_pod(pod))
-                memo[key] = v
-            if v:
-                return chunk
-        return len(pods)
+            g = memo.get(key, -2)
+            if g == -2:
+                g = None
+                meta = prios.PriorityMetadata(pod, listers)
+                if meta.pod_selectors and \
+                        len(base_rows) < self.SPREAD_GROUP_CAP:
+                    counts = self.scorer._spread_counts(pod, meta)
+                    if counts is not None:
+                        g = len(base_rows)
+                        base_rows.append(np.asarray(counts, np.float32))
+                        group_sel.append((pod.metadata.namespace,
+                                          meta.pod_selectors))
+                memo[key] = g
+            if g is not None:
+                batch.spread_gidx[i] = g
+        if not base_rows:
+            return False
+        # cross-group match matrix: a winner must bump every group whose
+        # selectors match its labels, not only its own (ns, labels) group
+        G = len(base_rows)
+        match = np.zeros((len(pods), G), np.float32)
+        mmemo: Dict[Tuple, np.ndarray] = {}
+        for i, pod in enumerate(pods):
+            key = (pod.metadata.namespace,
+                   tuple(sorted(pod.metadata.labels.items())))
+            row = mmemo.get(key)
+            if row is None:
+                row = np.zeros((G,), np.float32)
+                for g, (ns, sels) in enumerate(group_sel):
+                    if ns == pod.metadata.namespace and \
+                            all(sel(pod.metadata.labels) for sel in sels):
+                        row[g] = 1.0
+                mmemo[key] = row
+            match[i] = row
+        batch.set_spread(np.stack(base_rows), self.scorer._zone_ids,
+                         self.scorer._n_zones, float(weight), match=match)
+        return True
+
+    #: in-scan topology term cap per batch; bigger batches fall back to
+    #: the repair overlay + reassignment path entirely
+    TOPO_TERM_CAP = 512
+
+    def _assign_topology_terms(self, pods: List[Pod],
+                               batch: PodBatchTensors,
+                               profiles: Dict[int, AffinityProfile]) -> bool:
+        """In-scan required (anti-)affinity tables: the kernel scan tracks
+        per-(term, domain) winner-match counts so each pod's feasibility
+        respects EARLIER SAME-BATCH winners — the serial reference's
+        assume-between-iterations visibility (scheduler.go:514), which the
+        frozen batch-start mask lacks. The repair overlay stays as the
+        validator for ports/volumes/chained-predecessor winners."""
+        if not profiles:
+            return False
+        idx = self.topology
+        anti_tids: List[int] = []
+        aff_tids: List[int] = []
+        seen: set = set()
+        for prof in profiles.values():
+            for tid in prof.req_anti:
+                if tid not in seen:
+                    seen.add(tid)
+                    anti_tids.append(tid)
+            for tid, waived in prof.req_aff:
+                if waived and tid not in seen:
+                    seen.add(tid)
+                    aff_tids.append(tid)
+        terms = anti_tids + aff_tids
+        if not terms or len(terms) > self.TOPO_TERM_CAP:
+            return False
+        N = self.mirror.t.capacity
+        T = len(terms)
+        P = len(pods)
+        dom = np.full((T, N), -1, np.int32)
+        n_domains = 1
+        for j, tid in enumerate(terms):
+            term = idx._by_id[tid]
+            # _node_dom_vec handles missing/short entries (capacity-sized,
+            # -1 for label-absent rows)
+            nd = idx._node_dom_vec(term.tk)
+            dom[j] = nd[:N]
+            if len(nd):
+                n_domains = max(n_domains, int(nd.max()) + 1)
+        tpos = {tid: j for j, tid in enumerate(terms)}
+        # per-pod [K] term-index lists (-1 padded): the kernel's cost per
+        # scan step is O(K*N), independent of the batch's term union
+        anti_l: List[List[int]] = []
+        aff_l: List[List[int]] = []
+        match_l: List[List[int]] = []
+        kmax = 1
+        match_memo: Dict[Tuple, List[int]] = {}
+        for i, pod in enumerate(pods):
+            prof = profiles.get(i)
+            a: List[int] = []
+            f: List[int] = []
+            if prof is not None:
+                a = [tpos[tid] for tid in prof.req_anti]
+                f = [tpos[tid] for tid, waived in prof.req_aff if waived]
+            mkey = (pod.metadata.namespace,
+                    tuple(sorted(pod.metadata.labels.items())))
+            m = match_memo.get(mkey)
+            if m is None:
+                m = [tpos[tid] for tid in idx.match_set(pod)
+                     if tid in tpos]
+                match_memo[mkey] = m
+            kmax = max(kmax, len(a), len(f), len(m))
+            anti_l.append(a)
+            aff_l.append(f)
+            match_l.append(m)
+        if kmax > 16:
+            return False  # degenerate term fan-out: repair path handles it
+
+        def to_arr(lists: List[List[int]]) -> np.ndarray:
+            K = max(1, kmax)
+            out = np.full((P, K), -1, np.int32)
+            for i, l in enumerate(lists):
+                out[i, :len(l)] = l
+            return out
+        batch.set_topology_terms(dom, n_domains, to_arr(anti_l),
+                                 to_arr(aff_l), to_arr(match_l))
+        return True
 
     def _make_reassigner(self, batch: Optional[PodBatchTensors],
                          stale_winners):
@@ -596,13 +753,17 @@ class BatchScheduler:
     def _repair_batch(self, results: List[ScheduleResult],
                       profiles: Dict[int, AffinityProfile],
                       stale_winners=None,
-                      batch: Optional[PodBatchTensors] = None) -> None:
+                      batch: Optional[PodBatchTensors] = None) -> bool:
         """Validate host-evaluated predicates against earlier winners in the
-        same batch; losers are demoted to retry. Skipped when nothing in the
-        batch carries ports/affinity/disk constraints. Affinity interactions
-        run against a BatchOverlay of winner term counts (O(terms) dict
-        lookups per pod) — the batch analog of the serial reference's
-        cache.AssumePod visibility between scheduleOne iterations."""
+        same batch; losers are demoted to retry or serially reassigned.
+        Skipped when nothing in the batch carries ports/affinity/disk
+        constraints. Affinity interactions run against a BatchOverlay of
+        winner term counts (O(terms) dict lookups per pod) — the batch
+        analog of the serial reference's cache.AssumePod visibility between
+        scheduleOne iterations. Returns True when any kernel winner was
+        demoted or reassigned — the kernel's in-scan counters then
+        over-state (they counted the original placement), so
+        kernel-unassigned pods must retry, not park."""
         # overlay NodeInfos (winner clones) are only consulted by the
         # ports/disk/attach checks — skip their maintenance entirely for
         # affinity-only batches (the deepcopy per winner is the cost)
@@ -611,7 +772,7 @@ class BatchScheduler:
             or _pod_has_pvc(r.pod) or _pod_has_attach_volumes(r.pod)
             for r in results)
         if not track_nodes and not profiles and not stale_winners:
-            return
+            return False
         overlay: Dict[str, NodeInfo] = {}
         #: affinity tracking only matters when some pod validates it or a
         #: chained predecessor's winners are invisible to this batch's mask
@@ -698,6 +859,7 @@ class BatchScheduler:
                     return pvs_c
             return None
 
+        winner_moved = False
         for i, res in enumerate(results):
             if res.node_name is None:
                 continue
@@ -708,6 +870,7 @@ class BatchScheduler:
             ok, pvs = node_passes(i, pod, res.node_name, has_ports,
                                   has_disk, has_attach)
             if not ok:
+                winner_moved = True
                 # the serial reference would just have picked the next-best
                 # node for this pod; do that here instead of a retry round
                 pvs = try_reassign(i, res, has_ports, has_disk, has_attach)
@@ -733,6 +896,7 @@ class BatchScheduler:
             # adopted usage counted them on; no dirty row repairs that —
             # drop device usage so the next launch re-uploads host truth
             self.mirror.invalidate_usage()
+        return winner_moved
 
     # ------------------------------------------------------------- schedule
 
@@ -826,6 +990,8 @@ class BatchScheduler:
         w = self.scorer.weights
         batch.resource_weights[0] = w.get("LeastRequestedPriority", 1)
         batch.resource_weights[1] = w.get("BalancedResourceAllocation", 1)
+        spread_present = self._assign_spread_groups(pods, batch)
+        self._assign_topology_terms(pods, batch, profiles)
         nom_dev = self._nominated_device()
         if nom_dev is not None:
             # each pod's own nominated row, from the EXACT snapshot the
@@ -837,9 +1003,11 @@ class BatchScheduler:
                     batch.nom_row[i] = row
         static = self.scorer.static_scores(pods, batch)
         has_prio_ext = any(e.config.prioritize_verb for e in self.extenders)
-        # hysteresis: while static scores are in play, later launches refuse
-        # the chain up front (before tensorize) instead of discarding work
-        self._static_likely = static is not None or has_prio_ext
+        # hysteresis: while static scores (or in-scan spread groups, whose
+        # base counts must fold each batch's winners) are in play, later
+        # launches refuse the chain up front instead of discarding work
+        self._static_likely = static is not None or has_prio_ext \
+            or spread_present
         if has_prio_ext:
             if chaining:
                 return None  # host scores would lag the uncommitted chain
@@ -848,6 +1016,11 @@ class BatchScheduler:
             if chaining:
                 return None
             batch.set_static_scores(*static)
+        if chaining and spread_present:
+            # spread base counts were computed from the committed state;
+            # a chained launch's usage includes UNCOMMITTED winners the
+            # counts don't — relaunch sequentially after the commit
+            return None
         if chaining and not self.mirror.device_ready():
             return None  # tensorize grew the column axis; chain handle stale
         if chaining:
@@ -882,8 +1055,17 @@ class BatchScheduler:
             for r in out:
                 if r.node_name is None:
                     r.retry = True
-        self._repair_batch(out, pending.profiles, pending.stale_winners,
-                           batch=pending.batch)
+        moved = self._repair_batch(out, pending.profiles,
+                                   pending.stale_winners,
+                                   batch=pending.batch)
+        if moved and pending.batch.anti_dom is not None:
+            # the in-scan (anti-)affinity counters counted a winner the
+            # repair moved/demoted: pods the scan left unassigned may have
+            # been blocked by that placement — retry them instead of
+            # parking (the next cycle's counters reflect host truth)
+            for r in out:
+                if r.node_name is None:
+                    r.retry = True
         if not any(r.retry for r in out) and \
                 pending.usage_epoch == self.mirror.usage_epoch:
             # every surviving assignment flows through cache.assume_pod, so

@@ -113,7 +113,40 @@ def _pod_score(node_cfg: dict, nz_used, pod: dict,
     return score + static_score
 
 
-_BATCH_INVARIANT = ("unique_masks", "unique_scores", "resource_weights")
+#: SelectorSpread zone blend weight (selector_spreading.go zoneWeighting)
+ZONE_WEIGHTING = 2.0 / 3.0
+
+_BATCH_INVARIANT = ("unique_masks", "unique_scores", "resource_weights",
+                    "spread_base", "spread_zone", "spread_zinit",
+                    "spread_weight", "anti_dom", "anti_cnt0")
+
+
+def _spread_score(cnt_g: jnp.ndarray, fits: jnp.ndarray,
+                  zone_of: jnp.ndarray, zinit: jnp.ndarray) -> jnp.ndarray:
+    """One pod's [N] SelectorSpread score from running group counts —
+    the serial reduce (priorities.selector_spread_reduce /
+    selector_spreading.go): invert node counts to 0-10 normalized over the
+    FEASIBLE set, blend zone-level counts at weight 2/3; zone id 0 means
+    'no zone label' (keeps the MaxPriority zone default, excluded from the
+    zone max). int() truncation == floor for these non-negatives."""
+    cf = jnp.where(fits, cnt_g, 0.0)
+    maxc = jnp.max(cf)
+    zs = zinit.at[zone_of].add(cf)
+    z_idx = jnp.arange(zs.shape[0])
+    maxz = jnp.max(jnp.where(z_idx > 0, zs, 0.0))
+    have_zones = jnp.any(fits & (zone_of > 0))
+    node_s = jnp.where(maxc > 0,
+                       MAX_PRIORITY * (maxc - cnt_g) / jnp.maximum(maxc, 1.0),
+                       MAX_PRIORITY)
+    zone_s = jnp.where((zone_of > 0) & (maxz > 0),
+                       MAX_PRIORITY * (maxz - zs[zone_of])
+                       / jnp.maximum(maxz, 1.0),
+                       MAX_PRIORITY)
+    blended = jnp.where(have_zones,
+                        node_s * (1.0 - ZONE_WEIGHTING)
+                        + ZONE_WEIGHTING * zone_s,
+                        node_s)
+    return jnp.floor(blended)
 
 
 def _split_batch(pod_batch: dict):
@@ -126,12 +159,27 @@ def _split_batch(pod_batch: dict):
     return per_pod, pod_batch["unique_masks"], pod_batch["unique_scores"], rw
 
 
+def _spread_tables(pod_batch: dict, N: int):
+    """(base [G,N], zone_of [N], zinit [Z], weight scalar) with inert
+    defaults for batches without spread groups."""
+    base = pod_batch.get("spread_base")
+    if base is None:
+        return (jnp.zeros((1, N), jnp.float32),
+                jnp.zeros((N,), jnp.int32),
+                jnp.zeros((1,), jnp.float32),
+                jnp.float32(0.0))
+    return (base, pod_batch["spread_zone"], pod_batch["spread_zinit"],
+            pod_batch["spread_weight"])
+
+
 @jax.jit
 def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The full pods x nodes mask + score matrix against the frozen snapshot
     (no in-batch usage updates). vmap over the pod axis."""
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
+    N = node_cfg["alloc"].shape[0]
+    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
 
     def one(pod):
         mask = unique_masks[pod["mask_idx"]]
@@ -139,6 +187,10 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
         fits = _pod_feasible(node_cfg, usage["used"], usage["pod_count"],
                              pod, mask)
         score = _pod_score(node_cfg, usage["nonzero_used"], pod, static, rw)
+        g = pod.get("spread_gidx", jnp.int32(-1))
+        use_spread = jnp.where(g >= 0, 1.0, 0.0)
+        score = score + spread_w * use_spread * _spread_score(
+            spread_base[jnp.maximum(g, 0)], fits, zone_of, zinit)
         return fits, jnp.where(fits, score, NEG)
     return jax.vmap(one)(per_pod)
 
@@ -167,21 +219,64 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     ranks against the snapshot)."""
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
+    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    #: in-scan required (anti-)affinity: per-term node->domain rows plus
+    #: running (term, domain) match counters — the BatchOverlay's
+    #: serial-winner visibility, ON DEVICE, so the kernel's picks already
+    #: respect earlier same-batch winners instead of being repaired after
+    anti_dom = pod_batch.get("anti_dom")        # [T, N] int32, -1=no label
+    has_topo = anti_dom is not None
     rows = jnp.arange(N, dtype=jnp.int32)
     if nom is None:
         nom = {"used": jnp.zeros_like(usage["used"]),
                "count": jnp.zeros_like(usage["pod_count"])}
 
     def step(carry, pod):
-        used, nz_used, pod_count = carry
         mask = unique_masks[pod["mask_idx"]]
         static = unique_scores[pod["score_idx"]]
         self_oh = rows == pod.get("nom_row", jnp.int32(-1))
-        eff_used = used + nom["used"] - \
+        eff_used = carry["used"] + nom["used"] - \
             jnp.where(self_oh[:, None], pod["req"][None, :], 0.0)
-        eff_count = pod_count + nom["count"] - self_oh.astype(jnp.float32)
+        eff_count = carry["pod_count"] + nom["count"] \
+            - self_oh.astype(jnp.float32)
         fits = _pod_feasible(node_cfg, eff_used, eff_count, pod, mask)
-        score = _pod_score(node_cfg, nz_used, pod, static, rw)
+        if has_topo:
+            # per-pod term lists ([K] tids, -1 padded) keep this O(K*N)
+            # per step instead of O(T*N): a pod carries/matches only a
+            # handful of terms, while the batch's union can be hundreds
+            cnt = carry["topo_cnt"]
+            tot = carry["topo_tot"]
+
+            def term_hit(tid):
+                """[N] bool: node's domain holds an in-batch winner
+                matching term `tid` (-1 = padding, never hits)."""
+                t = jnp.maximum(tid, 0)
+                drow = anti_dom[t]
+                at = cnt[t][jnp.maximum(drow, 0)]
+                return (tid >= 0) & (drow >= 0) & (at > 0.0)
+
+            bad = jnp.zeros((N,), bool)
+            for k in range(pod["anti_tids"].shape[0]):
+                # required anti-affinity: a carried term with a winner in
+                # the node's domain forbids the node
+                bad = bad | term_hit(pod["anti_tids"][k])
+            for k in range(pod["aff_tids"].shape[0]):
+                # waived required affinity: once ANY winner matches the
+                # term, later carriers must co-locate into its domain
+                tid = pod["aff_tids"][k]
+                need = (tid >= 0) & (tot[jnp.maximum(tid, 0)] > 0.0)
+                bad = bad | (need & ~term_hit(tid))
+            fits = fits & ~bad
+        score = _pod_score(node_cfg, carry["nz_used"], pod, static, rw)
+        # SelectorSpread runs IN-SCAN from running group counts — the
+        # serial reference recounts per pod via assume-between-iterations
+        # (selector_spreading.go:277); a frozen batch-start score would
+        # clump one controller's pods onto the same "least loaded" nodes
+        g = pod.get("spread_gidx", jnp.int32(-1))
+        gi = jnp.maximum(g, 0)
+        use_spread = jnp.where(g >= 0, 1.0, 0.0)
+        score = score + spread_w * use_spread * _spread_score(
+            carry["spread"][gi], fits, zone_of, zinit)
         masked = jnp.where(fits, score, NEG)
         # selectHost rotates among max-score ties across cycles (:286-296):
         # sub-integer hash penalty keyed on (row, pod seq). Base scores are
@@ -194,17 +289,43 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         ok = fits[best] & pod["active"]
         onehot = (rows == best) & ok
         oh_f = onehot.astype(jnp.float32)
-        used = used + oh_f[:, None] * pod["req"][None, :]
-        nz_used = nz_used + oh_f[:, None] * pod["nonzero_req"][None, :]
-        pod_count = pod_count + oh_f
+        # a winner bumps EVERY spread group whose selectors match it (its
+        # spread_match row), not only its own — overlapping groups see
+        # each other's in-batch placements like the serial re-count does
+        sm = pod.get("spread_match")
+        if sm is None:
+            sm = jnp.zeros((carry["spread"].shape[0],), jnp.float32)
+        ok_f = jnp.where(ok, 1.0, 0.0)
+        out = {
+            "used": carry["used"] + oh_f[:, None] * pod["req"][None, :],
+            "nz_used": carry["nz_used"]
+            + oh_f[:, None] * pod["nonzero_req"][None, :],
+            "pod_count": carry["pod_count"] + oh_f,
+            "spread": carry["spread"].at[:, best].add(sm * ok_f),
+        }
+        if has_topo:
+            new_cnt, new_tot = carry["topo_cnt"], carry["topo_tot"]
+            for k in range(pod["match_tids"].shape[0]):
+                tid = pod["match_tids"][k]
+                t = jnp.maximum(tid, 0)
+                d = anti_dom[t, best]
+                val = ((tid >= 0) & (d >= 0) & ok).astype(jnp.float32)
+                new_cnt = new_cnt.at[t, jnp.maximum(d, 0)].add(val)
+                new_tot = new_tot.at[t].add(val)
+            out["topo_cnt"] = new_cnt
+            out["topo_tot"] = new_tot
         assign = jnp.where(ok, best, jnp.int32(-1))
-        return (used, nz_used, pod_count), (assign, masked[best])
+        return out, (assign, masked[best])
 
-    carry0 = (usage["used"], usage["nonzero_used"], usage["pod_count"])
-    (used, nz_used, pod_count), (assign, scores) = lax.scan(
-        step, carry0, per_pod)
-    return assign, scores, {"used": used, "nonzero_used": nz_used,
-                            "pod_count": pod_count}
+    carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
+              "pod_count": usage["pod_count"], "spread": spread_base}
+    if has_topo:
+        carry0["topo_cnt"] = pod_batch["anti_cnt0"]
+        carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
+    final, (assign, scores) = lax.scan(step, carry0, per_pod)
+    return assign, scores, {"used": final["used"],
+                            "nonzero_used": final["nz_used"],
+                            "pod_count": final["pod_count"]}
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

@@ -285,13 +285,18 @@ class ScoreCompiler:
             skey = self._pod_score_key(pod)
             if skey is None:
                 continue
+            # pods in an in-scan spread group get their spread component
+            # from the kernel's running counts — the static row must not
+            # double-count it
+            kernel_spread = bool(batch.spread_gidx[i] >= 0)
             # the feasible set (normalization domain) depends on the mask
             # row, the request columns, and the pressure flag
             key = (skey, int(batch.mask_idx[i]), batch.req[i].tobytes(),
-                   bool(batch.mem_pressure_blocked[i]))
+                   bool(batch.mem_pressure_blocked[i]), kernel_spread)
             u = row_of.get(key)
             if u is None:
-                row = self._compute_row(pod, batch.fits_row(i))
+                row = self._compute_row(pod, batch.fits_row(i),
+                                        skip_spread=kernel_spread)
                 if row is None:
                     u = 0
                 else:
@@ -305,8 +310,8 @@ class ScoreCompiler:
             return None
         return score_idx, np.stack(rows)
 
-    def _compute_row(self, pod: Pod, fits: np.ndarray
-                     ) -> Optional[np.ndarray]:
+    def _compute_row(self, pod: Pod, fits: np.ndarray,
+                     skip_spread: bool = False) -> Optional[np.ndarray]:
         """One pod's weighted static score row [N] (None = all-constant)."""
         w = self.weights
         meta = prios.PriorityMetadata(pod, self.listers)
@@ -343,7 +348,7 @@ class ScoreCompiler:
             raw = self._avoid_raw(pod, meta)
             if raw is not None:
                 acc(raw, w["NodePreferAvoidPodsPriority"])
-        if w.get("SelectorSpreadPriority"):
+        if w.get("SelectorSpreadPriority") and not skip_spread:
             counts = self._spread_counts(pod, meta)
             if counts is not None and counts.any():
                 acc(self._spread_reduce(counts, fits),

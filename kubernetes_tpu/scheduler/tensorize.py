@@ -637,6 +637,70 @@ class PodBatchTensors:
         # [LeastRequested, BalancedAllocation] weights for the device scan
         # (Policy-configurable; defaults.go:126-137 defaults both to 1)
         self.resource_weights = np.ones((2,), np.float32)
+        # in-scan SelectorSpread groups (core._assign_spread_groups): pods
+        # sharing (namespace, selector set) share a group whose per-node
+        # match counts update inside the kernel scan
+        self.spread_gidx = np.full((P,), -1, np.int32)
+        self.spread_base: Optional[np.ndarray] = None   # [G, N] f32
+        self.spread_zone: Optional[np.ndarray] = None   # [N] int32 (0=no zone)
+        self.spread_zinit: Optional[np.ndarray] = None  # [Z] f32 zeros
+        self.spread_match: Optional[np.ndarray] = None  # [P, G] f32
+        self.spread_weight = 0.0
+
+        # in-scan required (anti-)affinity term tables
+        # (core._assign_topology_terms)
+        self.anti_dom: Optional[np.ndarray] = None      # [T, N] int32
+        self.anti_cnt0: Optional[np.ndarray] = None     # [T, D] f32 zeros
+        self.anti_tids: Optional[np.ndarray] = None     # [P, K] int32 (-1 pad)
+        self.aff_tids: Optional[np.ndarray] = None      # [P, K] int32
+        self.match_tids: Optional[np.ndarray] = None    # [P, K] int32
+
+    def set_topology_terms(self, dom: np.ndarray, n_domains: int,
+                           anti_tids: np.ndarray, aff_tids: np.ndarray,
+                           match_tids: np.ndarray) -> None:
+        """Install in-scan term tables; T and D bucketed (padded term rows
+        carry dom=-1 everywhere: never conflict, never bump). The per-pod
+        [K]-term lists keep the scan O(K*N) per step."""
+        T = _bucket(dom.shape[0], minimum=8)
+        P = self.req.shape[0]
+        dom_p = np.full((T, dom.shape[1]), -1, np.int32)
+        dom_p[:dom.shape[0]] = dom
+        self.anti_dom = dom_p
+        self.anti_cnt0 = np.zeros((T, _bucket(max(n_domains, 1),
+                                              minimum=64)), np.float32)
+
+        def pad(m):
+            out = np.full((P, m.shape[1]), -1, np.int32)
+            out[:m.shape[0]] = m
+            return out
+        self.anti_tids = pad(anti_tids)
+        self.aff_tids = pad(aff_tids)
+        self.match_tids = pad(match_tids)
+
+    def set_spread(self, base: np.ndarray, zone_of: np.ndarray,
+                   n_zones: int, weight: float,
+                   match: Optional[np.ndarray] = None) -> None:
+        """Install spread group tables (G and Z bucketed to bound XLA
+        recompiles across batches). `match` [P, G0] marks which groups'
+        selectors match each pod — a winner bumps EVERY matching group's
+        running count (overlapping selector groups see each other's
+        in-batch placements, like the serial re-count would)."""
+        G = _bucket(base.shape[0], minimum=1)
+        P = self.req.shape[0]
+        padded = np.zeros((G, base.shape[1]), np.float32)
+        padded[:base.shape[0]] = base
+        self.spread_base = padded
+        self.spread_zone = zone_of.astype(np.int32)
+        self.spread_zinit = np.zeros((_bucket(n_zones, minimum=8),),
+                                     np.float32)
+        self.spread_match = np.zeros((P, G), np.float32)
+        if match is not None:
+            self.spread_match[:match.shape[0], :match.shape[1]] = match
+        else:
+            for i, g in enumerate(self.spread_gidx):
+                if g >= 0:
+                    self.spread_match[i, g] = 1.0
+        self.spread_weight = float(weight)
 
     def set_static_scores(self, score_idx: np.ndarray,
                           unique_scores: np.ndarray) -> None:
@@ -678,14 +742,29 @@ class PodBatchTensors:
 
             def mask_put(a):
                 return jax.device_put(np.asarray(a), by_node)
-        return {"req": put(self.req),
-                "nonzero_req": put(self.nonzero_req),
-                "mem_pressure_blocked": put(self.mem_pressure_blocked),
-                "active": put(self.active),
-                "seq": put(self.seq),
-                "mask_idx": put(self.mask_idx),
-                "score_idx": put(self.score_idx),
-                "nom_row": put(self.nom_row),
-                "unique_masks": mask_put(self.unique_masks),
-                "unique_scores": mask_put(self.unique_scores),
-                "resource_weights": put(self.resource_weights)}
+        out = {"req": put(self.req),
+               "nonzero_req": put(self.nonzero_req),
+               "mem_pressure_blocked": put(self.mem_pressure_blocked),
+               "active": put(self.active),
+               "seq": put(self.seq),
+               "mask_idx": put(self.mask_idx),
+               "score_idx": put(self.score_idx),
+               "nom_row": put(self.nom_row),
+               "unique_masks": mask_put(self.unique_masks),
+               "unique_scores": mask_put(self.unique_scores),
+               "resource_weights": put(self.resource_weights)}
+        if self.spread_base is not None:
+            import jax.numpy as jnp
+            out["spread_gidx"] = put(self.spread_gidx)
+            out["spread_match"] = put(self.spread_match)
+            out["spread_base"] = mask_put(self.spread_base)
+            out["spread_zone"] = put(self.spread_zone)
+            out["spread_zinit"] = put(self.spread_zinit)
+            out["spread_weight"] = jnp.float32(self.spread_weight)
+        if self.anti_dom is not None:
+            out["anti_dom"] = mask_put(self.anti_dom)
+            out["anti_cnt0"] = put(self.anti_cnt0)
+            out["anti_tids"] = put(self.anti_tids)
+            out["aff_tids"] = put(self.aff_tids)
+            out["match_tids"] = put(self.match_tids)
+        return out
