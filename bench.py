@@ -418,12 +418,30 @@ def run_density_config(n_nodes, pods_per_node):
         # warm every power-of-two pod bucket the loop can pop — the
         # deployment controller trickles pods in, so the first real cycles
         # hit MANY bucket shapes; compiling them during the timed region
-        # would charge XLA compile time to pod-startup latency
+        # would charge XLA compile time to pod-startup latency. The REAL
+        # pods are Deployment-owned spread carriers, so the warm pods must
+        # be too (a spread-group batch is a different kernel trace: the
+        # in-scan SelectorSpread state changes the scan's signature)
+        client.services("default").create(api.Service(
+            metadata=api.ObjectMeta(name="warm-spread",
+                                    namespace="default"),
+            spec=api.ServiceSpec(selector={"bench-warm": "spread"})))
+        deadline = time.time() + 30
+        from kubernetes_tpu.api.core import Service as _Svc
+        svc_inf = sched.informers.informer_for(_Svc)
+        while svc_inf.indexer.get_by_key("default/warm-spread") is None:
+            if time.time() > deadline:
+                break
+            time.sleep(0.05)
+
+        def warm_pod(i):
+            p = make_pod(2_000_000 + i)
+            p.metadata.labels["bench-warm"] = "spread"
+            return p
         sched.algorithm.refresh()
         sz = batch_size
         while sz >= 1:
-            sched.algorithm.schedule(
-                [make_pod(2_000_000 + i) for i in range(sz)])
+            sched.algorithm.schedule([warm_pod(i) for i in range(sz)])
             sched.algorithm.mirror.invalidate_usage()
             sz //= 2
         _warm_dirty_scatter(sched)

@@ -26,8 +26,13 @@ from .rbac import (AggregationRule, ClusterRole, ClusterRoleBinding,
 from .defaults import default
 from .meta import (LabelSelector, LabelSelectorRequirement, ObjectMeta,
                    OwnerReference, controller_ref, new_controller_ref)
-from .policy import (Lease, PodDisruptionBudget, PodDisruptionBudgetSpec,
-                     PodDisruptionBudgetStatus, PriorityClass, StorageClass)
+from .policy import (Eviction, Lease, PodDisruptionBudget,
+                     PodDisruptionBudgetSpec, PodDisruptionBudgetStatus,
+                     PriorityClass, StorageClass)
+from .admissionregistration import (MutatingWebhookConfiguration,
+                                    RuleWithOperations,
+                                    ValidatingWebhookConfiguration, Webhook,
+                                    WebhookClientConfig)
 from .quantity import Quantity
 from .serde import decode, deepcopy_obj, encode, from_json_str, to_json_str
 from .validation import ValidationError, validate

@@ -1,0 +1,68 @@
+"""admissionregistration.k8s.io/v1 — webhook configurations.
+
+Ref: staging/src/k8s.io/api/admissionregistration/v1beta1/types.go and
+the dispatchers in staging/src/k8s.io/apiserver/pkg/admission/plugin/
+webhook/{mutating,validating}/plugin.go — the apiserver's primary
+out-of-process extensibility mechanism: admission requests fan out to
+registered HTTPS endpoints as AdmissionReview documents; mutating
+webhooks answer with a JSONPatch, validating webhooks allow/deny.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .meta import ObjectMeta
+
+
+@dataclass
+class WebhookClientConfig:
+    url: str = ""  # direct URL form (the service ref needs a dataplane)
+
+
+@dataclass
+class RuleWithOperations:
+    # absent lists mean match-all (serde's omitempty requires factory
+    # defaults to be EMPTY — a ["*"] default would not survive round-trip)
+    operations: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=list)
+    api_versions: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Webhook:
+    name: str = ""
+    client_config: WebhookClientConfig = field(
+        default_factory=WebhookClientConfig)
+    rules: List[RuleWithOperations] = field(default_factory=list)
+    #: Fail (deny on webhook error — the v1 default) | Ignore
+    failure_policy: str = "Fail"
+    timeout_seconds: int = 10
+
+    def matches(self, operation: str, resource: str) -> bool:
+        for rule in self.rules or [RuleWithOperations()]:
+            ops_ok = not rule.operations or "*" in rule.operations \
+                or operation in rule.operations
+            res_ok = not rule.resources or "*" in rule.resources \
+                or resource in rule.resources
+            if ops_ok and res_ok:
+                return True
+        return False
+
+
+@dataclass
+class MutatingWebhookConfiguration:
+    api_version: str = "admissionregistration.k8s.io/v1"
+    kind: str = "MutatingWebhookConfiguration"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)
+
+
+@dataclass
+class ValidatingWebhookConfiguration:
+    api_version: str = "admissionregistration.k8s.io/v1"
+    kind: str = "ValidatingWebhookConfiguration"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: List[Webhook] = field(default_factory=list)

@@ -40,6 +40,15 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class Eviction:
+    """The pods/eviction subresource body (ref: policy/v1beta1 Eviction,
+    pkg/registry/core/pod/storage/eviction.go — the PDB-guarded delete)."""
+    api_version: str = "policy/v1beta1"
+    kind: str = "Eviction"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+
+@dataclass
 class PriorityClass:
     api_version: str = "scheduling.k8s.io/v1"
     kind: str = "PriorityClass"

@@ -227,6 +227,211 @@ class ResourceQuotaAdmission:
             pass  # the controller's recalculation is the backstop
 
 
+# ------------------------------------------------------------------ webhooks
+
+class WebhookDispatcher:
+    """Out-of-process admission over HTTP (ref: apiserver/pkg/admission/
+    plugin/webhook/{mutating,validating}/plugin.go): webhook endpoints are
+    registered as STORED Mutating/ValidatingWebhookConfiguration objects;
+    each matching webhook receives an AdmissionReview POST
+
+        {"request": {"uid", "operation", "resource", "namespace",
+                     "object": <encoded>}}
+
+    and answers {"response": {"allowed": bool, "message"?,
+    "patch"?: base64 RFC6902, "patchType"?: "JSONPatch"}}. Mutating
+    webhooks run between the in-process mutators and the validators;
+    validating webhooks run last. A webhook that errors or times out
+    follows its failurePolicy: Fail denies the request (the v1 default),
+    Ignore skips the webhook."""
+
+    def __init__(self, client):
+        self.client = client
+
+    # ---- mutating (returns the possibly-patched object)
+
+    def _empty(self, kind_resource: str) -> bool:
+        store = getattr(self.client, "store", None)
+        return store is not None and store.count(kind_resource) == 0
+
+    def admit(self, operation: str, resource: str, obj: Any):
+        if self._empty("mutatingwebhookconfigurations"):
+            return obj  # O(1) fast path: no webhooks registered
+        from ..api.admissionregistration import MutatingWebhookConfiguration
+        for cfg in self.client.resource(
+                MutatingWebhookConfiguration).list():
+            for wh in cfg.webhooks:
+                if not wh.matches(operation, resource):
+                    continue
+                resp = self._call(wh, operation, resource, obj)
+                if resp is None:
+                    continue  # failurePolicy=Ignore swallowed an error
+                if not resp.get("allowed", False):
+                    self._deny(wh, resp)
+                patch_b64 = resp.get("patch")
+                if patch_b64:
+                    obj = self._apply_patch(obj, patch_b64)
+        return obj
+
+    # ---- validating
+
+    def validate(self, operation: str, resource: str, obj: Any) -> None:
+        if self._empty("validatingwebhookconfigurations"):
+            return
+        from ..api.admissionregistration import (
+            ValidatingWebhookConfiguration)
+        for cfg in self.client.resource(
+                ValidatingWebhookConfiguration).list():
+            for wh in cfg.webhooks:
+                if not wh.matches(operation, resource):
+                    continue
+                resp = self._call(wh, operation, resource, obj)
+                if resp is None:
+                    continue
+                if not resp.get("allowed", False):
+                    self._deny(wh, resp)
+
+    # ---- plumbing
+
+    def _deny(self, wh, resp) -> None:
+        from .server import AdmissionDenied
+        msg = (resp.get("status") or {}).get("message") \
+            or resp.get("message") or "denied"
+        raise AdmissionDenied(
+            f'admission webhook "{wh.name}" denied the request: {msg}')
+
+    def _call(self, wh, operation: str, resource: str, obj: Any):
+        """One AdmissionReview round trip, or None when an erroring
+        webhook's failurePolicy says Ignore."""
+        import json as _json
+        import uuid
+        from urllib import request as urlrequest
+        from ..api import serde
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": str(uuid.uuid4()),
+                "operation": operation,
+                "resource": resource,
+                "namespace": getattr(getattr(obj, "metadata", None),
+                                     "namespace", ""),
+                "object": serde.encode(obj),
+            }}
+        try:
+            req = urlrequest.Request(
+                wh.client_config.url,
+                data=_json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urlrequest.urlopen(
+                    req, timeout=max(1, wh.timeout_seconds)) as r:
+                body = _json.loads(r.read())
+            resp = body.get("response")
+            if not isinstance(resp, dict):
+                # a 200 without a usable response is a BROKEN webhook, not
+                # a verdict — it must follow failurePolicy like any error
+                raise ValueError("AdmissionReview reply has no response")
+            return resp
+        except Exception as e:
+            if wh.failure_policy == "Ignore":
+                return None
+            from .server import AdmissionDenied
+            raise AdmissionDenied(
+                f'admission webhook "{wh.name}" failed and '
+                f"failurePolicy is Fail: {e}")
+
+    def _apply_patch(self, obj: Any, patch_b64: str):
+        import base64
+        import json as _json
+        from ..api import serde
+        from ..api.patch import json_patch
+        ops = _json.loads(base64.b64decode(patch_b64))
+        merged = json_patch(serde.encode(obj), ops)
+        return serde.decode(type(obj), merged)
+
+
+# -------------------------------------------------------------- noderestriction
+
+class NodeRestriction:
+    """Validating plugin scoping what a NODE identity may create/modify
+    (ref: plugin/pkg/admission/noderestriction/admission.go:53): mirror
+    pods only onto itself, and only its own Node object. Complements the
+    Node authorizer — authorization can't inspect request BODIES, so a
+    node could otherwise create a pod bound to a different node."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def validate(self, operation: str, resource: str, obj: Any) -> None:
+        user = self._server.current_user()
+        if user is None or not user.name.startswith("system:node:") or \
+                "system:nodes" not in getattr(user, "groups", ()):
+            return
+        node = user.name[len("system:node:"):]
+        from .server import AdmissionDenied
+        if resource == "pods" and operation == "CREATE" and \
+                obj.spec.node_name != node:
+            raise AdmissionDenied(
+                f"node {node!r} may only create mirror pods bound to "
+                f"itself, not {obj.spec.node_name!r}")
+        if resource == "nodes" and obj.metadata.name != node:
+            raise AdmissionDenied(
+                f"node {node!r} may not modify node "
+                f"{obj.metadata.name!r}")
+
+
+# ------------------------------------------------------------------- priority
+
+class PriorityAdmission:
+    """Mutating plugin resolving spec.priorityClassName -> spec.priority at
+    pod CREATE (ref: plugin/pkg/admission/priority/admission.go:83-90).
+    Without it PriorityClass objects are decorative: the queue and
+    preemption read only the resolved integer. A named class must exist
+    (reject otherwise); with no name, the cluster's global-default class
+    applies, else priority 0."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def admit(self, operation: str, resource: str, obj: Any):
+        if operation != "CREATE" or resource != "pods":
+            return obj
+        name = obj.spec.priority_class_name
+        store = getattr(self.client, "store", None)
+        if not name and store is not None and \
+                store.count("priorityclasses") == 0:
+            # O(1) fast path for the overwhelmingly common case
+            if obj.spec.priority is None:
+                obj.spec.priority = 0
+            return obj
+        from ..state.store import NotFoundError
+        if name:
+            if name in ("system-cluster-critical", "system-node-critical"):
+                # the built-in system classes (ref: scheduling/v1 defaults)
+                obj.spec.priority = 2000000000 if \
+                    name == "system-cluster-critical" else 2000001000
+                return obj
+            try:
+                pc = self.client.priority_classes().get(name)
+            except NotFoundError:
+                from .server import AdmissionDenied
+                raise AdmissionDenied(
+                    f"no PriorityClass with name {name} was found")
+            obj.spec.priority = pc.value
+            return obj
+        if obj.spec.priority is None:
+            default = next(
+                (pc for pc in self.client.priority_classes().list()
+                 if pc.global_default), None)
+            if default is not None:
+                obj.spec.priority_class_name = default.metadata.name
+                obj.spec.priority = default.value
+            else:
+                obj.spec.priority = 0
+        return obj
+
+
 # -------------------------------------------------------------- serviceaccount
 
 class ServiceAccountAdmission:

@@ -175,6 +175,63 @@ class RBACAuthorizer:
         return False
 
 
+NODE_USER_PREFIX = "system:node:"
+
+
+class NodeAuthorizer:
+    """Scopes node identities (CN=system:node:<name>, O=system:nodes) to
+    their OWN objects (ref: plugin/pkg/auth/authorizer/node — the graph
+    authorizer, reduced to ownership rules): any kubelet credential could
+    otherwise write any node's status or any pod's status. Non-node users
+    fall through to the delegate (RBAC).
+
+    Divergence from the reference noted: the reference walks a live graph
+    to also scope secrets/configmaps/PVs to pods running on the node; here
+    node users simply have no read grant for those kinds unless the
+    delegate adds one."""
+
+    #: kinds a kubelet may read cluster-wide (the informer surfaces it runs)
+    READ_OK = ("nodes", "pods", "services", "endpoints", "leases",
+               "configmaps")
+
+    def __init__(self, delegate, pod_node_of=None):
+        self.delegate = delegate
+        #: (namespace, name) -> nodeName, for pods/status scoping
+        self._pod_node_of = pod_node_of or (lambda ns, name: None)
+
+    def authorize(self, user, verb: str, resource: str, namespace: str,
+                  name: str = "") -> bool:
+        if not (user.name.startswith(NODE_USER_PREFIX)
+                and "system:nodes" in user.groups):
+            return self.delegate.authorize(user, verb, resource, namespace,
+                                           name)
+        node = user.name[len(NODE_USER_PREFIX):]
+        base = resource.split("/")[0]
+        if verb in ("get", "list", "watch"):
+            return base in self.READ_OK
+        if base == "nodes":
+            # a node writes only ITSELF (status, lease-era heartbeats)
+            return name == node or (verb == "create" and not name)
+        if base == "leases":
+            return name == node or (verb == "create" and not name)
+        if base == "events":
+            return verb in ("create", "patch", "update")
+        if base == "certificatesigningrequests":
+            return verb == "create"  # serving-cert renewal
+        if resource in ("pods/status", "pods/eviction") or \
+                (resource == "pods" and verb in ("delete", "update",
+                                                 "patch")):
+            # a node touches (or evicts) only pods BOUND TO IT — the
+            # eviction subresource is a delete in disguise and gets the
+            # same scoping
+            bound = self._pod_node_of(namespace, name)
+            return bound == node
+        if resource == "pods" and verb == "create":
+            # mirror pods: NodeRestriction admission pins spec.nodeName
+            return True
+        return False
+
+
 class CertAuthenticator:
     """x509 client-certificate authentication: the TLS layer verified the
     chain against the client CA; this maps subject CN -> user and O ->

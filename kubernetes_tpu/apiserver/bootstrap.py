@@ -1,0 +1,224 @@
+"""Bootstrap token authentication + the signer/cleaner controllers.
+
+Ref: staging/src/k8s.io/apiserver/pkg/authentication/request/bearertoken +
+plugin/pkg/auth/authenticator/token/bootstrap (token secrets of type
+bootstrap.kubernetes.io/token in kube-system, token format
+"<id>.<secret>", user system:bootstrap:<id> in group system:bootstrappers)
+and pkg/controller/bootstrap/{bootstrapsigner,tokencleaner}.go (the signer
+publishes a JWS over the cluster-info ConfigMap per token so a joiner can
+verify the cluster with ONLY a token + CA hash; the cleaner deletes
+expired token secrets).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+from typing import Optional
+
+from ..utils.clock import now_iso, parse_iso
+
+SECRET_TYPE = "bootstrap.kubernetes.io/token"
+TOKEN_RE = re.compile(r"^([a-z0-9]{6})\.([a-z0-9]{16})$")
+CLUSTER_INFO = "cluster-info"
+KUBE_PUBLIC = "kube-public"
+
+
+def token_secret_name(token_id: str) -> str:
+    return f"bootstrap-token-{token_id}"
+
+
+def generate_token() -> str:
+    """A kubeadm-format token: 6-char id, 16-char secret."""
+    import secrets as pysecrets
+    alphabet = "abcdefghijklmnopqrstuvwxyz0123456789"
+    rid = "".join(pysecrets.choice(alphabet) for _ in range(6))
+    rsecret = "".join(pysecrets.choice(alphabet) for _ in range(16))
+    return f"{rid}.{rsecret}"
+
+
+def make_token_secret(token: str, expiration_iso: Optional[str] = None,
+                      usages=("authentication", "signing"),
+                      groups=("system:bootstrappers",)):
+    """The bootstrap-token-<id> Secret (ref: kubeadm token create)."""
+    from ..api.core import Secret
+    from ..api.meta import ObjectMeta
+    tid, tsecret = token.split(".", 1)
+    data = {
+        "token-id": base64.b64encode(tid.encode()).decode(),
+        "token-secret": base64.b64encode(tsecret.encode()).decode(),
+        "auth-extra-groups": base64.b64encode(
+            ",".join(groups).encode()).decode(),
+    }
+    for u in usages:
+        data[f"usage-bootstrap-{u}"] = base64.b64encode(b"true").decode()
+    if expiration_iso:
+        data["expiration"] = base64.b64encode(
+            expiration_iso.encode()).decode()
+    return Secret(metadata=ObjectMeta(name=token_secret_name(tid),
+                                      namespace="kube-system"),
+                  type=SECRET_TYPE, data=data)
+
+
+def _field(secret, key: str) -> str:
+    raw = secret.data.get(key, "")
+    if not raw:
+        return secret.string_data.get(key, "")
+    try:
+        return base64.b64decode(raw).decode()
+    except Exception:
+        return ""
+
+
+def _expired(secret) -> bool:
+    exp = _field(secret, "expiration")
+    if not exp:
+        return False
+    import datetime
+    when = parse_iso(exp)
+    if when is None:
+        # RFC3339 with an explicit offset (kubeadm writes isoformat())
+        try:
+            when = datetime.datetime.fromisoformat(
+                exp.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            return True  # unparseable expiry fails closed
+    return when <= datetime.datetime.now(datetime.timezone.utc).timestamp()
+
+
+class BootstrapTokenAuthenticator:
+    """Bearer authenticator over STORED token secrets — `kubeadm token
+    create` then works against a live cluster, and deleting the secret
+    revokes the token immediately. Composes after another authenticator
+    (static tokens) via `fallback`."""
+
+    def __init__(self, client, fallback=None):
+        self.client = client
+        self.fallback = fallback
+
+    def authenticate(self, authorization_header: str):
+        from .auth import ANONYMOUS
+        if not authorization_header:
+            return ANONYMOUS
+        scheme, _, token = authorization_header.partition(" ")
+        token = token.strip()
+        if scheme.lower() == "bearer" and TOKEN_RE.match(token):
+            tid, tsecret = token.split(".", 1)
+            from ..state.store import NotFoundError
+            try:
+                secret = self.client.secrets("kube-system").get(
+                    token_secret_name(tid))
+            except NotFoundError:
+                secret = None
+            if secret is not None and secret.type == SECRET_TYPE \
+                    and not _expired(secret) \
+                    and _field(secret, "usage-bootstrap-authentication") == "true" \
+                    and hmac.compare_digest(_field(secret, "token-secret"),
+                                            tsecret):
+                from .auth import UserInfo
+                groups = tuple(g for g in _field(
+                    secret, "auth-extra-groups").split(",") if g)
+                return UserInfo(f"system:bootstrap:{tid}",
+                                groups or ("system:bootstrappers",))
+        if self.fallback is not None:
+            return self.fallback.authenticate(authorization_header)
+        return None
+
+
+# ----------------------------------------------------------------------- jws
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def jws_sign(payload: str, token: str) -> str:
+    """Compact JWS (HS256, kid = token id) over the cluster-info kubeconfig
+    (ref: bootstrapsigner's detached JWS; full compact form here)."""
+    tid, tsecret = token.split(".", 1)
+    header = _b64url(json.dumps(
+        {"alg": "HS256", "kid": tid}, separators=(",", ":")).encode())
+    body = _b64url(payload.encode())
+    signing_input = f"{header}.{body}".encode()
+    sig = hmac.new(token.encode(), signing_input, hashlib.sha256).digest()
+    return f"{header}.{body}.{_b64url(sig)}"
+
+
+def jws_verify(jws: str, payload: str, token: str) -> bool:
+    try:
+        header, body, _ = jws.split(".")
+    except ValueError:
+        return False
+    expect = jws_sign(payload, token)
+    return hmac.compare_digest(jws, expect) and \
+        _b64url(payload.encode()) == body
+
+
+class BootstrapSignerController:
+    """pkg/controller/bootstrap/bootstrapsigner.go: keep a
+    jws-kubeconfig-<tokenID> signature on the kube-public cluster-info
+    ConfigMap for every signing-usage token, so an UNAUTHENTICATED joiner
+    can verify the cluster info with only its token."""
+
+    name = "bootstrapsigner"
+
+    def __init__(self, client):
+        self.client = client
+
+    def sync_once(self) -> None:
+        from ..state.store import NotFoundError
+        try:
+            info = self.client.config_maps(KUBE_PUBLIC).get(CLUSTER_INFO)
+        except NotFoundError:
+            return
+        payload = info.data.get("kubeconfig", "")
+        if not payload:
+            return
+        tokens = {}
+        for secret in self.client.secrets("kube-system").list():
+            if secret.type != SECRET_TYPE or _expired(secret):
+                continue
+            if _field(secret, "usage-bootstrap-signing") != "true":
+                continue
+            tid = _field(secret, "token-id")
+            tsecret = _field(secret, "token-secret")
+            if tid and tsecret:
+                tokens[tid] = f"{tid}.{tsecret}"
+        want = {f"jws-kubeconfig-{tid}": jws_sign(payload, tok)
+                for tid, tok in tokens.items()}
+        have = {k: v for k, v in info.data.items()
+                if k.startswith("jws-kubeconfig-")}
+        if want == have:
+            return
+
+        def mutate(cur):
+            for k in [k for k in cur.data if k.startswith("jws-kubeconfig-")]:
+                del cur.data[k]
+            cur.data.update(want)
+            return cur
+        try:
+            self.client.config_maps(KUBE_PUBLIC).patch(CLUSTER_INFO, mutate)
+        except NotFoundError:
+            pass
+
+
+class TokenCleanerController:
+    """pkg/controller/bootstrap/tokencleaner.go: expired bootstrap token
+    secrets are deleted (revocation by time)."""
+
+    name = "tokencleaner"
+
+    def __init__(self, client):
+        self.client = client
+
+    def sync_once(self) -> None:
+        from ..state.store import NotFoundError
+        for secret in self.client.secrets("kube-system").list():
+            if secret.type == SECRET_TYPE and _expired(secret):
+                try:
+                    self.client.secrets("kube-system").delete(
+                        secret.metadata.name)
+                except NotFoundError:
+                    pass

@@ -26,6 +26,10 @@ from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
                            NotFoundError, WatchEvent)
 
 
+class TooManyRequestsError(RuntimeError):
+    """HTTP 429 from the server's overload protection (max-inflight)."""
+
+
 def _raise_for(status: int, body: str) -> None:
     try:
         msg = json.loads(body).get("message", body)
@@ -35,6 +39,14 @@ def _raise_for(status: int, body: str) -> None:
         raise PermissionError(f"Unauthorized: {msg}")
     if status == 403:
         raise PermissionError(f"Forbidden: {msg}")
+    if status == 429:
+        # two distinct 429s: a PDB-refused eviction vs the server's
+        # inflight overload limiter — callers handle them differently
+        # (drain waits on budgets; overload is a generic retry)
+        if "disruption budget" in msg:
+            from ..state.client import TooManyDisruptions
+            raise TooManyDisruptions(msg)
+        raise TooManyRequestsError(msg)
     if status == 404:
         raise NotFoundError(msg)
     if status == 410:
@@ -315,6 +327,16 @@ class HTTPResourceClient:
 
 
 class HTTPPodClient(HTTPResourceClient):
+    def evict(self, name: str, namespace: Optional[str] = None):
+        """POST the pods/eviction subresource (PDB-guarded delete). Raises
+        TooManyDisruptions on a 429 budget refusal."""
+        ns = namespace if namespace is not None else self._effective_ns()
+        body = {"apiVersion": "policy/v1beta1", "kind": "Eviction",
+                "metadata": {"name": name, "namespace": ns}}
+        return self._request(
+            "POST", self._url(name, namespace=ns, subresource="eviction"),
+            body, content_type="application/json")
+
     def bind(self, binding: corev1.Binding):
         ns = binding.metadata.namespace or self._effective_ns()
         return self._decode(self._request(

@@ -36,7 +36,7 @@ from ..api.core import Binding
 from .admission import QuotaExceeded
 from ..api.validation import ValidationError
 from ..runtime.scheme import SCHEME, Scheme
-from ..state.client import Client
+from ..state.client import Client, TooManyDisruptions
 from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
                            NotFoundError, Store)
 
@@ -83,7 +83,11 @@ class APIServer:
                  audit_log_path: Optional[str] = None,
                  tls_cert_file: Optional[str] = None,
                  tls_key_file: Optional[str] = None,
-                 client_ca_file: Optional[str] = None):
+                 client_ca_file: Optional[str] = None,
+                 max_mutating_inflight: int = 200,
+                 max_nonmutating_inflight: int = 400,
+                 request_timeout: float = 60.0,
+                 cors_allowed_origins: Optional[List[str]] = None):
         self.client = Client(store)
         self.store = self.client.store
         self.scheme = scheme
@@ -103,8 +107,10 @@ class APIServer:
         # default-enabled plugins (ref: kube-apiserver's default enabled
         # admission set includes LimitRanger and ResourceQuota; both no-op
         # in namespaces carrying no LimitRange/ResourceQuota objects)
-        from .admission import (LimitRanger, ResourceQuotaAdmission,
+        from .admission import (LimitRanger, PriorityAdmission,
+                                ResourceQuotaAdmission,
                                 ServiceAccountAdmission)
+        self.admission.mutators.append(PriorityAdmission(self.client).admit)
         limitranger = LimitRanger(self.client)
         self.admission.mutators.append(limitranger.admit)
         self.admission.validators.append(limitranger.validate)
@@ -113,6 +119,33 @@ class APIServer:
         self.admission.validators.append(sa.validate)
         self._quota = ResourceQuotaAdmission(self.client)
         self.admission.validators.append(self._quota.validate)
+        from .admission import NodeRestriction
+        self.admission.validators.append(NodeRestriction(self).validate)
+        # out-of-process webhooks: mutating AFTER the in-process mutators
+        # (they see defaulted objects), validating LAST (ref: the
+        # reference's plugin ordering — ValidatingAdmissionWebhook at the
+        # end of the chain)
+        from .admission import WebhookDispatcher
+        webhooks = WebhookDispatcher(self.client)
+        self.admission.mutators.append(webhooks.admit)
+        self.admission.validators.append(webhooks.validate)
+        #: request-scoped authenticated user (ThreadingHTTPServer gives one
+        #: thread per request) — admission plugins that need the requester
+        #: (NodeRestriction) read it via current_user()
+        self._req_local = threading.local()
+        #: overload protection (ref: DefaultBuildHandlerChain's
+        #: max-in-flight slot, config.go:545 — split read/write pools so N
+        #: slow readers can't starve writes); watches are long-running and
+        #: exempt, like the reference's longRunningRequestCheck
+        self._read_sem = threading.BoundedSemaphore(
+            max_nonmutating_inflight) if max_nonmutating_inflight else None
+        self._write_sem = threading.BoundedSemaphore(
+            max_mutating_inflight) if max_mutating_inflight else None
+        #: per-request socket deadline for non-watch requests (the
+        #: timeout filter analog: a stalled client can't pin a worker
+        #: thread forever)
+        self._request_timeout = request_timeout
+        self._cors_origins = list(cors_allowed_origins or [])
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -135,6 +168,9 @@ class APIServer:
 
             def do_PATCH(self):
                 outer._dispatch(self, "PATCH")
+
+            def do_OPTIONS(self):
+                outer._preflight(self)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -305,18 +341,63 @@ class APIServer:
         sub = rest[2] if len(rest) > 2 else ""
         return _Request(resource, ns, name, sub, query)
 
+    def _preflight(self, h) -> None:
+        """CORS preflight (ref: the chain's CORS filter, config.go:552)."""
+        origin = h.headers.get("Origin", "")
+        h.send_response(204)
+        if self._cors_allowed(origin):
+            h.send_header("Access-Control-Allow-Origin", origin)
+            h.send_header("Access-Control-Allow-Methods",
+                          "GET, POST, PUT, PATCH, DELETE, OPTIONS")
+            h.send_header("Access-Control-Allow-Headers",
+                          "Content-Type, Authorization")
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    def _cors_allowed(self, origin: str) -> bool:
+        return bool(origin) and ("*" in self._cors_origins
+                                 or origin in self._cors_origins)
+
     def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        # CORS response header on every request from an allowed origin —
+        # reset unconditionally: keep-alive reuses the handler instance,
+        # so a stale grant must not leak onto the NEXT request
+        origin = h.headers.get("Origin", "")
+        h._cors_origin = origin if self._cors_allowed(origin) else None
+        # overload protection: try-acquire the verb class's inflight slot;
+        # full pool answers 429 + Retry-After instead of queueing the
+        # thread (watches are long-running and exempt)
+        is_watch = "watch=true" in (h.path or "") or \
+            "watch=1" in (h.path or "")
+        sem = None
+        if not is_watch:
+            sem = self._read_sem if method == "GET" else self._write_sem
+            if sem is not None and not sem.acquire(blocking=False):
+                self._error(h, 429, "TooManyRequests",
+                            "too many requests, please try again later",
+                            headers={"Retry-After": "1"})
+                return
+            if self._request_timeout:
+                try:
+                    h.connection.settimeout(self._request_timeout)
+                except Exception:
+                    pass
         try:
             self._dispatch_inner(h, method)
         finally:
-            # the ResponseComplete audit line fires after EVERY outcome,
-            # including the error mappings below (which set _audit_code)
-            ctx = getattr(h, "_audit_ctx", None)
-            if ctx is not None:
-                # consume the ctx: keep-alive reuses this handler for the
-                # next request, which must not replay this line
-                h._audit_ctx = None
-                self._audit(h, *ctx)
+            if sem is not None:
+                sem.release()
+            self._finish_audit(h)
+
+    def _finish_audit(self, h) -> None:
+        # the ResponseComplete audit line fires after EVERY outcome,
+        # including the error mappings (which set _audit_code)
+        ctx = getattr(h, "_audit_ctx", None)
+        if ctx is not None:
+            # consume the ctx: keep-alive reuses this handler for the
+            # next request, which must not replay this line
+            h._audit_ctx = None
+            self._audit(h, *ctx)
 
     def _dispatch_inner(self, h: BaseHTTPRequestHandler,
                         method: str) -> None:
@@ -352,6 +433,11 @@ class APIServer:
         except QuotaExceeded as e:
             # the reference's quota denial is 403 Forbidden, not 422
             self._error(h, 403, "Forbidden", str(e))
+        except TooManyDisruptions as e:
+            # a PDB-refused eviction: 429 + Retry-After (eviction.go's
+            # TooManyRequests with a 10s suggestion)
+            self._error(h, 429, "TooManyRequests", str(e),
+                        headers={"Retry-After": "10"})
         except (ValidationError, AdmissionDenied, ValueError) as e:
             self._error(h, 422, "Invalid", str(e))
         except (BrokenPipeError, ConnectionResetError):
@@ -482,7 +568,12 @@ class APIServer:
         else:
             self._error(h, 405, "MethodNotAllowed", method)
 
+    def current_user(self):
+        """The request's authenticated user (None on an open hub)."""
+        return getattr(self._req_local, "user", None)
+
     def _handle(self, h, method: str, req: _Request, cls, user=None) -> None:
+        self._req_local.user = user
         rc = self._rc(cls, req.namespace)
         if req.subresource == "scale":
             self._handle_scale(h, method, req, rc)
@@ -510,6 +601,16 @@ class APIServer:
             data = self._read_body(h)
             if data is None:
                 self._error(h, 422, "Invalid", "empty request body")
+                return
+            if req.resource == "pods" and req.subresource == "eviction":
+                # the Eviction API: PDB-guarded delete (ref:
+                # pkg/registry/core/pod/storage/eviction.go); a refused
+                # eviction is 429 TooManyRequests, mapped in dispatch
+                self.client.pods(req.namespace or None).evict(
+                    req.name, namespace=req.namespace or "default")
+                self._respond_raw(h, 200, json.dumps(
+                    {"apiVersion": "v1", "kind": "Status",
+                     "status": "Success"}).encode(), "application/json")
                 return
             if req.resource == "bindings":
                 # the scheduler's bulk bind: a List of Bindings lands as
@@ -922,16 +1023,24 @@ class APIServer:
             self._audit_file.write(line + "\n")
             self._audit_file.flush()
 
-    def _respond_raw(self, h, code: int, body: bytes, ctype: str) -> None:
+    def _respond_raw(self, h, code: int, body: bytes, ctype: str,
+                     headers: Optional[dict] = None) -> None:
         h._audit_code = code
         h.send_response(code)
         h.send_header("Content-Type", ctype)
         h.send_header("Content-Length", str(len(body)))
+        origin = getattr(h, "_cors_origin", None)
+        if origin:
+            h.send_header("Access-Control-Allow-Origin", origin)
+        for k, v in (headers or {}).items():
+            h.send_header(k, v)
         h.end_headers()
         h.wfile.write(body)
 
-    def _error(self, h, code: int, reason: str, message: str) -> None:
+    def _error(self, h, code: int, reason: str, message: str,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps({
             "apiVersion": "v1", "kind": "Status", "status": "Failure",
             "reason": reason, "message": message, "code": code}).encode()
-        self._respond_raw(h, code, body, "application/json")
+        self._respond_raw(h, code, body, "application/json",
+                          headers=headers)

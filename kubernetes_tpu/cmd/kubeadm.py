@@ -79,27 +79,59 @@ class ControlPlane:
             client_ca_file=self.pki["ca_cert"],
             audit_log_path=os.path.join(data_dir, "audit.log"))
         self._store = store
-        # bootstrap token (ref: kubeadm token): lets joiners create CSRs
-        self.bootstrap_token = secrets.token_hex(8)
-        tokens = TokenAuthenticator()
-        tokens.add(self.bootstrap_token, UserInfo(
-            "system:bootstrap:kubeadm", ("system:bootstrappers",)))
+        # bootstrap token (ref: kubeadm token create): a STORED secret of
+        # type bootstrap.kubernetes.io/token — authenticated live, revoked
+        # by deletion or expiry (tokencleaner)
+        from ..apiserver.bootstrap import (BootstrapTokenAuthenticator,
+                                           generate_token,
+                                           make_token_secret)
+        from ..utils.clock import now_iso
+        import datetime
+        self.bootstrap_token = generate_token()
+        expiry = (datetime.datetime.now(datetime.timezone.utc)
+                  + datetime.timedelta(hours=24)).isoformat()
+        self.server.client.secrets("kube-system").create(
+            make_token_secret(self.bootstrap_token, expiration_iso=expiry))
+        # cluster-info in kube-public (ref: kubeadm's uploadconfig phase):
+        # the UNAUTHENTICATED discovery document joiners verify via the
+        # bootstrapsigner's per-token JWS + the CA public-key hash
+        from ..api.core import ConfigMap
+        from ..api.meta import ObjectMeta
+        ca_pem = open(self.pki["ca_cert"], "rb").read()
+        cluster_info = json.dumps({
+            "server": self.server.address,
+            "certificate-authority-data":
+                base64.b64encode(ca_pem).decode()})
+        self.server.client.config_maps("kube-public").create(ConfigMap(
+            metadata=ObjectMeta(name="cluster-info",
+                                namespace="kube-public"),
+            data={"kubeconfig": cluster_info}))
         authz = RBACAuthorizer()
         authz.grant("group:system:masters", ["*"], ["*"])
         # bootstrappers may create and read CSRs, nothing else
         authz.grant("group:system:bootstrappers",
                     ["create", "get", "list", "watch"],
                     ["certificatesigningrequests"])
-        # node identities run kubelets (ref: the Node authorizer's scope,
-        # expressed as RBAC here)
-        authz.grant("group:system:nodes",
-                    ["get", "list", "watch", "create", "update", "patch",
-                     "delete"],
-                    ["nodes", "nodes/status", "pods", "pods/status",
-                     "leases", "events"])
+        # anonymous discovery: cluster-info only (ref: kubeadm's
+        # cluster-info RBAC for system:unauthenticated)
+        authz.grant("group:system:unauthenticated", ["get"],
+                    ["configmaps"], namespaces=("kube-public",))
         authz.use_store(self.server.client)
-        self.server.authenticator = CertAuthenticator(fallback=tokens)
-        self.server.authorizer = authz
+        # node identities are scoped by the Node authorizer (their OWN
+        # node/pods/lease only) instead of a broad RBAC grant
+        from ..apiserver.auth import NodeAuthorizer
+        from ..state.store import NotFoundError
+
+        def pod_node_of(ns, name):
+            try:
+                return self.server.client.pods(ns or "default") \
+                    .get(name).spec.node_name
+            except NotFoundError:
+                return None
+        self.server.authenticator = CertAuthenticator(
+            fallback=BootstrapTokenAuthenticator(self.server.client))
+        self.server.authorizer = NodeAuthorizer(authz,
+                                                pod_node_of=pod_node_of)
         self.manager = None
         self.scheduler = None
 
@@ -129,12 +161,54 @@ class ControlPlane:
         self._store.close()
 
 
+def discover_cluster_info(server_url: str, token: str,
+                          ca_cert_hash: Optional[str] = None,
+                          timeout: float = 30.0) -> bytes:
+    """kubeadm join's token discovery (ref: cmd/kubeadm/app/discovery/
+    token): fetch the kube-public cluster-info ConfigMap ANONYMOUSLY over
+    an unverified channel, then establish trust cryptographically —
+    (a) the per-token JWS signature proves the cluster knows our token,
+    (b) the CA public-key hash (when given) pins the CA against a
+    token-compromised MITM. Returns the verified CA PEM."""
+    import time as _t
+    from ..apiserver.bootstrap import jws_verify
+    from ..apiserver.httpclient import HTTPClient
+    from ..utils import certs as certutil
+    anon = HTTPClient(server_url, insecure_skip_tls_verify=True)
+    tid = token.split(".", 1)[0]
+    deadline = _t.time() + timeout
+    while True:
+        cm = anon.config_maps("kube-public").get("cluster-info")
+        payload = cm.data.get("kubeconfig", "")
+        jws = cm.data.get(f"jws-kubeconfig-{tid}", "")
+        if payload and jws:
+            break
+        # the bootstrapsigner may not have signed yet; poll
+        if _t.time() > deadline:
+            raise TimeoutError(
+                "cluster-info was never signed for this token")
+        _t.sleep(0.25)
+    if not jws_verify(jws, payload, token):
+        raise ValueError("cluster-info JWS verification failed "
+                         "(token mismatch or tampered discovery document)")
+    info = json.loads(payload)
+    ca_pem = base64.b64decode(info["certificate-authority-data"])
+    if ca_cert_hash is not None and \
+            certutil.ca_cert_hash(ca_pem) != ca_cert_hash:
+        raise ValueError("discovered CA does not match the supplied "
+                         "--discovery-token-ca-cert-hash")
+    return ca_pem
+
+
 def join_node(server_url: str, token: str, node_name: str,
               work_dir: str, ca_file: Optional[str] = None,
+              ca_cert_hash: Optional[str] = None,
               timeout: float = 60.0):
     """The kubelet TLS bootstrap (ref: kubeadm join + kubelet
-    certificate.Manager): CSR with the node identity, wait for the signed
-    cert, start the agent with it. Returns the running NodeAgent."""
+    certificate.Manager): discover + verify the cluster CA from only the
+    bootstrap token (and optional CA hash) when no ca_file is pre-shared,
+    then CSR with the node identity, wait for the signed cert, and start
+    the agent with it. Returns the running NodeAgent."""
     from ..api.certificates import (SIGNER_KUBELET_CLIENT,
                                     CertificateSigningRequest,
                                     CertificateSigningRequestSpec)
@@ -142,6 +216,12 @@ def join_node(server_url: str, token: str, node_name: str,
     from ..apiserver.httpclient import HTTPClient
     from ..utils import certs as certutil
     os.makedirs(work_dir, exist_ok=True)
+    if ca_file is None:
+        ca_pem = discover_cluster_info(server_url, token,
+                                       ca_cert_hash=ca_cert_hash,
+                                       timeout=min(30.0, timeout))
+        ca_file = _write(os.path.join(work_dir, "discovered-ca.crt"),
+                         ca_pem)
     csr_pem, key_pem = certutil.new_csr(
         f"system:node:{node_name}", organizations=("system:nodes",))
     key_file = _write(os.path.join(work_dir, f"{node_name}.key"), key_pem)

@@ -342,6 +342,58 @@ def cmd_uncordon(args) -> int:
     return _set_unschedulable(args, False, "uncordoned")
 
 
+def cmd_drain(args) -> int:
+    """kubectl drain: cordon, then evict every pod off the node through
+    the PDB-guarded eviction API, backing off while budgets refuse (ref:
+    pkg/kubectl/cmd/drain — GetPodsForDeletion filters + evictPods loop)."""
+    import time as _t
+    client = _client(args)
+    _set_unschedulable(args, True, "cordoned")
+    pending = []
+    for pod in client.pods(None).list(namespace=None):
+        if pod.spec.node_name != args.name:
+            continue
+        ref = next((r for r in pod.metadata.owner_references
+                    if r.controller), None)
+        if ref is not None and ref.kind == "DaemonSet":
+            if not args.ignore_daemonsets:
+                print(f"error: pod {pod.metadata.name} is DaemonSet-managed"
+                      f" (use --ignore-daemonsets)", file=sys.stderr)
+                return 1
+            print(f"ignoring DaemonSet-managed pod {pod.metadata.name}")
+            continue
+        if ref is None and not args.force:
+            print(f"error: pod {pod.metadata.name} has no controller "
+                  f"(use --force)", file=sys.stderr)
+            return 1
+        pending.append(pod)
+    from ..state.client import TooManyDisruptions
+    from ..state.store import NotFoundError
+    deadline = _t.time() + args.timeout
+    while pending:
+        still = []
+        for pod in pending:
+            try:
+                client.pods(pod.metadata.namespace).evict(
+                    pod.metadata.name, namespace=pod.metadata.namespace)
+                print(f"pod/{pod.metadata.name} evicted")
+            except NotFoundError:
+                pass  # already gone
+            except TooManyDisruptions:
+                still.append(pod)  # budget exhausted; retry after backoff
+        pending = still
+        if pending:
+            if _t.time() > deadline:
+                names = ", ".join(p.metadata.name for p in pending)
+                print(f"error: drain timed out waiting for disruption "
+                      f"budget; still on node: {names}", file=sys.stderr)
+                return 1
+            _t.sleep(min(args.poll_interval,
+                         max(0.0, deadline - _t.time())))
+    print(f"node/{args.name} drained")
+    return 0
+
+
 def cmd_rollout(args) -> int:
     """kubectl rollout status|restart <deploy|sts|ds> <name>."""
     resource, cls = _resolve(args.resource, _client(args))
@@ -556,6 +608,14 @@ def main(argv=None) -> int:
         c = sub.add_parser(verb)
         c.add_argument("name")
         c.set_defaults(fn=fn)
+
+    dr = sub.add_parser("drain")
+    dr.add_argument("name")
+    dr.add_argument("--ignore-daemonsets", action="store_true")
+    dr.add_argument("--force", action="store_true")
+    dr.add_argument("--timeout", type=float, default=60.0)
+    dr.add_argument("--poll-interval", type=float, default=0.5)
+    dr.set_defaults(fn=cmd_drain)
 
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "restart", "history",

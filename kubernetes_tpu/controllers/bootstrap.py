@@ -1,0 +1,59 @@
+"""bootstrapsigner + tokencleaner controllers.
+
+Ref: pkg/controller/bootstrap/{bootstrapsigner.go,tokencleaner.go} — the
+two bootstrap-token halves of the controller-manager: keep cluster-info's
+per-token JWS signatures fresh, and delete expired token secrets. The
+token/JWS mechanics live in apiserver/bootstrap.py (shared with the
+authenticator and kubeadm).
+"""
+
+from __future__ import annotations
+
+from ..api.core import ConfigMap, Secret
+from ..apiserver.bootstrap import (BootstrapSignerController as _Signer,
+                                   TokenCleanerController as _Cleaner)
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+
+class BootstrapSigner(Controller):
+    name = "bootstrapsigner"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 resync: float = 30.0):
+        super().__init__(workers=1)
+        self._impl = _Signer(client)
+        self.resync = resync
+        kick = EventHandlers(on_add=lambda o: self.enqueue("sign"),
+                             on_update=lambda o, n: self.enqueue("sign"),
+                             on_delete=lambda o: self.enqueue("sign"))
+        informers.informer_for(Secret).add_event_handlers(kick)
+        informers.informer_for(ConfigMap).add_event_handlers(kick)
+
+    def run(self) -> None:
+        super().run()
+        self.enqueue("sign")
+
+    def sync(self, key: str) -> None:
+        self._impl.sync_once()
+        self.enqueue_after("sign", self.resync)
+
+
+class TokenCleaner(Controller):
+    name = "tokencleaner"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 resync: float = 30.0):
+        super().__init__(workers=1)
+        self._impl = _Cleaner(client)
+        self.resync = resync
+        informers.informer_for(Secret).add_event_handlers(EventHandlers(
+            on_add=lambda o: self.enqueue("clean")))
+
+    def run(self) -> None:
+        super().run()
+        self.enqueue("clean")
+
+    def sync(self, key: str) -> None:
+        self._impl.sync_once()
+        self.enqueue_after("clean", self.resync)

@@ -96,6 +96,9 @@ class ControllerManager:
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
             period=podgc_period)
+        from .bootstrap import BootstrapSigner, TokenCleaner
+        self.bootstrapsigner = BootstrapSigner(client, self.informers)
+        self.tokencleaner = TokenCleaner(client, self.informers)
         self.controllers: List = [
             self.replicaset, self.replication,
             self.deployment, self.job, self.statefulset,
@@ -105,7 +108,8 @@ class ControllerManager:
             self.resourcequota, self.podautoscaler, self.serviceaccount,
             self.clusterrole_aggregation, self.nodeipam,
             self.pvc_protection, self.pv_protection, self.ttl,
-            self.attachdetach, self.pv_expander]
+            self.attachdetach, self.pv_expander,
+            self.bootstrapsigner, self.tokencleaner]
         if self.csrapproving is not None:
             self.controllers += [self.csrapproving, self.csrsigning,
                                  self.root_ca_publisher]

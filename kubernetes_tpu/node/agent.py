@@ -66,6 +66,10 @@ class NodeAgent:
         self.prober = ProbeManager(self.runtime)
         #: node-pressure eviction; disabled until a signal source is set
         self.eviction = eviction or EvictionManager()
+        #: synthetic load knob for the hollow dataplane: each Running
+        #: pod's reported cpu usage = its request x this fraction
+        #: (the /stats/summary source HPA scrapes)
+        self.cpu_utilization = 0.0
         #: static-pod manifests (ref: kubelet config/file source); mirror
         #: pods are published to the apiserver with the config.mirror
         #: annotation so the control plane can SEE them

@@ -23,10 +23,13 @@ class HollowCluster:
                  name_prefix: str = "hollow-node-",
                  heartbeat_period: float = 10.0,
                  pleg_period: float = 1.0,
-                 run_duration: Optional[float] = None):
+                 run_duration: Optional[float] = None,
+                 serve_stats: bool = False):
         self.client = client
         self.informers = SharedInformerFactory(client)
         self.agents: List[NodeAgent] = []
+        self.servers: list = []
+        self.serve_stats = serve_stats
         for i in range(n_nodes):
             self.agents.append(NodeAgent(
                 client, f"{name_prefix}{i}", self.informers,
@@ -41,9 +44,28 @@ class HollowCluster:
         self.informers.wait_for_cache_sync()
         for a in self.agents:
             a.start()
+        if self.serve_stats:
+            # one kubelet HTTP server per hollow node: the HPA's
+            # SummaryMetricsClient scrapes their /stats/summary
+            from .server import KubeletServer
+            for a in self.agents:
+                self.servers.append(KubeletServer(a).start())
         return self
 
+    def kubelet_urls(self) -> List[str]:
+        return [s.address for s in self.servers]
+
+    def set_cpu_utilization(self, frac: float) -> None:
+        """Synthetic load on every hollow node (usage = request x frac)."""
+        for a in self.agents:
+            a.cpu_utilization = frac
+
     def stop(self) -> None:
+        for s in self.servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
         for a in self.agents:
             a.stop()
         self.informers.stop()

@@ -66,6 +66,30 @@ class KubeletServer:
                     "items": [serde.encode(p) for p in pods]}
             self._raw(h, 200, json.dumps(body).encode(),
                       "application/json")
+        elif path == "/stats/summary":
+            # the resource-metrics source HPA scrapes (ref: pkg/kubelet/
+            # server/stats summary API): per-pod cpu usage, synthesized on
+            # the hollow dataplane as request x the agent's utilization knob
+            from ..api import helpers, wellknown
+            util = getattr(self.agent, "cpu_utilization", 0.0)
+            pods = self.agent.pod_informer.indexer.by_index(
+                "nodeName", self.agent.node_name)
+            items = []
+            for p in pods:
+                if p.status.phase != "Running":
+                    continue
+                req_milli = helpers.pod_requests(p).get(
+                    wellknown.RESOURCE_CPU, 0)
+                items.append({
+                    "podRef": {"name": p.metadata.name,
+                               "namespace": p.metadata.namespace},
+                    "cpu": {"usageNanoCores":
+                            int(req_milli * util * 1_000_000)},
+                })
+            body = {"node": {"nodeName": self.agent.node_name},
+                    "pods": items}
+            self._raw(h, 200, json.dumps(body).encode(),
+                      "application/json")
         elif path == "/metrics":
             rt = self.agent.runtime
             lines = [

@@ -145,6 +145,16 @@ def default_scheme() -> Scheme:
     s.register(CertificateSigningRequest, "certificates.k8s.io/v1",
                "CertificateSigningRequest", "certificatesigningrequests",
                namespaced=False)
+    from ..api.admissionregistration import (MutatingWebhookConfiguration,
+                                             ValidatingWebhookConfiguration)
+    s.register(MutatingWebhookConfiguration,
+               "admissionregistration.k8s.io/v1",
+               "MutatingWebhookConfiguration",
+               "mutatingwebhookconfigurations", namespaced=False)
+    s.register(ValidatingWebhookConfiguration,
+               "admissionregistration.k8s.io/v1",
+               "ValidatingWebhookConfiguration",
+               "validatingwebhookconfigurations", namespaced=False)
     return s
 
 

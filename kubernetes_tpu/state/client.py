@@ -228,6 +228,11 @@ def _bind_mutator(binding: corev1.Binding, now: Optional[str] = None):
     return mutate
 
 
+class TooManyDisruptions(Exception):
+    """Eviction refused by a PodDisruptionBudget (HTTP 429 analog —
+    callers back off and retry, ref: eviction.go's TooManyRequests)."""
+
+
 class PodClient(ResourceClient):
     def bind(self, binding: corev1.Binding):
         """The scheduler's bind subresource: sets spec.nodeName
@@ -235,6 +240,46 @@ class PodClient(ResourceClient):
         ns = binding.metadata.namespace or self._effective_ns()
         return self._store.guaranteed_update("pods", ns, binding.metadata.name,
                                              _bind_mutator(binding))
+
+    def evict(self, name: str, namespace: Optional[str] = None):
+        """The pods/eviction subresource: a PDB-guarded delete (ref:
+        pkg/registry/core/pod/storage/eviction.go:51-85). With a matching
+        PodDisruptionBudget, the delete is admitted only while
+        status.disruptions_allowed > 0 — decremented atomically (CAS) with
+        the pod recorded in status.disrupted_pods — else it raises
+        TooManyDisruptions (HTTP 429, the drain retries). Without a PDB
+        the eviction is a plain delete."""
+        from ..api import labels as labelsmod
+        from ..api.policy import PodDisruptionBudget
+        from ..utils.clock import now_iso
+        ns = namespace if namespace is not None else self._effective_ns()
+        pod = self.get(name, namespace=ns)
+        pdbs = []
+        for pdb in ResourceClient(self._store, self._scheme,
+                                  PodDisruptionBudget, ns).list(namespace=ns):
+            if pdb.spec.selector is not None and labelsmod.matches(
+                    pdb.spec.selector, pod.metadata.labels):
+                pdbs.append(pdb)
+        if len(pdbs) > 1:
+            # the reference refuses to guess which budget governs
+            raise ValueError(
+                f"pod {name} matches multiple PodDisruptionBudgets")
+        if pdbs:
+            pdb = pdbs[0]
+
+            def mutate(cur):
+                if cur.status.disruptions_allowed < 1:
+                    raise TooManyDisruptions(
+                        f"cannot evict pod {name}: disruption budget "
+                        f"{cur.metadata.name} needs "
+                        f"{cur.spec.min_available or cur.spec.max_unavailable}"
+                        f" and has no disruptions allowed")
+                cur.status.disruptions_allowed -= 1
+                cur.status.disrupted_pods[name] = now_iso()
+                return cur
+            self._store.guaranteed_update(
+                "poddisruptionbudgets", ns, pdb.metadata.name, mutate)
+        return self.delete(name, namespace=ns)
 
     def bind_bulk(self, bindings: List[corev1.Binding]) -> List[Any]:
         """N binds in one store transaction (the batch scheduler's bind

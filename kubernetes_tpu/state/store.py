@@ -457,6 +457,12 @@ class Store:
                 out.append(obj)  # frozen canonical objects: read-only
             return out, self._rv
 
+    def count(self, resource: str) -> int:
+        """O(1) object count — cheap emptiness checks for per-request
+        admission gates (webhook configs, priority classes)."""
+        with self._lock:
+            return len(self._data.get(resource, ()))
+
     @property
     def resource_version(self) -> int:
         with self._lock:

@@ -180,6 +180,17 @@ def csr_subject_of(csr_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
     return _subject(x509.load_pem_x509_csr(csr_pem).subject)
 
 
+def ca_cert_hash(ca_cert_pem: bytes) -> str:
+    """kubeadm's discovery-token-ca-cert-hash: sha256 over the CA's
+    SubjectPublicKeyInfo DER (ref: kubeadm pubkeypin)."""
+    import hashlib
+    cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    spki = cert.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return "sha256:" + hashlib.sha256(spki).hexdigest()
+
+
 def csr_sans_of(csr_pem: bytes) -> Tuple[str, ...]:
     """Requested SubjectAlternativeNames (DNS names + IPs as strings)."""
     csr = x509.load_pem_x509_csr(csr_pem)

@@ -229,6 +229,85 @@ class TestCSRPrivilegeBoundaries:
         assert names == {"n1.cluster.local", "10.0.0.5"}
 
 
+class TestBootstrapTokens:
+    def test_token_only_join_with_ca_hash(self, tmp_path):
+        """kubeadm join from ONLY a bootstrap token + CA hash: anonymous
+        cluster-info discovery, JWS verification against the token
+        (bootstrapsigner), CA pinning by public-key hash, then the CSR TLS
+        bootstrap — no pre-shared PKI material at all."""
+        from kubernetes_tpu.cmd.kubeadm import ControlPlane, join_node
+        from kubernetes_tpu.utils import certs as certutil
+        cp = ControlPlane(str(tmp_path / "cp")).start()
+        node = None
+        try:
+            ca_pem = open(cp.pki["ca_cert"], "rb").read()
+            ca_hash = certutil.ca_cert_hash(ca_pem)
+            node = join_node(cp.server.address, cp.bootstrap_token, "tn1",
+                             str(tmp_path / "tn1"),
+                             ca_cert_hash=ca_hash, timeout=45.0).start()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if any(n.metadata.name == "tn1"
+                       for n in cp.admin_client.nodes().list()):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("token-joined node never registered")
+        finally:
+            if node is not None:
+                node.stop()
+            cp.stop()
+
+    def test_wrong_ca_hash_rejected(self, tmp_path):
+        from kubernetes_tpu.cmd.kubeadm import ControlPlane, join_node
+        cp = ControlPlane(str(tmp_path / "cp")).start()
+        try:
+            with pytest.raises(ValueError, match="ca-cert-hash"):
+                join_node(cp.server.address, cp.bootstrap_token, "evil",
+                          str(tmp_path / "evil"),
+                          ca_cert_hash="sha256:" + "0" * 64, timeout=45.0)
+        finally:
+            cp.stop()
+
+    def test_bad_token_never_authenticates_or_verifies(self, tmp_path):
+        """A token the cluster does not know fails BOTH the JWS check
+        (discovery) and bearer authentication."""
+        from kubernetes_tpu.apiserver.httpclient import HTTPClient
+        from kubernetes_tpu.cmd.kubeadm import (ControlPlane,
+                                                discover_cluster_info)
+        cp = ControlPlane(str(tmp_path / "cp")).start()
+        try:
+            with pytest.raises((ValueError, TimeoutError)):
+                discover_cluster_info(cp.server.address,
+                                      "aaaaaa.bbbbbbbbbbbbbbbb",
+                                      timeout=3.0)
+            bad = HTTPClient(cp.server.address,
+                             token="aaaaaa.bbbbbbbbbbbbbbbb",
+                             insecure_skip_tls_verify=True)
+            with pytest.raises(PermissionError):
+                bad.certificate_signing_requests().list()
+        finally:
+            cp.stop()
+
+    def test_token_expiry_cleaned(self):
+        """tokencleaner deletes expired token secrets; the authenticator
+        refuses them even before cleanup."""
+        from kubernetes_tpu.apiserver.bootstrap import (
+            BootstrapTokenAuthenticator, TokenCleanerController,
+            make_token_secret, token_secret_name)
+        from kubernetes_tpu.state import Client
+        client = Client()
+        token = "abcdef.0123456789abcdef"
+        client.secrets("kube-system").create(make_token_secret(
+            token, expiration_iso="2000-01-01T00:00:00+00:00"))
+        authn = BootstrapTokenAuthenticator(client)
+        assert authn.authenticate(f"Bearer {token}") is None
+        TokenCleanerController(client).sync_once()
+        from kubernetes_tpu.state.store import NotFoundError
+        with pytest.raises(NotFoundError):
+            client.secrets("kube-system").get(token_secret_name("abcdef"))
+
+
 class TestKubeadm:
     def test_init_and_tls_bootstrap_join(self, tmp_path):
         """The full aha-flow: kubeadm init brings up a TLS control plane;

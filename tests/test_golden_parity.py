@@ -1,0 +1,185 @@
+"""Reference-pinned golden parity fixtures.
+
+tests/golden/scheduler_golden.json holds table cases hand-derived from
+the reference's own tests (predicates_test.go, least_requested_test.go,
+balanced_resource_allocation_test.go, selector_spreading_test.go — each
+case cites its source). The SAME expectations are asserted against:
+
+  1. the python oracle (scheduler.predicates / scheduler.priorities), and
+  2. the batch kernel (full scheduler pipeline over a live cluster state),
+
+so repo semantics cannot drift from reference-derived behavior without a
+failure here — closing the round-3 gap of parity being self-referential.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler import priorities as prios
+from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "scheduler_golden.json")
+
+
+def load_cases(kind):
+    with open(GOLDEN) as f:
+        return [pytest.param(c, id=c["name"])
+                for c in json.load(f)[kind]]
+
+
+def build_node(spec):
+    alloc = {}
+    if "cpu" in spec:
+        alloc["cpu"] = Quantity(spec["cpu"])
+    if "memory" in spec:
+        alloc["memory"] = Quantity(spec["memory"])
+    alloc["pods"] = Quantity(spec.get("pods", 110))
+    node = api.Node(
+        metadata=api.ObjectMeta(name=spec["name"],
+                                labels=dict(spec.get("labels", {}))),
+        status=api.NodeStatus(capacity=dict(alloc),
+                              allocatable=dict(alloc),
+                              conditions=[api.NodeCondition(
+                                  type="Ready", status="True")]))
+    if spec.get("unschedulable"):
+        node.spec.unschedulable = True
+    for t in spec.get("taints", []):
+        node.spec.taints.append(api.Taint(
+            key=t["key"], value=t.get("value", ""), effect=t["effect"]))
+    return node
+
+
+def build_pod(spec, namespace="default"):
+    reqs = {}
+    if "cpu" in spec:
+        reqs["cpu"] = Quantity(spec["cpu"])
+    if "memory" in spec:
+        reqs["memory"] = Quantity(spec["memory"])
+    container = api.Container(
+        name="c", image="img",
+        resources=api.ResourceRequirements(requests=reqs))
+    if "host_port" in spec:
+        container.ports = [api.ContainerPort(
+            container_port=spec["host_port"], host_port=spec["host_port"])]
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name=spec["name"], namespace=namespace,
+                                labels=dict(spec.get("labels", {}))),
+        spec=api.PodSpec(containers=[container]))
+    if "init_cpu" in spec or "init_memory" in spec:
+        ireqs = {}
+        if "init_cpu" in spec:
+            ireqs["cpu"] = Quantity(spec["init_cpu"])
+        if "init_memory" in spec:
+            ireqs["memory"] = Quantity(spec["init_memory"])
+        pod.spec.init_containers = [api.Container(
+            name="init", image="img",
+            resources=api.ResourceRequirements(requests=ireqs))]
+    if "node_selector" in spec:
+        pod.spec.node_selector = dict(spec["node_selector"])
+    for t in spec.get("tolerations", []):
+        pod.spec.tolerations.append(api.Toleration(
+            key=t["key"], operator=t.get("operator", "Equal"),
+            value=t.get("value", ""), effect=t.get("effect", "")))
+    if "gce_pd" in spec:
+        pod.spec.volumes = [api.Volume(
+            name="v", gce_persistent_disk={"pdName": spec["gce_pd"]})]
+    tk = spec.get("topology_key", "kubernetes.io/hostname")
+    if "anti_affinity" in spec:
+        pod.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels=dict(spec["anti_affinity"])),
+                        topology_key=tk)]))
+    if "affinity" in spec:
+        pod.spec.affinity = api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels=dict(spec["affinity"])),
+                    topology_key=tk)]))
+    if "node" in spec:
+        pod.spec.node_name = spec["node"]
+    return pod
+
+
+def build_infos(case):
+    infos = {}
+    for nspec in case["nodes"]:
+        infos[nspec["name"]] = NodeInfo(build_node(nspec))
+    for pspec in case.get("existing", []):
+        infos[pspec["node"]].add_pod(build_pod(pspec))
+    return infos
+
+
+class TestGoldenFeasibility:
+    @pytest.mark.parametrize("case", load_cases("feasibility"))
+    def test_oracle(self, case):
+        infos = build_infos(case)
+        pod = build_pod(case["pod"])
+        meta = preds.PredicateMetadata(pod, infos)
+        for node_name, want in case["expected"].items():
+            got, reasons = preds.pod_fits_on_node(pod, meta,
+                                                  infos[node_name])
+            assert got == want, \
+                f'{case["name"]}: oracle said {got} for {node_name} ' \
+                f"(reasons {reasons}), reference expects {want} " \
+                f'[{case["ref"]}]'
+
+    @pytest.mark.parametrize("case", load_cases("feasibility"))
+    def test_kernel(self, case):
+        """The same case through the real pipeline: cluster state into the
+        cache, one-pod batch through the kernel, decision vs expectation."""
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.state import Client
+        client = Client(validate=False)
+        sched = Scheduler(client, batch_size=8)
+        for nspec in case["nodes"]:
+            node = build_node(nspec)
+            client.nodes().create(node)
+            sched.cache.add_node(node)
+        for pspec in case.get("existing", []):
+            sched.cache.add_pod(build_pod(pspec))
+        pod = client.pods().create(build_pod(case["pod"]))
+        sched.queue.add(pod)
+        sched.algorithm.refresh()
+        sched.drain_pipelined()
+        bound = client.pods().get(case["pod"]["name"]).spec.node_name
+        feasible = {n for n, ok in case["expected"].items() if ok}
+        if feasible:
+            assert bound in feasible, \
+                f'{case["name"]}: kernel bound to {bound!r}, feasible set ' \
+                f'is {feasible} [{case["ref"]}]'
+        else:
+            assert not bound, \
+                f'{case["name"]}: kernel bound infeasible pod to {bound!r}'
+
+
+class TestGoldenScores:
+    @pytest.mark.parametrize("case", load_cases("scores"))
+    def test_oracle(self, case):
+        infos = build_infos(case)
+        pod = build_pod(case["pod"])
+        listers = None
+        if "service_selector" in case:
+            svc = api.Service(
+                metadata=api.ObjectMeta(name="svc", namespace="default"),
+                spec=api.ServiceSpec(
+                    selector=dict(case["service_selector"])))
+            listers = prios.SpreadListers(services=lambda ns: [svc])
+        meta = prios.PriorityMetadata(pod, listers=listers)
+        weights = {case["priority"]: 1}
+        scores = prios.prioritize_nodes(pod, meta, infos, weights=weights,
+                                        all_node_infos=infos)
+        for node_name, want in case["expected"].items():
+            assert scores[node_name] == want, \
+                f'{case["name"]}: oracle scored {node_name} ' \
+                f"{scores[node_name]}, reference expects {want} " \
+                f'[{case["ref"]}]'

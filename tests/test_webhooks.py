@@ -1,0 +1,150 @@
+"""Admission webhooks: stored configurations dispatching AdmissionReview
+to live HTTP endpoints, with failurePolicy semantics.
+
+Modeled on staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook
+mutating/validating plugin tests.
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+
+
+class _WebhookServer:
+    """A tiny admission webhook endpoint; `handler(review) -> response`."""
+
+    def __init__(self, handler):
+        outer_handler = handler
+        received = self.received = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(n))
+                received.append(review)
+                resp = outer_handler(review)
+                body = json.dumps({"response": resp}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def make_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="img")]))
+
+
+def hook_config(cls, name, url, failure_policy="Fail", resources=("pods",)):
+    return cls(
+        metadata=api.ObjectMeta(name=name),
+        webhooks=[api.Webhook(
+            name=f"{name}.example.com",
+            client_config=api.WebhookClientConfig(url=url),
+            rules=[api.RuleWithOperations(operations=["CREATE"],
+                                          resources=list(resources))],
+            failure_policy=failure_policy, timeout_seconds=2)])
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+class TestMutatingWebhook:
+    def test_live_webhook_mutates_labels(self, server):
+        """A mutating webhook's JSONPatch lands on the stored object."""
+        def mutate(review):
+            ops = [{"op": "add", "path": "/metadata/labels",
+                    "value": {"injected": "true"}}]
+            return {"allowed": True, "patchType": "JSONPatch",
+                    "patch": base64.b64encode(
+                        json.dumps(ops).encode()).decode()}
+        wh = _WebhookServer(mutate)
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.MutatingWebhookConfiguration).create(
+                hook_config(api.MutatingWebhookConfiguration, "labeler",
+                            wh.url))
+            out = client.pods("default").create(make_pod("m"))
+            assert out.metadata.labels.get("injected") == "true"
+            # the AdmissionReview carried the operation + encoded object
+            req = wh.received[0]["request"]
+            assert req["operation"] == "CREATE"
+            assert req["resource"] == "pods"
+            assert req["object"]["metadata"]["name"] == "m"
+        finally:
+            wh.stop()
+
+    def test_non_matching_resource_skipped(self, server):
+        def deny(review):
+            return {"allowed": False}
+        wh = _WebhookServer(deny)
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.MutatingWebhookConfiguration).create(
+                hook_config(api.MutatingWebhookConfiguration, "cm-only",
+                            wh.url, resources=("configmaps",)))
+            client.pods("default").create(make_pod("free"))  # not matched
+            assert not wh.received
+        finally:
+            wh.stop()
+
+
+class TestValidatingWebhook:
+    def test_denial_rejects_create(self, server):
+        def deny(review):
+            return {"allowed": False,
+                    "status": {"message": "forbidden image"}}
+        wh = _WebhookServer(deny)
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.ValidatingWebhookConfiguration).create(
+                hook_config(api.ValidatingWebhookConfiguration, "gate",
+                            wh.url))
+            with pytest.raises(Exception, match="forbidden image"):
+                client.pods("default").create(make_pod("v"))
+            from kubernetes_tpu.state.store import NotFoundError
+            with pytest.raises(NotFoundError):
+                client.pods("default").get("v")
+        finally:
+            wh.stop()
+
+    def test_dead_webhook_fail_policy_denies(self, server):
+        client = HTTPClient(server.address)
+        client.resource(api.ValidatingWebhookConfiguration).create(
+            hook_config(api.ValidatingWebhookConfiguration, "dead",
+                        "http://127.0.0.1:9/nope", failure_policy="Fail"))
+        with pytest.raises(Exception, match="failurePolicy is Fail"):
+            client.pods("default").create(make_pod("blocked"))
+
+    def test_dead_webhook_ignore_policy_admits(self, server):
+        client = HTTPClient(server.address)
+        client.resource(api.ValidatingWebhookConfiguration).create(
+            hook_config(api.ValidatingWebhookConfiguration, "dead",
+                        "http://127.0.0.1:9/nope", failure_policy="Ignore"))
+        out = client.pods("default").create(make_pod("through"))
+        assert out.metadata.name == "through"
