@@ -320,6 +320,9 @@ class NodeStatus:
     phase: str = ""
     conditions: List[NodeCondition] = field(default_factory=list)
     addresses: List[dict] = field(default_factory=list)
+    #: {"kubeletEndpoint": {"Port": N}} — the apiserver->kubelet proxy's
+    #: dial target (ref: NodeDaemonEndpoints)
+    daemon_endpoints: Optional[dict] = None
     node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
     images: List[ContainerImage] = field(default_factory=list)
     volumes_attached: List[AttachedVolume] = field(default_factory=list)

@@ -66,15 +66,18 @@ class _Request:
     """Parsed request-info (ref: apiserver/pkg/endpoints/request
     RequestInfoFactory)."""
 
-    __slots__ = ("resource", "namespace", "name", "subresource", "query")
+    __slots__ = ("resource", "namespace", "name", "subresource", "query",
+                 "tail")
 
     def __init__(self, resource: str, namespace: str, name: str,
-                 subresource: str, query: dict):
+                 subresource: str, query: dict, tail=()):
         self.resource = resource
         self.namespace = namespace
         self.name = name
         self.subresource = subresource
         self.query = query
+        #: path segments past the subresource (the proxy verb's target)
+        self.tail = tuple(tail)
 
 
 class APIServer:
@@ -339,7 +342,7 @@ class APIServer:
         resource = rest[0]
         name = rest[1] if len(rest) > 1 else ""
         sub = rest[2] if len(rest) > 2 else ""
-        return _Request(resource, ns, name, sub, query)
+        return _Request(resource, ns, name, sub, query, tail=rest[3:])
 
     def _preflight(self, h) -> None:
         """CORS preflight (ref: the chain's CORS filter, config.go:552)."""
@@ -579,6 +582,9 @@ class APIServer:
             self._handle_scale(h, method, req, rc)
             return
         if method == "GET":
+            if req.resource == "nodes" and req.subresource == "proxy":
+                self._proxy_to_kubelet(h, req)
+                return
             if req.name:
                 obj = rc.get(req.name, namespace=req.namespace or None)
                 self._respond(h, 200, obj)
@@ -869,6 +875,32 @@ class APIServer:
             for r in results]}
         self._respond_raw(h, 200, json.dumps(body).encode(),
                           "application/json")
+
+    def _proxy_to_kubelet(self, h, req: _Request) -> None:
+        """GET /api/v1/nodes/{name}/proxy/<path> — the apiserver->kubelet
+        proxy (ref: pkg/registry/core/node/rest ProxyREST), the transport
+        kubectl logs rides. The kubelet address comes from the node's
+        status (InternalIP + daemonEndpoints.kubeletEndpoint.Port)."""
+        from urllib import request as urlrequest
+        node = self.client.nodes().get(req.name)
+        port = ((node.status.daemon_endpoints or {})
+                .get("kubeletEndpoint") or {}).get("Port")
+        ip = next((a.get("address") for a in node.status.addresses
+                   if a.get("type") == "InternalIP"), None)
+        if not port or not ip:
+            self._error(h, 503, "ServiceUnavailable",
+                        f"node {req.name} publishes no kubelet endpoint")
+            return
+        target = f"http://{ip}:{port}/" + "/".join(req.tail)
+        try:
+            with urlrequest.urlopen(target, timeout=10) as r:
+                body = r.read()
+                ctype = r.headers.get("Content-Type", "text/plain")
+        except Exception as e:
+            self._error(h, 502, "BadGateway",
+                        f"kubelet proxy to {req.name} failed: {e}")
+            return
+        self._respond_raw(h, 200, body, ctype)
 
     def _apply_patch(self, req: _Request, rc, cls, ctype: str, data):
         """The PATCH verb (ref: apiserver/pkg/endpoints/handlers/patch.go:45

@@ -342,6 +342,30 @@ def cmd_uncordon(args) -> int:
     return _set_unschedulable(args, False, "uncordoned")
 
 
+def cmd_logs(args) -> int:
+    """kubectl logs <pod> [-c container]: resolve the pod's node, then
+    ride the apiserver->kubelet proxy to /containerLogs (ref:
+    pkg/kubectl/cmd/logs + the kubelet server's log endpoint)."""
+    from urllib import request as urlrequest
+    client = _client(args)
+    pod = client.pods(args.namespace).get(args.name,
+                                          namespace=args.namespace)
+    if not pod.spec.node_name:
+        print(f"error: pod {args.name} is not scheduled yet",
+              file=sys.stderr)
+        return 1
+    container = args.container or pod.spec.containers[0].name
+    url = (f"{args.master}/api/v1/nodes/{pod.spec.node_name}/proxy/"
+           f"containerLogs/{args.namespace}/{args.name}/{container}")
+    try:
+        with urlrequest.urlopen(url, timeout=15) as r:
+            sys.stdout.write(r.read().decode(errors="replace"))
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_drain(args) -> int:
     """kubectl drain: cordon, then evict every pod off the node through
     the PDB-guarded eviction API, backing off while budgets refuse (ref:
@@ -608,6 +632,11 @@ def main(argv=None) -> int:
         c = sub.add_parser(verb)
         c.add_argument("name")
         c.set_defaults(fn=fn)
+
+    lo = sub.add_parser("logs")
+    lo.add_argument("name")
+    lo.add_argument("--container", "-c", default="")
+    lo.set_defaults(fn=cmd_logs)
 
     dr = sub.add_parser("drain")
     dr.add_argument("name")

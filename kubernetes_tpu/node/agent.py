@@ -70,6 +70,10 @@ class NodeAgent:
         #: pod's reported cpu usage = its request x this fraction
         #: (the /stats/summary source HPA scrapes)
         self.cpu_utilization = 0.0
+        #: filled by KubeletServer.start(): the node's published dial
+        #: target for the apiserver->kubelet proxy
+        self.kubelet_host = "127.0.0.1"
+        self.kubelet_port = None
         #: static-pod manifests (ref: kubelet config/file source); mirror
         #: pods are published to the apiserver with the config.mirror
         #: annotation so the control plane can SEE them
@@ -99,6 +103,12 @@ class NodeAgent:
         node.status.conditions = [NodeCondition(
             type="Ready", status="True", reason="KubeletReady",
             last_heartbeat_time=now_iso())]
+        endpoints = self._daemon_endpoints()
+        if endpoints is not None:
+            node.status.daemon_endpoints = endpoints
+            node.status.addresses = [
+                {"type": "InternalIP", "address": self.kubelet_host},
+                {"type": "Hostname", "address": self.node_name}]
         from ..state.store import AlreadyExistsError
         try:
             self.client.nodes().create(node)
@@ -107,9 +117,20 @@ class NodeAgent:
                 cur.status.capacity = dict(caps)
                 cur.status.allocatable = dict(caps)
                 cur.status.conditions = node.status.conditions
+                if endpoints is not None:
+                    cur.status.daemon_endpoints = endpoints
+                    cur.status.addresses = node.status.addresses
                 return cur
             self.client.nodes().patch(self.node_name, reclaim)
         self._renew_lease()
+
+    def _daemon_endpoints(self):
+        """The kubelet server's dial target, once one is attached
+        (KubeletServer.attach) — the apiserver proxy path's source."""
+        port = getattr(self, "kubelet_port", None)
+        if not port:
+            return None
+        return {"kubeletEndpoint": {"Port": port}}
 
     def _renew_lease(self) -> None:
         """Ref: pkg/kubelet/nodelease — a Lease in kube-node-lease renewed

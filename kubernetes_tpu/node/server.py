@@ -44,6 +44,15 @@ class KubeletServer:
                                         daemon=True,
                                         name=f"kubelet-http-{self.agent.node_name}")
         self._thread.start()
+        # publish the dial target on the Node so the apiserver->kubelet
+        # proxy (nodes/{name}/proxy, kubectl logs) can reach this server
+        host, port = self._httpd.server_address[:2]
+        self.agent.kubelet_host = host
+        self.agent.kubelet_port = port
+        try:
+            self.agent.register()
+        except Exception:
+            pass  # agent not started yet: its own register() publishes
         return self
 
     def stop(self) -> None:

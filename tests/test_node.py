@@ -687,3 +687,51 @@ class TestPVExpander:
                 "small").metadata.resource_version == rv_claim
         finally:
             informers.stop()
+
+
+class TestKubeletProxy:
+    def test_kubectl_logs_via_apiserver_proxy(self, capsys):
+        """kubectl logs rides GET /nodes/{name}/proxy/containerLogs/...:
+        the apiserver dials the kubelet endpoint the node published
+        (ref: pkg/registry/core/node/rest ProxyREST + cmd/logs)."""
+        import time
+        from kubernetes_tpu import api
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.cmd import kubectl
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.node.server import KubeletServer
+        from kubernetes_tpu.state import SharedInformerFactory
+        srv = APIServer().start()
+        agent = ks = None
+        try:
+            client = HTTPClient(srv.address)
+            informers = SharedInformerFactory(client)
+            agent = NodeAgent(client, "pn1", informers, pleg_period=0.2)
+            informers.start()
+            informers.wait_for_cache_sync()
+            agent.start()
+            ks = KubeletServer(agent).start()
+            node = client.nodes().get("pn1")
+            assert node.status.daemon_endpoints["kubeletEndpoint"]["Port"]
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="lp", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="app", image="img")]))
+            pod.spec.node_name = "pn1"
+            client.pods("default").create(pod)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if client.pods("default").get("lp").status.phase == \
+                        "Running":
+                    break
+                time.sleep(0.1)
+            rc = kubectl.main(["--master", srv.address, "logs", "lp"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "app" in out and "state=" in out
+        finally:
+            if ks is not None:
+                ks.stop()
+            if agent is not None:
+                agent.stop()
+            srv.stop()
