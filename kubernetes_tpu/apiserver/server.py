@@ -577,6 +577,13 @@ class APIServer:
 
     def _handle(self, h, method: str, req: _Request, cls, user=None) -> None:
         self._req_local.user = user
+        if req.resource == "nodes" and req.subresource == "proxy" and \
+                method != "GET":
+            # the proxy subresource is GET-only here; falling through
+            # would let a nodes/proxy-scoped credential write the Node
+            self._error(h, 405, "MethodNotAllowed",
+                        "the node proxy supports only GET")
+            return
         rc = self._rc(cls, req.namespace)
         if req.subresource == "scale":
             self._handle_scale(h, method, req, rc)
@@ -892,10 +899,19 @@ class APIServer:
                         f"node {req.name} publishes no kubelet endpoint")
             return
         target = f"http://{ip}:{port}/" + "/".join(req.tail)
+        from urllib import error as urlerror
         try:
-            with urlrequest.urlopen(target, timeout=10) as r:
+            # short timeout: this handler occupies a read-inflight slot,
+            # so dead kubelets must not pin it for long
+            with urlrequest.urlopen(target, timeout=3) as r:
                 body = r.read()
                 ctype = r.headers.get("Content-Type", "text/plain")
+        except urlerror.HTTPError as e:
+            # relay the kubelet's own status + body (the reference's
+            # ProxyREST forwards upstream errors verbatim)
+            self._respond_raw(h, e.code, e.read(),
+                              e.headers.get("Content-Type", "text/plain"))
+            return
         except Exception as e:
             self._error(h, 502, "BadGateway",
                         f"kubelet proxy to {req.name} failed: {e}")

@@ -734,4 +734,5 @@ class TestKubeletProxy:
                 ks.stop()
             if agent is not None:
                 agent.stop()
+            informers.stop()
             srv.stop()
