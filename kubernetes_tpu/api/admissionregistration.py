@@ -41,13 +41,23 @@ class Webhook:
     failure_policy: str = "Fail"
     timeout_seconds: int = 10
 
-    def matches(self, operation: str, resource: str) -> bool:
+    def matches(self, operation: str, resource: str,
+                api_version: str = "") -> bool:
+        """api_version is the resource's registered groupVersion
+        ("apps/v1", "v1" for core). When the caller cannot resolve it,
+        a rule constrained to specific groups/versions does NOT match —
+        under-matching is the safe failure for admission routing."""
+        group, _, version = api_version.rpartition("/")
         for rule in self.rules or [RuleWithOperations()]:
             ops_ok = not rule.operations or "*" in rule.operations \
                 or operation in rule.operations
             res_ok = not rule.resources or "*" in rule.resources \
                 or resource in rule.resources
-            if ops_ok and res_ok:
+            grp_ok = not rule.api_groups or "*" in rule.api_groups \
+                or group in rule.api_groups
+            ver_ok = not rule.api_versions or "*" in rule.api_versions \
+                or version in rule.api_versions
+            if ops_ok and res_ok and grp_ok and ver_ok:
                 return True
         return False
 

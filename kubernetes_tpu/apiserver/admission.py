@@ -254,14 +254,29 @@ class WebhookDispatcher:
         store = getattr(self.client, "store", None)
         return store is not None and store.count(kind_resource) == 0
 
+    def _group_version_of(self, resource: str) -> str:
+        """Registered groupVersion of a resource plural ("apps/v1", "v1"),
+        or "" when unresolvable (matches() then under-matches safely)."""
+        scheme = getattr(self.client, "scheme", None)
+        if scheme is None:
+            return ""
+        cls = scheme.type_for_resource(resource)
+        if cls is None:
+            return ""
+        try:
+            return scheme.gvk_for(cls)[0]
+        except KeyError:
+            return ""
+
     def admit(self, operation: str, resource: str, obj: Any):
         if self._empty("mutatingwebhookconfigurations"):
             return obj  # O(1) fast path: no webhooks registered
         from ..api.admissionregistration import MutatingWebhookConfiguration
+        gv = self._group_version_of(resource)
         for cfg in self.client.resource(
                 MutatingWebhookConfiguration).list():
             for wh in cfg.webhooks:
-                if not wh.matches(operation, resource):
+                if not wh.matches(operation, resource, gv):
                     continue
                 resp = self._call(wh, operation, resource, obj)
                 if resp is None:
@@ -280,10 +295,11 @@ class WebhookDispatcher:
             return
         from ..api.admissionregistration import (
             ValidatingWebhookConfiguration)
+        gv = self._group_version_of(resource)
         for cfg in self.client.resource(
                 ValidatingWebhookConfiguration).list():
             for wh in cfg.webhooks:
-                if not wh.matches(operation, resource):
+                if not wh.matches(operation, resource, gv):
                     continue
                 resp = self._call(wh, operation, resource, obj)
                 if resp is None:

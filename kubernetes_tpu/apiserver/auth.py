@@ -185,19 +185,20 @@ class NodeAuthorizer:
     otherwise write any node's status or any pod's status. Non-node users
     fall through to the delegate (RBAC).
 
-    Divergence from the reference noted: the reference walks a live graph
-    to also scope secrets/configmaps/PVs to pods running on the node; here
-    node users simply have no read grant for those kinds unless the
-    delegate adds one."""
+    Configmaps follow the reference's graph idea in miniature: a node may
+    GET only configmaps volume-referenced by pods bound to it, via the
+    `node_configmaps_of` hook — never list/watch them cluster-wide."""
 
     #: kinds a kubelet may read cluster-wide (the informer surfaces it runs)
-    READ_OK = ("nodes", "pods", "services", "endpoints", "leases",
-               "configmaps")
+    READ_OK = ("nodes", "pods", "services", "endpoints", "leases")
 
-    def __init__(self, delegate, pod_node_of=None):
+    def __init__(self, delegate, pod_node_of=None, node_configmaps_of=None):
         self.delegate = delegate
         #: (namespace, name) -> nodeName, for pods/status scoping
         self._pod_node_of = pod_node_of or (lambda ns, name: None)
+        #: node -> {(namespace, name)} configmaps its bound pods reference
+        self._node_configmaps_of = node_configmaps_of or \
+            (lambda node: frozenset())
 
     def authorize(self, user, verb: str, resource: str, namespace: str,
                   name: str = "") -> bool:
@@ -207,6 +208,18 @@ class NodeAuthorizer:
                                            name)
         node = user.name[len(NODE_USER_PREFIX):]
         base = resource.split("/")[0]
+        if base == "nodes" and "/" in resource and \
+                resource != "nodes/status":
+            # nodes/proxy (and any other node subresource except status)
+            # would let ONE kubelet credential reach every other kubelet
+            # through the apiserver proxy — deny before the read grant
+            # (ref: the graph authorizer has no kubelet->proxy edge)
+            return False
+        if base == "configmaps":
+            # graph-lite: exact-name GET of configmaps referenced by pods
+            # bound to THIS node; no cluster-wide list/watch
+            return verb == "get" and bool(name) and \
+                (namespace, name) in self._node_configmaps_of(node)
         if verb in ("get", "list", "watch"):
             return base in self.READ_OK
         if base == "nodes":

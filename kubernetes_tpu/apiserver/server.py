@@ -121,7 +121,6 @@ class APIServer:
         self.admission.mutators.append(sa.admit)
         self.admission.validators.append(sa.validate)
         self._quota = ResourceQuotaAdmission(self.client)
-        self.admission.validators.append(self._quota.validate)
         from .admission import NodeRestriction
         self.admission.validators.append(NodeRestriction(self).validate)
         # out-of-process webhooks: mutating AFTER the in-process mutators
@@ -132,6 +131,10 @@ class APIServer:
         webhooks = WebhookDispatcher(self.client)
         self.admission.mutators.append(webhooks.admit)
         self.admission.validators.append(webhooks.validate)
+        # ResourceQuota runs LAST so a later validator's denial can never
+        # strand a committed charge (the reference orders ResourceQuota at
+        # the end of the default plugin set for exactly this reason)
+        self.admission.validators.append(self._quota.validate)
         #: request-scoped authenticated user (ThreadingHTTPServer gives one
         #: thread per request) — admission plugins that need the requester
         #: (NodeRestriction) read it via current_user()

@@ -128,10 +128,37 @@ class ControlPlane:
                     .get(name).spec.node_name
             except NotFoundError:
                 return None
+
+        import time as _time
+        _cm_cache: dict = {}  # node -> (expires_at, refs)
+
+        def node_configmaps_of(node):
+            # configmaps volume-referenced by pods bound to this node —
+            # the graph authorizer's kubelet->configmap edge. The scan is
+            # O(pods), so amortize it with a short TTL instead of paying
+            # it on every kubelet GET (the reference keeps an incremental
+            # graph; a 1s-stale grant only delays a NEW pod's configmap
+            # read by one cache window)
+            hit = _cm_cache.get(node)
+            now = _time.monotonic()
+            if hit is not None and hit[0] > now:
+                return hit[1]
+            refs = set()
+            for p in self.server.client.pods(None).list():
+                if p.spec.node_name != node:
+                    continue
+                ns = p.metadata.namespace or "default"
+                for v in p.spec.volumes:
+                    cm = v.config_map or {}
+                    if cm.get("name"):
+                        refs.add((ns, cm["name"]))
+            _cm_cache[node] = (now + 1.0, refs)
+            return refs
         self.server.authenticator = CertAuthenticator(
             fallback=BootstrapTokenAuthenticator(self.server.client))
-        self.server.authorizer = NodeAuthorizer(authz,
-                                                pod_node_of=pod_node_of)
+        self.server.authorizer = NodeAuthorizer(
+            authz, pod_node_of=pod_node_of,
+            node_configmaps_of=node_configmaps_of)
         self.manager = None
         self.scheduler = None
 

@@ -279,6 +279,31 @@ class PodClient(ResourceClient):
                 return cur
             self._store.guaranteed_update(
                 "poddisruptionbudgets", ns, pdb.metadata.name, mutate)
+            try:
+                return self.delete(name, namespace=ns)
+            except Exception:
+                # the budget slot was consumed but no disruption happened
+                # (pod deleted concurrently, store error): hand it back,
+                # or sibling evictions stay blocked until the disruption
+                # controller resyncs — the reference only charges a
+                # SUCCESSFUL eviction
+
+                def refund(cur):
+                    # only while OUR charge is still outstanding: if the
+                    # disruption controller resynced in between it already
+                    # recomputed the budget from live pods, and a blind
+                    # +1 would over-credit past the PDB
+                    if name in cur.status.disrupted_pods:
+                        cur.status.disruptions_allowed += 1
+                        del cur.status.disrupted_pods[name]
+                    return cur
+                try:
+                    self._store.guaranteed_update(
+                        "poddisruptionbudgets", ns, pdb.metadata.name,
+                        refund)
+                except Exception:
+                    pass  # PDB itself deleted mid-flight: nothing to refund
+                raise
         return self.delete(name, namespace=ns)
 
     def bind_bulk(self, bindings: List[corev1.Binding]) -> List[Any]:

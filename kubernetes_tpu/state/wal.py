@@ -23,6 +23,18 @@ import struct
 from typing import Iterator, Optional, Tuple
 
 
+class _FlushSentinel:
+    """Queue marker serviced by the wal worker: flush (optionally fsync)
+    everything enqueued before it, then signal the waiter."""
+
+    __slots__ = ("sync", "done")
+
+    def __init__(self, sync: bool):
+        import threading
+        self.sync = sync
+        self.done = threading.Event()
+
+
 class _PyAppender:
     def __init__(self, path: str):
         self._f = open(path, "ab")
@@ -108,13 +120,6 @@ class WalWriter:
             import queue as queue_mod
             import threading
             self._q = queue_mod.SimpleQueue()
-            # drain tracking by sequence number: a bare "drained" event
-            # races append (worker could flag empty between an appender's
-            # flag-clear and its put); written >= enqueued cannot
-            self._seq_lock = threading.Lock()
-            self._written_cond = threading.Condition(self._seq_lock)
-            self._enqueued_seq = 0
-            self._written_seq = 0
             self._worker = threading.Thread(
                 target=self._run, daemon=True, name="wal-writer")
             self._worker.start()
@@ -132,49 +137,59 @@ class WalWriter:
             item = self._q.get()
             if item is None:
                 return
+            if isinstance(item, _FlushSentinel):
+                # servicing the sentinel AFTER every record enqueued
+                # before it (FIFO) keeps ALL appender access on this
+                # thread — drain() never touches self._a concurrently
+                try:
+                    self._a.flush(item.sync)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                item.done.set()
+                continue
             try:
                 self._a.append(self._encode_record(*item))
             except Exception:
                 import traceback
                 traceback.print_exc()
-            flush = self._q.empty()
-            if flush:
+            if self._q.empty():
                 self._a.flush(False)
-            with self._seq_lock:
-                self._written_seq += 1
-                self._written_cond.notify_all()
 
     def append(self, op: str, resource: str, rv: int, obj_data,
                uid_counter: int = 0) -> None:
         if self._q is not None:
-            with self._seq_lock:
-                self._enqueued_seq += 1
             self._q.put((op, resource, rv, obj_data, uid_counter))
             return
         self._a.append(self._encode_record(op, resource, rv, obj_data,
                                            uid_counter))
 
-    def drain(self, timeout: float = 30.0) -> None:
+    def drain(self, timeout: float = 30.0, sync: bool = False) -> bool:
         """Wait until every record enqueued BEFORE this call hit the file
-        (deferred mode)."""
+        (deferred mode). Returns False (and logs) on timeout — callers
+        must not report durability the worker did not confirm."""
         if self._q is None:
-            return
-        import time as _time
-        deadline = _time.monotonic() + timeout
-        with self._seq_lock:
-            target = self._enqueued_seq
-            while self._written_seq < target:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    return
-                self._written_cond.wait(remaining)
-        self._a.flush(False)
+            return True
+        sentinel = _FlushSentinel(sync)
+        self._q.put(sentinel)
+        if sentinel.done.wait(timeout):
+            return True
+        import logging
+        logging.getLogger("wal").warning(
+            "wal drain timed out after %.1fs; tail not confirmed on disk",
+            timeout)
+        return False
 
     def flush(self) -> None:
         if self._q is not None:
             if not self.sync:
                 return  # worker flushes as its queue empties
-            self.drain()
+            if not self.drain(sync=True):
+                # sync mode is a durability CONTRACT — a timed-out drain
+                # must surface, not ack an fsync that never happened
+                raise OSError("wal flush: worker did not confirm fsync "
+                              "within the drain timeout")
+            return
         self._a.flush(self.sync)
 
     def close(self) -> None:

@@ -244,3 +244,63 @@ class TestNodeScoping:
                     metadata=api.ObjectMeta(name="b")))
         finally:
             server._req_local.user = None
+
+
+class TestEvictionRefund:
+    def test_failed_delete_refunds_budget(self, server):
+        """The budget slot is only charged for a SUCCESSFUL eviction: a
+        pod deleted concurrently between the PDB CAS and the delete must
+        hand the slot back, or sibling evictions stay blocked until the
+        disruption controller resyncs."""
+        from kubernetes_tpu.state.store import NotFoundError
+        client = HTTPClient(server.address)
+        for i in range(2):
+            client.pods("default").create(
+                make_pod(f"r{i}", labels={"app": "db"}))
+        pdb = make_pdb("db-pdb", {"app": "db"}, 1)
+        created = client.pod_disruption_budgets("default").create(pdb)
+        created.status.disruptions_allowed = 1
+        client.pod_disruption_budgets("default").update_status(created)
+        # state-level client so the delete can be made to fail
+        # deterministically after the budget CAS
+        pc = server.client.pods("default")
+        real_delete = pc.delete
+
+        def racing_delete(name, namespace=None):
+            raise NotFoundError(f"pods {name} deleted concurrently")
+        pc.delete = racing_delete
+        with pytest.raises(NotFoundError):
+            pc.evict("r0")
+        pc.delete = real_delete
+        q = client.pod_disruption_budgets("default").get("db-pdb")
+        assert q.status.disruptions_allowed == 1
+        assert "r0" not in q.status.disrupted_pods
+        # the refunded slot admits the next eviction
+        client.pods("default").evict("r1")
+
+    def test_node_cannot_proxy_or_read_foreign_configmaps(self):
+        """One kubelet credential must not reach other kubelets through
+        nodes/proxy, nor read configmaps beyond those referenced by pods
+        bound to it (the graph authorizer's scoping, reduced)."""
+        from kubernetes_tpu.apiserver.auth import (NodeAuthorizer,
+                                                   RBACAuthorizer, UserInfo)
+        rbac = RBACAuthorizer()
+        refs = {"a": {("default", "app-config")}}
+        authz = NodeAuthorizer(
+            rbac, node_configmaps_of=lambda node: refs.get(node, set()))
+        kubelet_a = UserInfo("system:node:a", ("system:nodes",))
+        # nodes/proxy denied even for the node's own name
+        assert not authz.authorize(kubelet_a, "get", "nodes/proxy", "", "a")
+        assert not authz.authorize(kubelet_a, "get", "nodes/proxy", "", "b")
+        # configmaps: exact-name GET of referenced ones only
+        assert authz.authorize(kubelet_a, "get", "configmaps",
+                               "default", "app-config")
+        assert not authz.authorize(kubelet_a, "get", "configmaps",
+                                   "default", "other")
+        assert not authz.authorize(kubelet_a, "list", "configmaps",
+                                   "default", "")
+        assert not authz.authorize(kubelet_a, "watch", "configmaps",
+                                   "", "")
+        # the cluster-wide informer surfaces are still readable
+        assert authz.authorize(kubelet_a, "get", "nodes/status", "", "a")
+        assert authz.authorize(kubelet_a, "list", "pods", "", "")

@@ -175,3 +175,41 @@ class TestWal:
         assert Client(store2).pods("default").get(
             "p1").spec.node_name == "n1"
         store2.close()
+
+
+class TestDeferredDrain:
+    def test_drain_confirms_tail_on_disk(self, tmp_path):
+        """drain() is serviced by the worker via a flush sentinel (all
+        appender access stays on one thread) and returns True only once
+        every prior record is readable from the file."""
+        from kubernetes_tpu.state.wal import WalWriter, load_wal
+        path = str(tmp_path / "w.wal")
+        w = WalWriter(path, deferred=True)
+        for i in range(500):
+            w.append("PUT", "pods", i + 1, {"n": i})
+        assert w.drain(timeout=10) is True
+        records, _ = load_wal(path)
+        assert len(records) == 500
+        assert records[-1]["rv"] == 500
+        w.close()
+
+    def test_drain_reports_timeout(self, tmp_path):
+        """A drain that cannot be confirmed must return False, not
+        silently claim durability."""
+        from kubernetes_tpu.state.wal import WalWriter
+
+        class _Stuck:
+            def append(self, payload):
+                import time
+                time.sleep(5)
+
+            def flush(self, sync):
+                pass
+
+            def close(self):
+                pass
+        path = str(tmp_path / "w.wal")
+        w = WalWriter(path, deferred=True)
+        w._a = _Stuck()
+        w.append("PUT", "pods", 1, {"n": 1})
+        assert w.drain(timeout=0.2) is False

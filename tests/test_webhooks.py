@@ -148,3 +148,87 @@ class TestValidatingWebhook:
                         "http://127.0.0.1:9/nope", failure_policy="Ignore"))
         out = client.pods("default").create(make_pod("through"))
         assert out.metadata.name == "through"
+
+
+def scoped_config(url, api_groups, api_versions=("*",)):
+    return api.ValidatingWebhookConfiguration(
+        metadata=api.ObjectMeta(name="scoped"),
+        webhooks=[api.Webhook(
+            name="scoped.example.com",
+            client_config=api.WebhookClientConfig(url=url),
+            rules=[api.RuleWithOperations(
+                operations=["CREATE"], resources=["*"],
+                api_groups=list(api_groups),
+                api_versions=list(api_versions))],
+            failure_policy="Fail", timeout_seconds=2)])
+
+
+class TestRuleGroupVersionScoping:
+    """rule.apiGroups/apiVersions constrain dispatch (ref: the v1 rule
+    matcher in apiserver/pkg/admission/plugin/webhook/rules) — a rule
+    scoped to apps must not fire for same-plural core resources."""
+
+    def test_group_scoped_rule_skips_other_groups(self, server):
+        wh = _WebhookServer(lambda review: {"allowed": False})
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.ValidatingWebhookConfiguration).create(
+                scoped_config(wh.url, api_groups=["apps"]))
+            # core/v1 pod sails through; the apps-scoped hook never fires
+            out = client.pods("default").create(make_pod("core-free"))
+            assert out.metadata.name == "core-free"
+            assert not wh.received
+            # an apps/v1 object IS matched and denied
+            dep = api.Deployment(
+                metadata=api.ObjectMeta(name="d", namespace="default"))
+            with pytest.raises(Exception, match="denied"):
+                client.resource(api.Deployment, "default").create(dep)
+            assert wh.received
+        finally:
+            wh.stop()
+
+    def test_version_scoped_rule_skips_other_versions(self, server):
+        wh = _WebhookServer(lambda review: {"allowed": False})
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.ValidatingWebhookConfiguration).create(
+                scoped_config(wh.url, api_groups=["*"],
+                              api_versions=["v2badbeta1"]))
+            out = client.pods("default").create(make_pod("v1-free"))
+            assert out.metadata.name == "v1-free"
+            assert not wh.received
+        finally:
+            wh.stop()
+
+
+class TestQuotaWebhookOrdering:
+    def test_webhook_denial_does_not_strand_quota_charge(self, server):
+        """ResourceQuota must run LAST: a validating-webhook denial after
+        a committed charge would falsely throttle the namespace until the
+        quota controller resyncs (the reference orders ResourceQuota at
+        the end of the default plugin chain)."""
+        def deny(review):
+            return {"allowed": False, "status": {"message": "nope"}}
+        wh = _WebhookServer(deny)
+        try:
+            client = HTTPClient(server.address)
+            client.resource_quotas("default").create(api.ResourceQuota(
+                metadata=api.ObjectMeta(name="q", namespace="default"),
+                spec=api.ResourceQuotaSpec(
+                    hard={"pods": api.Quantity("1")})))
+            client.resource(api.ValidatingWebhookConfiguration).create(
+                hook_config(api.ValidatingWebhookConfiguration, "gate",
+                            wh.url))
+            with pytest.raises(Exception, match="nope"):
+                client.pods("default").create(make_pod("denied"))
+            q = client.resource_quotas("default").get("q")
+            assert q.status.used.get(
+                "pods", api.Quantity(0)).value() == 0
+            # the slot is immediately usable once the gate is lifted
+            client.resource(
+                api.ValidatingWebhookConfiguration).delete("gate")
+            client.pods("default").create(make_pod("now-fits"))
+            assert client.resource_quotas("default").get(
+                "q").status.used["pods"].value() == 1
+        finally:
+            wh.stop()
