@@ -188,9 +188,11 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
 
 WIRE_NODES = int(os.environ.get("BENCH_WIRE_NODES", "5000"))
 WIRE_PODS = int(os.environ.get("BENCH_WIRE_PODS", "20000"))
-# the wire path stays at 4k: hub/scheduler CPU overlap (async binder)
-# needs more batches in flight than raw kernel efficiency
-WIRE_BATCH = int(os.environ.get("BENCH_WIRE_BATCH", "4096"))
+# measured sweep (r05, slim bind frames): 4096->3.4k, 8192->4.3k,
+# 10240->4.5k, 16384->5.6k pods/s — with per-pod wire costs cut by slim
+# frames, per-batch fixed costs (launch + fetch RTT) dominate and the
+# biggest batch wins, same knee as the in-process headline
+WIRE_BATCH = int(os.environ.get("BENCH_WIRE_BATCH", "16384"))
 
 
 class _SpawnedAPIServer:
@@ -323,10 +325,10 @@ def run_wire_config(n_nodes, n_pods, batch=None):
             "hub_us_per_pod": round(hub_cpu / max(1, scheduled) * 1e6, 1),
             "sched_cpu_s": round(my_cpu, 2),
             "sched_us_per_pod": round(my_cpu / max(1, scheduled) * 1e6, 1),
-            "hub_cost_split": "bind txn (clone+stamp+publish) + WAL worker"
-                              " + per-revision watch encode (cached)",
-            "sched_cost_split": "watch decode (json+serde) + tensorize"
-                                " + assume/commit loop",
+            "hub_cost_split": "bind txn (clone+stamp+publish) + slim WAL"
+                              " records + slim bind watch frames",
+            "sched_cost_split": "slim frame apply (clone+fields) +"
+                                " tensorize + assume/commit loop",
         }
         return rate, scheduled, setup_s, elapsed, bottlenecks
       finally:

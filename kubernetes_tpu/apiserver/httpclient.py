@@ -23,7 +23,7 @@ from ..api import serde
 from ..api.meta import LabelSelector
 from ..runtime.scheme import SCHEME, Scheme
 from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
-                           NotFoundError, WatchEvent)
+                           NotFoundError, SlimBindRef, WatchEvent)
 
 
 class TooManyRequestsError(RuntimeError):
@@ -83,6 +83,16 @@ class _HTTPWatch:
                 if not line:
                     continue
                 frame = json.loads(line)
+                if frame.get("slim") == "bind":
+                    # negotiated compact bind frame: the informer
+                    # materializes the pod from its cached prior revision
+                    o = frame["o"]
+                    rv = int(o["rv"])
+                    self.events.put(WatchEvent(
+                        frame["type"],
+                        SlimBindRef(o.get("namespace", ""), o["name"],
+                                    o["node"], o.get("ts"), rv), rv))
+                    continue
                 obj = serde.decode(self._cls, frame["object"])
                 rv = int(obj.metadata.resource_version or 0)
                 self.events.put(WatchEvent(frame["type"], obj, rv))
@@ -311,12 +321,17 @@ class HTTPResourceClient:
         return self._decode(self._request(
             "DELETE", self._url(name, namespace=namespace, query=query)))
 
+    #: set by subclasses whose consumers can apply slim frames (pods)
+    _SLIM_WATCH = False
+
     def watch(self, namespace: Optional[str] = None,
               resource_version: Optional[int] = None) -> _HTTPWatch:
         ns = namespace if namespace is not None else (self._ns or None)
         query = "watch=true"
         if resource_version is not None:
             query += f"&resourceVersion={resource_version}"
+        if self._SLIM_WATCH:
+            query += "&slimBind=true"
         url = self._url(namespace=ns or "", query=query)
         req = urlrequest.Request(url, headers=self._headers())
         try:
@@ -327,6 +342,10 @@ class HTTPResourceClient:
 
 
 class HTTPPodClient(HTTPResourceClient):
+    # pod watches negotiate slim bind frames: the SharedInformer's indexer
+    # always holds the previous revision to apply them against
+    _SLIM_WATCH = True
+
     def evict(self, name: str, namespace: Optional[str] = None):
         """POST the pods/eviction subresource (PDB-guarded delete). Raises
         TooManyDisruptions on a 429 budget refusal."""

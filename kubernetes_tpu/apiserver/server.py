@@ -638,10 +638,8 @@ class APIServer:
                 # pairs under the request namespace — same semantics, no
                 # per-item object decode on the hot path.
                 if data.get("kind") == "BindList":
-                    from ..api.meta import ObjectMeta
-                    from ..api.core import ObjectReference
                     ns = req.namespace or "default"
-                    bindings = []
+                    pairs = []
                     for it in data.get("items", []):
                         if not (isinstance(it, list) and len(it) == 2 and
                                 isinstance(it[0], str) and
@@ -650,10 +648,11 @@ class APIServer:
                                         "BindList items must be "
                                         "[podName, nodeName] pairs")
                             return
-                        bindings.append(Binding(
-                            metadata=ObjectMeta(name=it[0], namespace=ns),
-                            target=ObjectReference(kind="Node",
-                                                   name=it[1])))
+                        pairs.append((it[0], it[1]))
+                    # pair fast path: no Binding/ObjectMeta/ObjectReference
+                    # construction per pod; shares the Status-list response
+                    # below with the classic Binding-decode form
+                    outs = self.client.pods(None).bind_bulk_pairs(ns, pairs)
                 else:
                     items = data.get("items", [data]) \
                         if data.get("kind") == "List" else [data]
@@ -671,8 +670,8 @@ class APIServer:
                                 return
                             b.metadata.namespace = req.namespace
                         bindings.append(b)
-                outs = self.client.pods(req.namespace or None) \
-                    .bind_bulk(bindings)
+                    outs = self.client.pods(req.namespace or None) \
+                        .bind_bulk(bindings)
                 # slim per-slot results — the reference's bind returns
                 # metav1.Status, never the pod; echoing N full pods would
                 # cost an encode+decode per bind on the hot path
@@ -979,6 +978,11 @@ class APIServer:
         apiserver's WatchServer over the cacher; resumable by
         resourceVersion exactly like storage/cacher/cacher.go)."""
         rv = req.query.get("resourceVersion")
+        # negotiated compact framing (the protobuf-negotiation analog):
+        # a client that opted in receives bind MODIFIED events as slim
+        # {"slim":"bind", ...} frames it applies to its cached copy —
+        # no full-object encode here, no full decode there
+        slim_ok = req.query.get("slimBind") in ("true", "1")
         watch = self.store.watch(req.resource, req.namespace or None,
                                  int(rv) if rv else None)
         h.send_response(200)
@@ -1021,12 +1025,22 @@ class APIServer:
                         break
                     batch.append(nxt)
                 # per-object cached JSON: one encode per revision shared
-                # across every watcher/list/journal of that revision
-                frames = b"".join(
-                    (f'{{"type": "{e.type}", "object": '
-                     f"{serde.to_json_cached(e.object)}}}\n").encode()
-                    for e in batch)
-                write_chunk(frames)
+                # across every watcher/list/journal of that revision;
+                # negotiated slim frames skip even that
+                parts = []
+                for e in batch:
+                    if slim_ok and e.slim is not None:
+                        d = dict(e.slim)
+                        d["rv"] = e.resource_version
+                        parts.append(
+                            f'{{"type": "{e.type}", "slim": "bind", '
+                            f'"o": {json.dumps(d)}}}\n'.encode())
+                    else:
+                        parts.append(
+                            (f'{{"type": "{e.type}", "object": '
+                             f"{serde.to_json_cached(e.object)}}}\n")
+                            .encode())
+                write_chunk(b"".join(parts))
                 if closing:
                     break
         except (BrokenPipeError, ConnectionResetError, OSError):

@@ -56,6 +56,15 @@ from jax import lax
 
 MAX_PRIORITY = 10.0
 NEG = jnp.float32(-1e30)
+#: pods per scan step (unrolled inside the step, exact serial semantics);
+#: the scan is latency-bound so fewer, fatter steps win — see
+#: schedule_batch. Power of two <= the minimum pod bucket (8).
+#: Topology-carrying batches use their own knob: the in-step (anti-)
+#: affinity gathers/scatters chain through the carry, so fat steps buy
+#: less there (measured r05: uniform 7.7k->9.7k at G=8; anti 2.3k->2.1k).
+import os as _os
+_STEP_GROUP = int(_os.environ.get("KTPU_SCAN_GROUP", "8"))
+_STEP_GROUP_TOPO = int(_os.environ.get("KTPU_SCAN_GROUP_TOPO", "1"))
 
 # column layout (keep in sync with tensorize.py)
 COL_CPU = 0
@@ -84,7 +93,14 @@ def _balanced_allocation(nz_used: jnp.ndarray, nz_req: jnp.ndarray,
     cpu_frac = jnp.where(cap_cpu > 0, req_cpu / jnp.maximum(cap_cpu, 1.0), 1.0)
     mem_frac = jnp.where(cap_mem > 0, req_mem / jnp.maximum(cap_mem, 1.0), 1.0)
     diff = jnp.abs(cpu_frac - mem_frac)
-    score = jnp.floor((1.0 - diff) * MAX_PRIORITY)
+    # epsilon-floor: when (1-diff)*10 is EXACTLY an integer in exact math
+    # (e.g. cpuFrac .7875, memFrac .1875 -> 4.0), f32 rounding can land a
+    # hair below it while the f64 reference truncation lands at it — a
+    # one-point score flip that permutes whole assignment windows (the
+    # r04/r05 pod-affinity parity gap, stuck at 0.961). The nudge is far
+    # above f32 error (~1e-6 at this magnitude) and far below the spacing
+    # of distinct achievable scores near a boundary.
+    score = jnp.floor((1.0 - diff) * MAX_PRIORITY + 4e-6)
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
 
 
@@ -231,7 +247,7 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         nom = {"used": jnp.zeros_like(usage["used"]),
                "count": jnp.zeros_like(usage["pod_count"])}
 
-    def step(carry, pod):
+    def one_pod(carry, pod):
         mask = unique_masks[pod["mask_idx"]]
         static = unique_scores[pod["score_idx"]]
         self_oh = rows == pod.get("nom_row", jnp.int32(-1))
@@ -243,29 +259,32 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         if has_topo:
             # per-pod term lists ([K] tids, -1 padded) keep this O(K*N)
             # per step instead of O(T*N): a pod carries/matches only a
-            # handful of terms, while the batch's union can be hundreds
+            # handful of terms, while the batch's union can be hundreds.
+            # The K axis is VECTORIZED — one [K,N] gather + one reduce —
+            # not a Python loop: K unrolled iterations serialize K
+            # dependent gathers in the scan's HLO (the r04 anti-affinity
+            # regression, 2.5k -> 1.7k pods/s)
             cnt = carry["topo_cnt"]
             tot = carry["topo_tot"]
 
-            def term_hit(tid):
-                """[N] bool: node's domain holds an in-batch winner
-                matching term `tid` (-1 = padding, never hits)."""
-                t = jnp.maximum(tid, 0)
-                drow = anti_dom[t]
-                at = cnt[t][jnp.maximum(drow, 0)]
-                return (tid >= 0) & (drow >= 0) & (at > 0.0)
+            def term_hits(tids):
+                """[K,N] bool: node's domain holds an in-batch winner
+                matching term tids[k] (-1 = padding, never hits)."""
+                t = jnp.maximum(tids, 0)                      # [K]
+                drow = anti_dom[t]                            # [K,N]
+                at = jnp.take_along_axis(
+                    cnt[t], jnp.maximum(drow, 0), axis=1)     # [K,N]
+                return (tids[:, None] >= 0) & (drow >= 0) & (at > 0.0)
 
-            bad = jnp.zeros((N,), bool)
-            for k in range(pod["anti_tids"].shape[0]):
-                # required anti-affinity: a carried term with a winner in
-                # the node's domain forbids the node
-                bad = bad | term_hit(pod["anti_tids"][k])
-            for k in range(pod["aff_tids"].shape[0]):
-                # waived required affinity: once ANY winner matches the
-                # term, later carriers must co-locate into its domain
-                tid = pod["aff_tids"][k]
-                need = (tid >= 0) & (tot[jnp.maximum(tid, 0)] > 0.0)
-                bad = bad | (need & ~term_hit(tid))
+            # required anti-affinity: a carried term with a winner in
+            # the node's domain forbids the node
+            bad = term_hits(pod["anti_tids"]).any(axis=0)
+            # waived required affinity: once ANY winner matches the
+            # term, later carriers must co-locate into its domain
+            atids = pod["aff_tids"]
+            need = (atids >= 0) & (tot[jnp.maximum(atids, 0)] > 0.0)
+            bad = bad | (need[:, None]
+                         & ~term_hits(atids)).any(axis=0)
             fits = fits & ~bad
         score = _pod_score(node_cfg, carry["nz_used"], pod, static, rw)
         # SelectorSpread runs IN-SCAN from running group counts — the
@@ -304,16 +323,15 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
             "spread": carry["spread"].at[:, best].add(sm * ok_f),
         }
         if has_topo:
-            new_cnt, new_tot = carry["topo_cnt"], carry["topo_tot"]
-            for k in range(pod["match_tids"].shape[0]):
-                tid = pod["match_tids"][k]
-                t = jnp.maximum(tid, 0)
-                d = anti_dom[t, best]
-                val = ((tid >= 0) & (d >= 0) & ok).astype(jnp.float32)
-                new_cnt = new_cnt.at[t, jnp.maximum(d, 0)].add(val)
-                new_tot = new_tot.at[t].add(val)
-            out["topo_cnt"] = new_cnt
-            out["topo_tot"] = new_tot
+            # one [K]-vector scatter-add instead of K chained scatters
+            # (duplicate padded indices add 0, .at accumulates safely)
+            mtids = pod["match_tids"]                         # [K]
+            mt = jnp.maximum(mtids, 0)
+            md = anti_dom[mt, best]                           # [K]
+            val = ((mtids >= 0) & (md >= 0) & ok).astype(jnp.float32)
+            out["topo_cnt"] = carry["topo_cnt"].at[
+                mt, jnp.maximum(md, 0)].add(val)
+            out["topo_tot"] = carry["topo_tot"].at[mt].add(val)
         assign = jnp.where(ok, best, jnp.int32(-1))
         return out, (assign, masked[best])
 
@@ -322,10 +340,35 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     if has_topo:
         carry0["topo_cnt"] = pod_batch["anti_cnt0"]
         carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
-    final, (assign, scores) = lax.scan(step, carry0, per_pod)
-    return assign, scores, {"used": final["used"],
-                            "nonzero_used": final["nz_used"],
-                            "pod_count": final["pod_count"]}
+    # STEP GROUPING: the scan is latency-bound — each step's compute
+    # ([N]-vector ops) is tiny next to the per-step sequencing overhead,
+    # so a P-step scan costs ~P * step_latency regardless of N. Packing G
+    # pods per step (unrolled inside, SAME op sequence -> bit-identical
+    # results) cuts the step count G-fold. P is always a power of two
+    # >= 8 (tensorize._bucket), so G=8 divides it exactly.
+    P = per_pod["seq"].shape[0]
+    # clamp the knob to a power of two dividing P (P is always a power of
+    # two via tensorize._bucket) — an arbitrary env value must degrade,
+    # not crash the reshape below
+    want = max(1, _STEP_GROUP_TOPO if has_topo else _STEP_GROUP)
+    G = min(1 << (want.bit_length() - 1), P)
+
+    def step(carry, podg):
+        outs = []
+        for g in range(G):
+            pod = {k: v[g] for k, v in podg.items()}
+            carry, out = one_pod(carry, pod)
+            outs.append(out)
+        return carry, (jnp.stack([o[0] for o in outs]),
+                       jnp.stack([o[1] for o in outs]))
+
+    per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
+                 for k, v in per_pod.items()}
+    final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
+    return (assign_g.reshape(P), scores_g.reshape(P),
+            {"used": final["used"],
+             "nonzero_used": final["nz_used"],
+             "pod_count": final["pod_count"]})
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

@@ -15,8 +15,8 @@ import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
-from .client import Client, ResourceClient
-from .store import ADDED, DELETED, ExpiredError, MODIFIED
+from .client import Client, ResourceClient, apply_bind_fields
+from .store import ADDED, DELETED, ExpiredError, MODIFIED, SlimBindRef
 
 
 class Indexer:
@@ -204,6 +204,24 @@ class SharedInformer:
             if self._stop.is_set():
                 return
             obj = ev.object
+            if isinstance(obj, SlimBindRef):
+                # negotiated slim bind frame: materialize the bound pod
+                # from our cached prior revision (the hub applied exactly
+                # these fields to exactly that object)
+                cached = self.indexer.get_by_key(
+                    f"{obj.namespace}/{obj.name}" if obj.namespace
+                    else obj.name)
+                if cached is None:
+                    try:  # cache miss (relist raced): fall back to a GET
+                        obj = self._rc.get(obj.name, namespace=obj.namespace)
+                    except Exception:
+                        continue
+                else:
+                    from ..api import serde
+                    new = serde.shallow_bind_clone(cached)
+                    apply_bind_fields(new, obj.node, obj.ts)
+                    new.metadata.resource_version = str(obj.rv)
+                    obj = new
             with self._lock:
                 handlers = list(self._handlers)
             if ev.type == ADDED:

@@ -62,6 +62,26 @@ class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED | BOOKMARK
     object: Any
     resource_version: int = 0
+    #: optional compact form of a known-shape mutation (today: binds —
+    #: {"namespace","name","node","ts"}). In-process consumers ignore it
+    #: (object is always the full canonical); the HTTP watch serves it to
+    #: clients that negotiated slim frames, the way the reference
+    #: negotiates protobuf instead of JSON per Accept header
+    slim: Any = None
+
+
+@dataclass
+class SlimBindRef:
+    """Placeholder object in a WatchEvent decoded from a negotiated slim
+    bind frame: the consumer (SharedInformer) materializes the full pod by
+    applying `apply_bind_fields` to its cached copy at the previous
+    revision. Only ever produced by the HTTP watch client — store-level
+    watches always carry full canonical objects."""
+    namespace: str
+    name: str
+    node: str
+    ts: Optional[str]
+    rv: int
 
 
 class Watch:
@@ -133,6 +153,22 @@ class Store:
                 # (etcd revisions never regress across snapshot+restart)
                 self._rv = max(self._rv, rec["rv"])
                 self._uid_counter = max(self._uid_counter, rec.get("uc", 0))
+                continue
+            if rec["op"] == "BIND":
+                # slim bind record: re-derive the bound pod from the state
+                # the log built so far (its PUT necessarily precedes) —
+                # byte-identical to the original via apply_bind_fields
+                b = rec["object"]
+                bucket = self._data.setdefault(rec["resource"], {})
+                key = (b.get("namespace", ""), b["name"])
+                cur = bucket.get(key)
+                if cur is not None:
+                    from .client import apply_bind_fields
+                    new = serde.shallow_bind_clone(cur[0])
+                    apply_bind_fields(new, b["node"], b.get("ts"))
+                    new.metadata.resource_version = str(rec["rv"])
+                    bucket[key] = (new, rec["rv"])
+                self._rv = max(self._rv, rec["rv"])
                 continue
             cls = SCHEME.type_for_resource(rec["resource"])
             if cls is None:
@@ -377,6 +413,7 @@ class Store:
     def bulk_apply(self, resource: str,
                    items: List[Tuple[str, str, Callable[[Any], Any]]],
                    copy_fn: Callable[[Any], Any] = serde.deepcopy_obj,
+                   slim_fn: Optional[Callable[[Any], Any]] = None,
                    ) -> List[Any]:
         """Apply N read-modify-write mutations under ONE lock acquisition.
 
@@ -413,9 +450,21 @@ class Store:
                                    WatchEvent(DELETED, updated, self._rv)))
                 else:
                     bucket[key] = (updated, self._rv)
-                    self._journal("PUT", resource, updated, self._rv)
+                    slim = slim_fn(updated) if slim_fn is not None else None
+                    if slim is not None:
+                        # known-shape mutation: journal the compact record
+                        # (replayed via apply_bind_fields) and hand the
+                        # watch layer the same dict — no full-pod encode
+                        # on either path
+                        if self._wal is not None:
+                            self._wal.append("BIND", resource, self._rv,
+                                             slim,
+                                             uid_counter=self._uid_counter)
+                    else:
+                        self._journal("PUT", resource, updated, self._rv)
                     events.append((resource,
-                                   WatchEvent(MODIFIED, updated, self._rv)))
+                                   WatchEvent(MODIFIED, updated, self._rv,
+                                              slim=slim)))
                 out.append(updated)
             self._wal_commit()  # one durability point per transaction
             for res, ev in events:

@@ -473,3 +473,69 @@ class TestBulkCreate:
         assert isinstance(outs[1], Exception)  # duplicate in same batch
         assert not isinstance(outs[2], Exception)
         assert outs[2].metadata.resource_version
+
+
+class TestSlimBindFrames:
+    def test_slim_watch_materializes_identical_pod(self, server):
+        """A pod informer over HTTP (slim frames negotiated) must end up
+        with exactly the object a raw full-frame watcher decodes — same
+        node, condition timestamps, resourceVersion."""
+        from kubernetes_tpu.api import serde
+        client = HTTPClient(server.address)
+        created = client.pods("default").create(make_pod("sb-1"))
+        factory = SharedInformerFactory(client)
+        inf = factory.informer_for(api.Pod)
+        updates = []
+        from kubernetes_tpu.state.informer import EventHandlers
+        inf.add_event_handlers(EventHandlers(
+            on_update=lambda old, new: updates.append(new)))
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        outs = client.pods("default").bind_bulk([api.Binding(
+            metadata=api.ObjectMeta(name="sb-1", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1"))])
+        assert not any(isinstance(o, Exception) for o in outs)
+        deadline = time.time() + 10
+        while time.time() < deadline and not updates:
+            time.sleep(0.05)
+        assert updates, "slim bind event never reached the informer"
+        got = updates[-1]
+        want = client.pods("default").get("sb-1")  # full GET, no slim
+        assert serde.encode(got) == serde.encode(want)
+        assert got.spec.node_name == "n1"
+        assert got.metadata.resource_version == \
+            want.metadata.resource_version
+        factory.stop()
+
+    def test_unnegotiated_watch_still_gets_full_frames(self, server):
+        """A raw watch WITHOUT the slimBind param receives classic full
+        object frames for binds (third-party watchers keep working)."""
+        client = HTTPClient(server.address)
+        client.pods("default").create(make_pod("full-1"))
+        rc = client.pods("default")
+        rc._SLIM_WATCH = False  # a watcher that never negotiated
+        w = rc.watch(resource_version=0)
+        try:
+            rc.bind_bulk([api.Binding(
+                metadata=api.ObjectMeta(name="full-1",
+                                        namespace="default"),
+                target=api.ObjectReference(kind="Node", name="n9"))])
+            deadline = time.time() + 10
+            bound = None
+            import queue as qm
+            while time.time() < deadline:
+                try:
+                    ev = w.events.get(timeout=0.5)
+                except qm.Empty:
+                    continue
+                if ev is None:
+                    break
+                if ev.type == "MODIFIED" and \
+                        getattr(ev.object, "spec", None) is not None \
+                        and ev.object.spec.node_name == "n9":
+                    bound = ev.object
+                    break
+            assert bound is not None, "full MODIFIED frame never arrived"
+            assert bound.metadata.name == "full-1"
+        finally:
+            w.stop()

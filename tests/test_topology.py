@@ -270,3 +270,29 @@ class TestInScanParity:
         assert extra["batch_imbalance"] <= extra["oracle_imbalance"] + 1
         rate_a, _, _ = bench.measure_parity("pod-anti-affinity", 300, 60)
         assert rate_a >= 0.99, f"anti-affinity parity {rate_a}"
+
+
+class TestScoreBoundaryParity:
+    def test_balanced_allocation_integer_boundary(self):
+        """When |cpuFrac - memFrac| * 10 lands EXACTLY on an integer in
+        exact math (cpuFrac .7875 - memFrac .1875 = .6), the f32 kernel
+        must agree with the f64 oracle's truncation — the epsilon-floor
+        in _balanced_allocation guards the boundary (the r04 pod-affinity
+        parity gap: a one-point flip permuted whole assignment windows)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from kubernetes_tpu.scheduler.kernels.batch import (
+            _balanced_allocation)
+        cap_cpu = jnp.asarray([4000.0], jnp.float32)
+        cap_mem = jnp.asarray([float(2 ** 35)], jnp.float32)
+        # node usage 3050m / 6144Mi + pod request 100m / 128Mi:
+        # cpuFrac = 3150/4000 = .7875, memFrac = 6442450944/2^35 = .1875
+        nz_used = jnp.asarray([[3050.0, 6308233216.0]], jnp.float32)
+        nz_req = jnp.asarray([100.0, 134217728.0], jnp.float32)
+        got = float(_balanced_allocation(nz_used, nz_req,
+                                         cap_cpu, cap_mem)[0])
+        # oracle (priorities.balanced_allocation_map semantics, f64)
+        cf = 3150.0 / 4000.0
+        mf = 6442450944.0 / float(2 ** 35)
+        want = int((1.0 - abs(cf - mf)) * 10.0)
+        assert got == want == 4
