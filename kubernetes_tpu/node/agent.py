@@ -42,7 +42,8 @@ class NodeAgent:
                  runtime: Optional[ContainerRuntime] = None,
                  heartbeat_period: float = 10.0,
                  pleg_period: float = 1.0, eviction=None,
-                 static_pod_dir=None, serve_port=None):
+                 static_pod_dir=None, serve_port=None,
+                 device_manager=None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity or DEFAULT_CAPACITY)
@@ -84,6 +85,10 @@ class NodeAgent:
         #: /containerLogs) when a port is given (0 = ephemeral)
         self.server = None
         self._serve_port = serve_port
+        #: extended-resource plugins (TPUs): advertises allocatable,
+        #: allocates device IDs at sandbox creation, checkpoints
+        #: (ref: kubelet cm/devicemanager wiring in container manager)
+        self.device_manager = device_manager
 
     def _on_pod_event(self, pod: Pod) -> None:
         if pod.spec.node_name == self.node_name:
@@ -95,6 +100,13 @@ class NodeAgent:
         """Create (or reclaim) the Node object (ref: kubelet registerWithAPIServer
         + nodestatus setters) and its lease."""
         caps = {k: Quantity(v) for k, v in self.capacity.items()}
+        if self.device_manager is not None:
+            # plugin-advertised extended resources ride the same
+            # capacity/allocatable surface the scheduler reads
+            # (ref: nodestatus MachineInfo setter + devicemanager
+            # GetCapacity)
+            for rname, count in self.device_manager.allocatable().items():
+                caps[rname] = Quantity(count)
         node = Node(
             metadata=ObjectMeta(name=self.node_name, labels={
                 "kubernetes.io/hostname": self.node_name, **self.labels}))
@@ -192,6 +204,21 @@ class NodeAgent:
             self.client.nodes().patch(self.node_name, beat)
         except Exception:
             pass
+        if self.device_manager is not None:
+            # the ListAndWatch poll: health changes re-publish node
+            # allocatable so the scheduler stops counting broken chips
+            try:
+                if self.device_manager.refresh():
+                    alloc = self.device_manager.allocatable()
+
+                    def republish(cur):
+                        for rname, count in alloc.items():
+                            cur.status.capacity[rname] = Quantity(count)
+                            cur.status.allocatable[rname] = Quantity(count)
+                        return cur
+                    self.client.nodes().patch(self.node_name, republish)
+            except Exception:
+                pass
         self._renew_lease()
         self._maybe_evict()
 
@@ -229,11 +256,15 @@ class NodeAgent:
                 self.runtime.stop_pod_sandbox(uid)
                 self.prober.forget(uid)
                 self._reported.pop(uid, None)
+                if self.device_manager is not None:
+                    self.device_manager.free(uid)
             return
         if helpers.pod_is_terminal(pod):
             self.runtime.stop_pod_sandbox(pod.metadata.uid)
             self.prober.forget(pod.metadata.uid)
             self._reported.pop(pod.metadata.uid, None)
+            if self.device_manager is not None:
+                self.device_manager.free(pod.metadata.uid)
             return
         sb = self.runtime.pod_sandbox(pod.metadata.uid)
         if sb is None:
@@ -247,6 +278,18 @@ class NodeAgent:
                                    reason="CreateContainerConfigError")
                 raise RuntimeError(
                     f"pod {key} waiting for volume sources: {missing}")
+            if self.device_manager is not None:
+                # allocate concrete device IDs BEFORE the sandbox exists
+                # (ref: the devicemanager Allocate admission hook) — a pod
+                # the scheduler oversubscribed fails here, not mid-run
+                from .devicemanager import InsufficientDevices
+                try:
+                    self.device_manager.ensure_allocated(pod)
+                except InsufficientDevices as e:
+                    self._write_status(pod, "Pending", ready=False,
+                                       reason="UnexpectedAdmissionError")
+                    raise RuntimeError(
+                        f"pod {key} device allocation failed: {e}")
             sb = self.runtime.run_pod_sandbox(pod)
             self.runtime.start_containers(sb, pod)
         # status write runs on EVERY sync, not only sandbox creation — the
@@ -450,8 +493,19 @@ class NodeAgent:
         if self._serve_port is not None:
             from .server import KubeletServer
             self.server = KubeletServer(self, port=self._serve_port).start()
-        for pod in self.pod_informer.indexer.by_index("nodeName",
-                                                      self.node_name):
+        my_pods = self.pod_informer.indexer.by_index("nodeName",
+                                                     self.node_name)
+        self._device_pruned = False
+        if self.device_manager is not None and \
+                self.pod_informer.has_synced():
+            # reconcile the checkpoint against live pods: chips held by a
+            # pod deleted while this kubelet was down must come back
+            # (ref: devicemanager pruning vs GetActivePods on startup).
+            # Only against a SYNCED informer — an empty pre-sync indexer
+            # would free every live pod's chips
+            self.device_manager.prune(p.metadata.uid for p in my_pods)
+            self._device_pruned = True
+        for pod in my_pods:
             self.queue.add(pod.metadata.key())
         for suffix, target in (("sync", self._sync_worker),
                                ("heartbeat", self._heartbeat_loop),
@@ -480,6 +534,14 @@ class NodeAgent:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_period):
+            if self.device_manager is not None and \
+                    not self._device_pruned and \
+                    self.pod_informer.has_synced():
+                # deferred startup reconcile (informer synced after start)
+                self.device_manager.prune(
+                    p.metadata.uid for p in self.pod_informer.indexer
+                    .by_index("nodeName", self.node_name))
+                self._device_pruned = True
             self.heartbeat()
             self.sync_static_pods()  # re-scan the manifest dir
 

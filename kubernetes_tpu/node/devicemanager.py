@@ -1,0 +1,352 @@
+"""Device-plugin manager — extended resources (TPUs) on the node.
+
+Ref: pkg/kubelet/cm/devicemanager/manager.go (ManagerImpl: plugin
+registration socket, per-resource endpoints, Allocate into container
+config), pkg/kubelet/apis/deviceplugin/v1beta1/api.proto (Registration /
+ListAndWatch / Allocate RPC surface), and
+pkg/kubelet/cm/devicemanager/checkpoint (pod->device assignments that
+survive kubelet restarts).
+
+Re-shaped for this runtime: the RPC boundary is a UNIX socket speaking
+length-prefixed JSON (this image carries no gRPC; the boundary is still a
+real socket between processes/threads, not an in-process call), device
+health arrives by poll-refresh instead of a streaming ListAndWatch, and
+allocation is deterministic (lowest free IDs first) so checkpoint replay
+and tests are stable.
+
+This is the flagship TPU story end-to-end: a plugin advertises
+`google.com/tpu`, the node publishes it in allocatable, the scheduler's
+kernel carries it as a scalar column (tensorize interns every requested
+resource), the bind lands, and the kubelet allocates concrete chip IDs
+at sandbox creation — checkpointed to disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    payload = b""
+    while len(payload) < n:
+        chunk = sock.recv(n - len(payload))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        payload += chunk
+    return json.loads(payload)
+
+
+class TPUDevicePlugin:
+    """A device plugin advertising N TPU chips (the in-repo analog of a
+    vendor plugin binary). `allocate` hands back the env a runtime would
+    inject (TPU_VISIBLE_CHIPS — the chip-pinning contract)."""
+
+    def __init__(self, resource: str = "google.com/tpu", count: int = 8):
+        self.resource = resource
+        self._devices = {f"tpu-{i}": True for i in range(count)}
+        self._lock = threading.Lock()
+
+    def set_health(self, device_id: str, healthy: bool) -> None:
+        with self._lock:
+            if device_id in self._devices:
+                self._devices[device_id] = healthy
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"resource": self.resource,
+                    "devices": [{"id": d, "healthy": h}
+                                for d, h in sorted(self._devices.items())]}
+
+    def allocate(self, ids: List[str]) -> dict:
+        with self._lock:
+            unknown = [i for i in ids if i not in self._devices]
+        if unknown:
+            return {"error": f"unknown devices {unknown}"}
+        return {"env": {"TPU_VISIBLE_CHIPS":
+                        ",".join(sorted(ids))}}
+
+
+class DevicePluginServer:
+    """Plugin half of the socket boundary: serves info/allocate requests
+    for one plugin on a unix socket (ref: the plugin's gRPC server on
+    /var/lib/kubelet/device-plugins/<resource>.sock)."""
+
+    def __init__(self, plugin, socket_path: str):
+        self.plugin = plugin
+        self.socket_path = socket_path
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DevicePluginServer":
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.5)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"plugin-{self.plugin.resource}")
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_msg(conn)
+                op = req.get("op")
+                if op == "info":
+                    _send_msg(conn, self.plugin.info())
+                elif op == "allocate":
+                    _send_msg(conn, self.plugin.allocate(req.get("ids", [])))
+                else:
+                    _send_msg(conn, {"error": f"unknown op {op}"})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+
+
+class PluginEndpoint:
+    """Kubelet half: one persistent connection per registered plugin
+    (ref: devicemanager endpoint.go)."""
+
+    #: bound on any single plugin RPC — a hung plugin must fail a pod's
+    #: sync, not wedge the manager lock forever
+    RPC_TIMEOUT_S = 5.0
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(self.RPC_TIMEOUT_S)
+        self._sock.connect(socket_path)
+        self._lock = threading.Lock()
+
+    def info(self) -> dict:
+        with self._lock:
+            _send_msg(self._sock, {"op": "info"})
+            return _recv_msg(self._sock)
+
+    def allocate(self, ids: List[str]) -> dict:
+        with self._lock:
+            _send_msg(self._sock, {"op": "allocate", "ids": ids})
+            return _recv_msg(self._sock)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class InsufficientDevices(Exception):
+    """Admission failure: the pod asks for more devices than are free
+    (ref: devicemanager's UnexpectedAdmissionError)."""
+
+
+class DeviceManager:
+    """Tracks registered plugins, healthy devices, and per-pod
+    assignments; persists assignments to a checkpoint file so a kubelet
+    restart never double-allocates a chip
+    (ref: devicemanager/checkpoint/checkpoint.go)."""
+
+    def __init__(self, checkpoint_path: Optional[str] = None):
+        self._endpoints: Dict[str, PluginEndpoint] = {}
+        #: resource -> {device_id: healthy}
+        self._devices: Dict[str, Dict[str, bool]] = {}
+        #: pod_uid -> {resource: [device_ids]}
+        self._allocations: Dict[str, Dict[str, List[str]]] = {}
+        #: pod_uid -> {env var: value} merged from plugin responses
+        self._env: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+        self.checkpoint_path = checkpoint_path
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            with open(checkpoint_path) as f:
+                data = json.load(f)
+            self._allocations = data.get("allocations", {})
+            self._env = data.get("env", {})
+
+    # ------------------------------------------------------ registration
+
+    def register_plugin(self, socket_path: str) -> str:
+        """Connect to a plugin's socket and adopt its resource (ref:
+        Registration.Register + addEndpoint). Returns the resource name."""
+        ep = PluginEndpoint(socket_path)
+        info = ep.info()
+        resource = info["resource"]
+        with self._lock:
+            self._endpoints[resource] = ep
+            self._devices[resource] = {d["id"]: d["healthy"]
+                                       for d in info["devices"]}
+        return resource
+
+    def refresh(self) -> bool:
+        """Poll device health from every endpoint (the ListAndWatch
+        analog); dead endpoints mark their resource unhealthy wholesale —
+        the reference's endpoint-gone -> devices unhealthy behavior.
+        Returns True when any health table changed (the agent re-publishes
+        node allocatable on True)."""
+        with self._lock:
+            eps = dict(self._endpoints)
+        changed = False
+        for resource, ep in eps.items():
+            try:
+                info = ep.info()
+                table = {d["id"]: d["healthy"] for d in info["devices"]}
+            except (ConnectionError, OSError, socket.timeout):
+                table = {d: False for d in self._devices.get(resource, {})}
+            with self._lock:
+                if self._devices.get(resource) != table:
+                    self._devices[resource] = table
+                    changed = True
+        return changed
+
+    def prune(self, active_pod_uids) -> None:
+        """Drop checkpointed allocations for pods that no longer exist —
+        a pod deleted while the kubelet was down must not leak its chips
+        (ref: devicemanager reconciling the checkpoint against
+        GetActivePods on startup)."""
+        live = set(active_pod_uids)
+        with self._lock:
+            stale = [uid for uid in self._allocations if uid not in live]
+            for uid in stale:
+                del self._allocations[uid]
+                self._env.pop(uid, None)
+            if stale:
+                self._checkpoint_locked()
+
+    # ------------------------------------------------------- accounting
+
+    def resources(self) -> List[str]:
+        with self._lock:
+            return list(self._devices)
+
+    def allocatable(self) -> Dict[str, int]:
+        """Healthy device counts per resource — merged into the node's
+        status.capacity/allocatable by the agent."""
+        with self._lock:
+            return {r: sum(1 for h in table.values() if h)
+                    for r, table in self._devices.items()}
+
+    def _in_use(self, resource: str) -> set:
+        used = set()
+        for per_pod in self._allocations.values():
+            used.update(per_pod.get(resource, ()))
+        return used
+
+    def pod_devices(self, pod_uid: str) -> Dict[str, List[str]]:
+        with self._lock:
+            return {r: list(ids)
+                    for r, ids in self._allocations.get(pod_uid, {}).items()}
+
+    def pod_env(self, pod_uid: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._env.get(pod_uid, {}))
+
+    # -------------------------------------------------------- allocation
+
+    def ensure_allocated(self, pod) -> Dict[str, str]:
+        """Allocate devices for every registered extended resource the
+        pod's containers request (idempotent per pod uid). Returns the env
+        to inject. Raises InsufficientDevices when free healthy devices
+        cannot cover the request (ref: Allocate in the admission path)."""
+        needs: Dict[str, int] = {}
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            reqs = getattr(getattr(c, "resources", None), "requests", None) \
+                or {}
+            for rname, q in reqs.items():
+                if rname in self._devices:
+                    needs[rname] = needs.get(rname, 0) + int(q.value())
+        if not needs:
+            return {}
+        uid = pod.metadata.uid
+        with self._lock:
+            if uid in self._allocations:
+                return dict(self._env.get(uid, {}))
+            picked: Dict[str, List[str]] = {}
+            for resource, want in needs.items():
+                free = sorted(d for d, h in self._devices[resource].items()
+                              if h and d not in self._in_use(resource))
+                if len(free) < want:
+                    raise InsufficientDevices(
+                        f"{resource}: want {want}, {len(free)} free")
+                picked[resource] = free[:want]
+            env: Dict[str, str] = {}
+            for resource, ids in picked.items():
+                try:
+                    resp = self._endpoints[resource].allocate(ids) \
+                        if resource in self._endpoints else {"env": {}}
+                except (ConnectionError, OSError, socket.timeout) as e:
+                    # bounded by RPC_TIMEOUT_S: a hung plugin fails THIS
+                    # pod's sync (retried by the workqueue), it does not
+                    # wedge the manager
+                    raise InsufficientDevices(
+                        f"{resource}: plugin unreachable: {e}")
+                if resp.get("error"):
+                    raise InsufficientDevices(
+                        f"{resource}: plugin refused: {resp['error']}")
+                env.update(resp.get("env", {}))
+            self._allocations[uid] = picked
+            self._env[uid] = env
+            self._checkpoint_locked()
+            return dict(env)
+
+    def free(self, pod_uid: str) -> None:
+        with self._lock:
+            if self._allocations.pop(pod_uid, None) is not None:
+                self._env.pop(pod_uid, None)
+                self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        if not self.checkpoint_path:
+            return
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"allocations": self._allocations,
+                       "env": self._env}, f)
+        os.replace(tmp, self.checkpoint_path)
+
+    def close(self) -> None:
+        with self._lock:
+            for ep in self._endpoints.values():
+                try:
+                    ep.close()
+                except OSError:
+                    pass
+            self._endpoints.clear()
