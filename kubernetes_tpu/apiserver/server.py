@@ -595,6 +595,10 @@ class APIServer:
             if req.resource == "nodes" and req.subresource == "proxy":
                 self._proxy_to_kubelet(h, req)
                 return
+            if req.resource == "pods" and req.subresource == "attach":
+                # kubectl attach transport (ref: AttachREST + getAttach)
+                self._handle_pod_attach(h, req)
+                return
             if req.name:
                 obj = rc.get(req.name, namespace=req.namespace or None)
                 self._respond(h, 200, obj)
@@ -617,6 +621,12 @@ class APIServer:
             data = self._read_body(h)
             if data is None:
                 self._error(h, 422, "Invalid", "empty request body")
+                return
+            if req.resource == "pods" and req.subresource == "exec":
+                # kubectl exec transport (ref: registry/core/pod/rest
+                # ExecREST + kubelet server.go getExec): resolve the
+                # pod's node, forward one exec round trip to its kubelet
+                self._handle_pod_exec(h, req, data)
                 return
             if req.resource == "pods" and req.subresource == "eviction":
                 # the Eviction API: PDB-guarded delete (ref:
@@ -885,17 +895,91 @@ class APIServer:
         self._respond_raw(h, 200, json.dumps(body).encode(),
                           "application/json")
 
+    def _kubelet_target(self, node_name: str):
+        """(ip, port) the node publishes for its kubelet server, or
+        (None, None) — shared by the proxy and exec/attach routes."""
+        node = self.client.nodes().get(node_name)
+        port = ((node.status.daemon_endpoints or {})
+                .get("kubeletEndpoint") or {}).get("Port")
+        ip = next((a.get("address") for a in node.status.addresses
+                   if a.get("type") == "InternalIP"), None)
+        return ip, port
+
+    def _resolve_pod_kubelet(self, h, req: _Request):
+        """(pod, kubelet base url) for a streaming subresource, or None
+        after writing the error response."""
+        pod = self.client.pods(req.namespace or "default").get(
+            req.name, namespace=req.namespace or "default")
+        if not pod.spec.node_name:
+            self._error(h, 409, "Conflict",
+                        f"pod {req.name} is not bound to a node")
+            return None
+        ip, port = self._kubelet_target(pod.spec.node_name)
+        if not port or not ip:
+            self._error(h, 503, "ServiceUnavailable",
+                        f"node {pod.spec.node_name} publishes no "
+                        f"kubelet endpoint")
+            return None
+        return pod, f"http://{ip}:{port}"
+
+    def _handle_pod_exec(self, h, req: _Request, data) -> None:
+        """POST pods/{name}/exec {"container"?, "command": [...],
+        "stdin"?: b64} -> the kubelet's {"exitCode", "output"} verbatim."""
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        resolved = self._resolve_pod_kubelet(h, req)
+        if resolved is None:
+            return
+        pod, base = resolved
+        container = data.get("container") or (
+            pod.spec.containers[0].name if pod.spec.containers else "")
+        ns = pod.metadata.namespace or "default"
+        target = f"{base}/exec/{ns}/{pod.metadata.name}/{container}"
+        body = json.dumps({"command": data.get("command", []),
+                           "stdin": data.get("stdin", "")}).encode()
+        try:
+            r = urlrequest.urlopen(urlrequest.Request(
+                target, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST"), timeout=10)
+            self._respond_raw(h, 200, r.read(), "application/json")
+        except urlerror.HTTPError as e:
+            self._respond_raw(h, e.code, e.read(),
+                              e.headers.get("Content-Type", "text/plain"))
+        except Exception as e:
+            self._error(h, 502, "BadGateway",
+                        f"exec to {pod.spec.node_name} failed: {e}")
+
+    def _handle_pod_attach(self, h, req: _Request) -> None:
+        """GET pods/{name}/attach?container= -> the kubelet's current
+        output stream for the container."""
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        resolved = self._resolve_pod_kubelet(h, req)
+        if resolved is None:
+            return
+        pod, base = resolved
+        container = req.query.get("container") or (
+            pod.spec.containers[0].name if pod.spec.containers else "")
+        ns = pod.metadata.namespace or "default"
+        target = f"{base}/attach/{ns}/{pod.metadata.name}/{container}"
+        try:
+            with urlrequest.urlopen(target, timeout=10) as r:
+                self._respond_raw(h, 200, r.read(), "text/plain")
+        except urlerror.HTTPError as e:
+            self._respond_raw(h, e.code, e.read(),
+                              e.headers.get("Content-Type", "text/plain"))
+        except Exception as e:
+            self._error(h, 502, "BadGateway",
+                        f"attach to {pod.spec.node_name} failed: {e}")
+
     def _proxy_to_kubelet(self, h, req: _Request) -> None:
         """GET /api/v1/nodes/{name}/proxy/<path> — the apiserver->kubelet
         proxy (ref: pkg/registry/core/node/rest ProxyREST), the transport
         kubectl logs rides. The kubelet address comes from the node's
         status (InternalIP + daemonEndpoints.kubeletEndpoint.Port)."""
         from urllib import request as urlrequest
-        node = self.client.nodes().get(req.name)
-        port = ((node.status.daemon_endpoints or {})
-                .get("kubeletEndpoint") or {}).get("Port")
-        ip = next((a.get("address") for a in node.status.addresses
-                   if a.get("type") == "InternalIP"), None)
+        ip, port = self._kubelet_target(req.name)
         if not port or not ip:
             self._error(h, 503, "ServiceUnavailable",
                         f"node {req.name} publishes no kubelet endpoint")

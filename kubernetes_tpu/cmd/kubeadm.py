@@ -24,6 +24,23 @@ import time
 from typing import Optional, Tuple
 
 
+def _wipe_dir(data_dir: str) -> None:
+    """Empty a kubeadm data dir (pki, WAL, audit) without removing the
+    dir itself — shared by ControlPlane.reset and the reset CLI."""
+    import shutil
+    if not os.path.isdir(data_dir):
+        return
+    for entry in os.listdir(data_dir):
+        path = os.path.join(data_dir, entry)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+
 def _write(path: str, data: bytes) -> str:
     # key material must never be world-readable (the reference's
     # keyutil.WriteKey uses 0600); harmless extra strictness for certs
@@ -106,6 +123,16 @@ class ControlPlane:
             metadata=ObjectMeta(name="cluster-info",
                                 namespace="kube-public"),
             data={"kubeconfig": cluster_info}))
+        # the uploaded ClusterConfiguration (ref: kubeadm's uploadconfig
+        # phase writing the kubeadm-config ConfigMap) — `upgrade` CAS-es
+        # this document and restarts components against it
+        self.version = "v1.0.0"
+        self.server.client.config_maps("kube-system").create(ConfigMap(
+            metadata=ObjectMeta(name="kubeadm-config",
+                                namespace="kube-system"),
+            data={"ClusterConfiguration": json.dumps(
+                {"kubernetesVersion": self.version,
+                 "clusterName": "kubernetes"})}))
         authz = RBACAuthorizer()
         authz.grant("group:system:masters", ["*"], ["*"])
         # bootstrappers may create and read CSRs, nothing else
@@ -186,6 +213,71 @@ class ControlPlane:
             self.manager.stop()
         self.server.stop()
         self._store.close()
+
+    # ------------------------------------------------------------ upgrade
+
+    @staticmethod
+    def _version_tuple(v: str):
+        """Numeric ordering key for vX.Y.Z[-suffix] strings; a component
+        with no numeric prefix is a clean error, not a traceback."""
+        import re
+        parts = []
+        for x in v.lstrip("v").split("-")[0].split("."):
+            m = re.match(r"\d+", x)
+            if m is None:
+                raise ValueError(f"unparseable version {v!r}")
+            parts.append(int(m.group()))
+        return tuple(parts)
+
+    def upgrade(self, target_version: str) -> dict:
+        """`kubeadm upgrade apply` (ref: cmd/kubeadm/app/cmd/upgrade.go
+        + phases/upgrade): preflight the stored ClusterConfiguration,
+        re-render it at the target version, then restart control-plane
+        components in the reference's order — the API server keeps
+        serving (it IS the upgrade transport), controller-manager
+        restarts before the scheduler. Returns the upgrade plan record."""
+        from ..controllers import ControllerManager
+        from ..scheduler import Scheduler
+        cm = self.server.client.config_maps("kube-system").get(
+            "kubeadm-config")
+        cfg = json.loads(cm.data["ClusterConfiguration"])
+        current = cfg["kubernetesVersion"]
+        if self._version_tuple(target_version) <= \
+                self._version_tuple(current):
+            raise ValueError(
+                f"target {target_version} is not newer than {current}")
+        # phase: re-render + upload the new ClusterConfiguration (CAS —
+        # a concurrent upgrade loses cleanly)
+        cfg["kubernetesVersion"] = target_version
+        cm.data["ClusterConfiguration"] = json.dumps(cfg)
+        self.server.client.config_maps("kube-system").update(cm)
+        # phase: restart components in order against the SAME store;
+        # leader leases release on stop, the replacements re-acquire
+        plan = {"from": current, "to": target_version, "restarted": []}
+        ca = (open(self.pki["ca_cert"], "rb").read(),
+              open(self.pki["ca_key"], "rb").read())
+        if self.manager is not None:
+            self.manager.stop()
+            self.manager = ControllerManager(self.admin_client,
+                                             cluster_ca=ca)
+            self.manager.start()
+            plan["restarted"].append("kube-controller-manager")
+        if self.scheduler is not None:
+            self.scheduler.stop()
+            self.scheduler = Scheduler(self.admin_client)
+            self.scheduler.start()
+            plan["restarted"].append("kube-scheduler")
+        self.version = target_version
+        return plan
+
+    def reset(self) -> None:
+        """`kubeadm reset` (ref: cmd/kubeadm/app/cmd/reset.go): stop
+        everything, then tear down the node-local state this init laid
+        down — pki, WAL, audit log — leaving a clean data dir a fresh
+        init can reuse."""
+        self.stop()
+        data_dir = os.path.dirname(self.pki["ca_cert"])  # <data>/pki
+        _wipe_dir(os.path.dirname(data_dir))
 
 
 def discover_cluster_info(server_url: str, token: str,
@@ -316,6 +408,15 @@ def main(argv=None) -> int:
     j.add_argument("--node-name", required=True)
     j.add_argument("--work-dir", required=True)
     j.add_argument("--ca-file", default=None)
+    u = sub.add_parser("upgrade")
+    u.add_argument("action", choices=["plan", "apply"])
+    u.add_argument("version", nargs="?", default=None)
+    u.add_argument("--server", required=True)
+    u.add_argument("--ca-file", required=True)
+    u.add_argument("--cert-file", required=True)
+    u.add_argument("--key-file", required=True)
+    r = sub.add_parser("reset")
+    r.add_argument("--data-dir", required=True)
     args = p.parse_args(argv)
 
     if args.cmd == "init":
@@ -343,6 +444,47 @@ def main(argv=None) -> int:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         stop.wait()
         node.stop()
+        return 0
+    if args.cmd == "upgrade":
+        # the out-of-process form of the upgrade phases: plan reads the
+        # uploaded ClusterConfiguration; apply CAS-es the re-rendered one
+        # (the owning init process restarts components via
+        # ControlPlane.upgrade — ref: upgrade.go's apply flow)
+        from ..apiserver.httpclient import HTTPClient
+        client = HTTPClient(args.server, ca_file=args.ca_file,
+                            cert_file=args.cert_file,
+                            key_file=args.key_file)
+        cm = client.config_maps("kube-system").get("kubeadm-config")
+        cfg = json.loads(cm.data["ClusterConfiguration"])
+        if args.action == "plan":
+            print(json.dumps({"current": cfg["kubernetesVersion"],
+                              "target": args.version or "(none given)"}))
+            return 0
+        if not args.version:
+            print("error: upgrade apply needs a version", file=sys.stderr)
+            return 1
+        try:
+            cur = ControlPlane._version_tuple(cfg["kubernetesVersion"])
+            newer = ControlPlane._version_tuple(args.version) > cur
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if not newer:
+            print(f"error: {args.version} is not newer than "
+                  f"{cfg['kubernetesVersion']}", file=sys.stderr)
+            return 1
+        cfg["kubernetesVersion"] = args.version
+        cm.data["ClusterConfiguration"] = json.dumps(cfg)
+        client.config_maps("kube-system").update(cm)
+        print(f"upgraded cluster configuration to {args.version}",
+              flush=True)
+        return 0
+    if args.cmd == "reset":
+        # node-local teardown (ref: reset.go): wipe pki/WAL/audit so a
+        # fresh init starts clean. Refuses nothing — reset is the
+        # "I mean it" command, exactly like the reference
+        _wipe_dir(args.data_dir)
+        print(f"reset: {args.data_dir} cleaned", flush=True)
         return 0
     return 1
 

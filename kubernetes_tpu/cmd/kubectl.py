@@ -366,6 +366,108 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def _exec_via_api(master, namespace, pod_name, container, command,
+                  stdin: bytes = b""):
+    """One exec round trip through the pods/exec subresource. Returns
+    (exitCode, output bytes)."""
+    import base64
+    import json as _json
+    from urllib import request as urlrequest
+    url = (f"{master}/api/v1/namespaces/{namespace}/pods/"
+           f"{pod_name}/exec")
+    body = _json.dumps({
+        "container": container, "command": list(command),
+        "stdin": base64.b64encode(stdin).decode()}).encode()
+    req = urlrequest.Request(url, data=body,
+                             headers={"Content-Type": "application/json"},
+                             method="POST")
+    with urlrequest.urlopen(req, timeout=15) as r:
+        resp = _json.loads(r.read())
+    return resp.get("exitCode", 1), base64.b64decode(resp.get("output", ""))
+
+
+def cmd_exec(args) -> int:
+    """kubectl exec <pod> [-c container] -- command...: runs in the
+    pod's container through apiserver->kubelet (ref: pkg/kubectl/cmd/exec
+    over the ExecREST/getExec transport)."""
+    # argparse.REMAINDER swallows flags placed after the pod name (the
+    # standard kubectl order `exec POD -c C -- cmd`): recover them here
+    command = list(args.command)
+    container = args.container
+    while len(command) >= 2 and command[0] in ("-c", "--container"):
+        container = command[1]
+        command = command[2:]
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("error: a command is required", file=sys.stderr)
+        return 1
+    try:
+        code, output = _exec_via_api(args.master, args.namespace,
+                                     args.name, container, command)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(output.decode(errors="replace"))
+    return code
+
+
+def cmd_attach(args) -> int:
+    """kubectl attach <pod> [-c container]: the container's current
+    output stream (ref: pkg/kubectl/cmd/attach over AttachREST)."""
+    from urllib import request as urlrequest
+    url = (f"{args.master}/api/v1/namespaces/{args.namespace}/pods/"
+           f"{args.name}/attach")
+    if args.container:
+        url += f"?container={args.container}"
+    try:
+        with urlrequest.urlopen(url, timeout=15) as r:
+            sys.stdout.write(r.read().decode(errors="replace"))
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_cp(args) -> int:
+    """kubectl cp <pod>:<path> <local> | <local> <pod>:<path> — file
+    transfer over the exec transport (ref: pkg/kubectl/cmd/cp, which
+    streams tar through exec; here cat/tee carry the bytes)."""
+    def parse(spec):
+        if ":" in spec:
+            pod, _, path = spec.partition(":")
+            return pod, path
+        return None, spec
+    src_pod, src_path = parse(args.src)
+    dst_pod, dst_path = parse(args.dst)
+    if (src_pod is None) == (dst_pod is None):
+        print("error: exactly one of src/dst must be pod:path",
+              file=sys.stderr)
+        return 1
+    try:
+        if src_pod is not None:  # pod -> local
+            code, data = _exec_via_api(args.master, args.namespace,
+                                       src_pod, args.container,
+                                       ["cat", src_path])
+            if code != 0:
+                sys.stderr.write(data.decode(errors="replace"))
+                return code
+            with open(dst_path, "wb") as f:
+                f.write(data)
+            return 0
+        with open(src_path, "rb") as f:  # local -> pod
+            data = f.read()
+        code, out = _exec_via_api(args.master, args.namespace, dst_pod,
+                                  args.container, ["tee", dst_path],
+                                  stdin=data)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if code != 0:
+        sys.stderr.write(out.decode(errors="replace"))
+    return code
+
+
 def cmd_drain(args) -> int:
     """kubectl drain: cordon, then evict every pod off the node through
     the PDB-guarded eviction API, backing off while budgets refuse (ref:
@@ -637,6 +739,23 @@ def main(argv=None) -> int:
     lo.add_argument("name")
     lo.add_argument("--container", "-c", default="")
     lo.set_defaults(fn=cmd_logs)
+
+    ex = sub.add_parser("exec")
+    ex.add_argument("name")
+    ex.add_argument("--container", "-c", default="")
+    ex.add_argument("command", nargs=argparse.REMAINDER)
+    ex.set_defaults(fn=cmd_exec)
+
+    at = sub.add_parser("attach")
+    at.add_argument("name")
+    at.add_argument("--container", "-c", default="")
+    at.set_defaults(fn=cmd_attach)
+
+    cp = sub.add_parser("cp")
+    cp.add_argument("src")
+    cp.add_argument("dst")
+    cp.add_argument("--container", "-c", default="")
+    cp.set_defaults(fn=cmd_cp)
 
     dr = sub.add_parser("drain")
     dr.add_argument("name")

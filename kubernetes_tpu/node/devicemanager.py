@@ -293,6 +293,13 @@ class DeviceManager:
             for rname, q in reqs.items():
                 if rname in self._devices:
                     needs[rname] = needs.get(rname, 0) + int(q.value())
+                elif "/" in rname:
+                    # an extended resource with NO registered plugin must
+                    # fail admission, not start chip-less (ref: the
+                    # devicemanager's UnexpectedAdmissionError for
+                    # unknown resources)
+                    raise InsufficientDevices(
+                        f"{rname}: no device plugin registered")
         if not needs:
             return {}
         uid = pod.metadata.uid

@@ -41,6 +41,8 @@ class PodSandbox:
     name: str
     state: str = "ready"        # ready | notready
     containers: Dict[str, ContainerStatusInfo] = field(default_factory=dict)
+    #: synthetic per-sandbox filesystem (exec cat/tee, kubectl cp)
+    files: Dict[str, bytes] = field(default_factory=dict)
 
 
 class ContainerRuntime:
@@ -60,6 +62,17 @@ class ContainerRuntime:
         raise NotImplementedError  # pragma: no cover
 
     def list_sandboxes(self) -> List[PodSandbox]:  # pragma: no cover
+        raise NotImplementedError
+
+    def exec_in_container(self, pod_uid: str, container: str,
+                          command: List[str], stdin: bytes = b""
+                          ) -> "tuple[int, bytes]":  # pragma: no cover
+        """(exit_code, combined output) — the CRI Exec rpc analog."""
+        raise NotImplementedError
+
+    def attach(self, pod_uid: str,
+               container: str) -> bytes:  # pragma: no cover
+        """Current output stream of a running container (Attach rpc)."""
         raise NotImplementedError
 
 
@@ -140,3 +153,54 @@ class FakeRuntime(ContainerRuntime):
     def list_sandboxes(self) -> List[PodSandbox]:
         with self._lock:
             return list(self._sandboxes.values())
+
+    def exec_in_container(self, pod_uid: str, container: str,
+                          command: List[str], stdin: bytes = b""
+                          ) -> "tuple[int, bytes]":
+        """A tiny deterministic shell over the sandbox's synthetic files —
+        enough surface for kubectl exec/cp e2e (echo/hostname/env/cat/tee,
+        true/false for exit codes)."""
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+        if sb is None:
+            return 128, b"sandbox not found\n"
+        cs = sb.containers.get(container)
+        if cs is None or cs.state != "running":
+            return 126, f"container {container} is not running\n".encode()
+        if not command:
+            return 126, b"no command\n"
+        prog, args = command[0], command[1:]
+        if prog == "echo":
+            return 0, (" ".join(args) + "\n").encode()
+        if prog == "hostname":
+            return 0, (sb.name + "\n").encode()
+        if prog == "true":
+            return 0, b""
+        if prog == "false":
+            return 1, b""
+        if prog == "cat":
+            if not args:
+                return 0, stdin
+            with self._lock:
+                data = sb.files.get(args[0])
+            if data is None:
+                return 1, f"cat: {args[0]}: No such file\n".encode()
+            return 0, data
+        if prog == "tee":
+            if not args:
+                return 0, stdin
+            with self._lock:
+                sb.files[args[0]] = stdin
+            return 0, stdin
+        return 127, f"{prog}: command not found\n".encode()
+
+    def attach(self, pod_uid: str, container: str) -> bytes:
+        """The synthetic output stream: the container's status line (what
+        containerLogs serves) — attach and logs read the same account."""
+        with self._lock:
+            sb = self._sandboxes.get(pod_uid)
+        cs = sb.containers.get(container) if sb is not None else None
+        if cs is None:
+            return b""
+        return (f"{container} state={cs.state} restarts={cs.restarts} "
+                f"started_at={cs.started_at}\n").encode()

@@ -1,9 +1,10 @@
-"""Kubelet HTTP server — the node's introspection endpoint.
+"""Kubelet HTTP server — the node's introspection + streaming endpoint.
 
 Ref: pkg/kubelet/server/server.go (4,553 LoC): /pods, /healthz,
-/containerLogs/{ns}/{pod}/{container}, /metrics. Exec/attach/portforward
-need a real runtime and are out of scope for the hollow dataplane; logs
-come from the FakeRuntime's synthetic account of each container.
+/containerLogs/{ns}/{pod}/{container}, /metrics, and the streaming
+routes getExec/getAttach (server.go; the reference speaks SPDY/WebSocket
+via the CRI streaming server — here exec is one POST round trip against
+the runtime's Exec rpc analog, attach a GET of the current stream).
 """
 
 from __future__ import annotations
@@ -29,6 +30,9 @@ class KubeletServer:
 
             def do_GET(self):
                 outer._get(self)
+
+            def do_POST(self):
+                outer._post(self)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -114,6 +118,17 @@ class KubeletServer:
             ]
             self._raw(h, 200, ("\n".join(lines) + "\n").encode(),
                       "text/plain")
+        elif len(parts) == 4 and parts[0] == "attach":
+            # GET /attach/{ns}/{pod}/{container} — the current output
+            # stream (ref: server.go getAttach)
+            _, ns, pod_name, cname = parts
+            pod = self.agent.pod_informer.indexer.get_by_key(
+                f"{ns}/{pod_name}")
+            if pod is None:
+                self._raw(h, 404, b"pod not found", "text/plain")
+                return
+            out = self.agent.runtime.attach(pod.metadata.uid, cname)
+            self._raw(h, 200, out, "text/plain")
         elif len(parts) == 4 and parts[0] == "containerLogs":
             _, ns, pod_name, cname = parts
             pod = self.agent.pod_informer.indexer.get_by_key(
@@ -129,6 +144,36 @@ class KubeletServer:
             self._raw(h, 200, log.encode(), "text/plain")
         else:
             self._raw(h, 404, b"not found", "text/plain")
+
+    def _post(self, h) -> None:
+        """POST /exec/{ns}/{pod}/{container} (ref: server.go getExec):
+        body {"command": [...], "stdin": <b64>} -> {"exitCode", "output"
+        (b64)} — one round trip against the runtime's Exec rpc analog."""
+        import base64
+        path = h.path.split("?")[0]
+        parts = [p for p in path.split("/") if p]
+        if len(parts) != 4 or parts[0] != "exec":
+            self._raw(h, 404, b"not found", "text/plain")
+            return
+        _, ns, pod_name, cname = parts
+        pod = self.agent.pod_informer.indexer.get_by_key(f"{ns}/{pod_name}")
+        if pod is None:
+            self._raw(h, 404, b"pod not found", "text/plain")
+            return
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            req = json.loads(h.rfile.read(n)) if n else {}
+            command = req.get("command", [])
+            stdin = base64.b64decode(req.get("stdin", ""))
+        except (ValueError, KeyError):
+            self._raw(h, 400, b"bad exec request", "text/plain")
+            return
+        code, output = self.agent.runtime.exec_in_container(
+            pod.metadata.uid, cname, command, stdin=stdin)
+        body = json.dumps({
+            "exitCode": code,
+            "output": base64.b64encode(output).decode()}).encode()
+        self._raw(h, 200, body, "application/json")
 
     def _raw(self, h, code: int, body: bytes, ctype: str) -> None:
         h.send_response(code)

@@ -360,3 +360,98 @@ class TestKubeadm:
             if node is not None:
                 node.stop()
             cp.stop()
+
+
+class TestUpgradeReset:
+    def test_upgrade_bumps_config_and_restarts_components(self, tmp_path):
+        """kubeadm upgrade: preflight the stored ClusterConfiguration,
+        re-render at the target version, restart controller-manager then
+        scheduler — and the cluster still schedules afterwards
+        (ref: cmd/kubeadm/app/cmd/upgrade.go apply flow)."""
+        import json as _json
+        from kubernetes_tpu.cmd.kubeadm import ControlPlane
+        cp = ControlPlane(str(tmp_path / "cp")).start()
+        try:
+            old_mgr, old_sched = cp.manager, cp.scheduler
+            with pytest.raises(ValueError):
+                cp.upgrade("v1.0.0")  # not newer: preflight refuses
+            plan = cp.upgrade("v1.1.0")
+            assert plan == {"from": "v1.0.0", "to": "v1.1.0",
+                            "restarted": ["kube-controller-manager",
+                                          "kube-scheduler"]}
+            cm = cp.admin_client.config_maps("kube-system").get(
+                "kubeadm-config")
+            cfg = _json.loads(cm.data["ClusterConfiguration"])
+            assert cfg["kubernetesVersion"] == "v1.1.0"
+            # components are fresh instances, and they are HEALTHY: a
+            # node + pod created post-upgrade gets scheduled
+            assert cp.manager is not old_mgr
+            assert cp.scheduler is not old_sched
+            alloc = {"cpu": api.Quantity("4"),
+                     "memory": api.Quantity("8Gi"),
+                     "pods": api.Quantity(110)}
+            cp.admin_client.nodes().create(api.Node(
+                metadata=api.ObjectMeta(name="un1"),
+                status=api.NodeStatus(
+                    capacity=dict(alloc), allocatable=dict(alloc),
+                    conditions=[api.NodeCondition(type="Ready",
+                                                  status="True")])))
+            cp.admin_client.pods("default").create(api.Pod(
+                metadata=api.ObjectMeta(name="up1", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img")])))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if cp.admin_client.pods("default").get(
+                        "up1").spec.node_name:
+                    break
+                time.sleep(0.1)
+            assert cp.admin_client.pods("default").get(
+                "up1").spec.node_name == "un1"
+        finally:
+            cp.stop()
+
+    def test_upgrade_cli_renders_config(self, tmp_path):
+        """The out-of-process `kubeadm upgrade plan/apply` reads and
+        CAS-updates the uploaded config through the API."""
+        import json as _json
+        from kubernetes_tpu.cmd import kubeadm
+        cp = kubeadm.ControlPlane(str(tmp_path / "cp")).start()
+        try:
+            creds = ["--server", cp.server.address,
+                     "--ca-file", cp.pki["ca_cert"],
+                     "--cert-file", cp.pki["admin_cert"],
+                     "--key-file", cp.pki["admin_key"]]
+            assert kubeadm.main(["upgrade", "plan"] + creds) == 0
+            assert kubeadm.main(
+                ["upgrade", "apply", "v1.2.0"] + creds) == 0
+            cm = cp.admin_client.config_maps("kube-system").get(
+                "kubeadm-config")
+            assert _json.loads(cm.data["ClusterConfiguration"])[
+                "kubernetesVersion"] == "v1.2.0"
+            # downgrade refused
+            assert kubeadm.main(
+                ["upgrade", "apply", "v1.0.5"] + creds) == 1
+        finally:
+            cp.stop()
+
+    def test_reset_leaves_clean_dir_for_reinit(self, tmp_path):
+        """kubeadm reset tears down pki/WAL/audit; a fresh init in the
+        same dir comes up healthy (ref: cmd/kubeadm/app/cmd/reset.go)."""
+        import os
+        from kubernetes_tpu.cmd.kubeadm import ControlPlane
+        data = str(tmp_path / "cp")
+        cp = ControlPlane(data).start()
+        cp.admin_client.config_maps("default").create(api.ConfigMap(
+            metadata=api.ObjectMeta(name="junk", namespace="default"),
+            data={"k": "v"}))
+        cp.reset()
+        assert os.listdir(data) == []
+        # a fresh init reuses the dir with a clean slate
+        cp2 = ControlPlane(data).start()
+        try:
+            from kubernetes_tpu.state.store import NotFoundError
+            with pytest.raises(NotFoundError):
+                cp2.admin_client.config_maps("default").get("junk")
+        finally:
+            cp2.stop()

@@ -150,3 +150,83 @@ def test_mesh_drain_sharded_arrays():
         cfg, usage = sched.algorithm.mirror.device_cfg_usage()
     arr = next(iter(usage.values()))
     assert len(arr.sharding.device_set) == 8
+
+
+def test_sharded_2d_matches_single_device():
+    """VERDICT r4 #6: a 2-D (pods x nodes) mesh — pod batch data-parallel
+    for filter_score, node state model-parallel throughout — must produce
+    the same mask/score matrix and the same assignments as 1 device."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import __graft_entry__
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from kubernetes_tpu.scheduler.kernels.batch import (filter_score,
+                                                        schedule_batch)
+
+    node_cfg, usage, pod_batch = __graft_entry__._example_state(P=32, N=256)
+    single_fits, single_score = filter_score(node_cfg, usage, pod_batch)
+    single_assign, _, _ = schedule_batch(node_cfg, usage, pod_batch)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("pods", "nodes"))
+
+    def shard(arr, spec):
+        return jax.device_put(jax.numpy.asarray(arr),
+                              NamedSharding(mesh, spec))
+
+    def node_sharded(d):
+        return {k: shard(v, P("nodes") if np.asarray(v).ndim == 1
+                         else P("nodes", None)) for k, v in d.items()}
+    cfg_s = node_sharded(node_cfg)
+    usage_s = node_sharded(usage)
+    fs_batch = {k: (shard(v, P(None, "nodes"))
+                    if k in ("unique_masks", "unique_scores")
+                    else shard(v, P("pods") if np.asarray(v).ndim == 1
+                               else P("pods", None)))
+                for k, v in pod_batch.items()}
+    sc_batch = {k: (shard(v, P(None, "nodes"))
+                    if k in ("unique_masks", "unique_scores")
+                    else shard(v, P()))
+                for k, v in pod_batch.items()}
+    with mesh:
+        fits2, score2 = filter_score(cfg_s, usage_s, fs_batch)
+        assign2, _, _ = schedule_batch(cfg_s, usage_s, sc_batch)
+    assert len(fits2.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(single_fits),
+                                  np.asarray(fits2))
+    np.testing.assert_array_equal(np.asarray(single_score),
+                                  np.asarray(score2))
+    np.testing.assert_array_equal(np.asarray(single_assign),
+                                  np.asarray(assign2))
+
+
+def test_full_drain_on_2d_mesh_matches_single_device():
+    """The PRODUCTION drain over the 2-D (pods x nodes) mesh binds every
+    pod to the same node as the single-device drain."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.state import Client
+
+    def run(mesh):
+        client, nodes, pods = _drain_fixture(Client)
+        sched = Scheduler(client, batch_size=32, mesh=mesh)
+        for n in nodes:
+            sched.cache.add_node(n)
+        for p in pods:
+            sched.queue.add(p)
+        sched.algorithm.refresh()
+        n = sched.drain_pipelined()
+        return n, {p.metadata.name: p.spec.node_name
+                   for p in client.pods().list()}
+
+    n_single, single = run(None)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("pods", "nodes"))
+    with mesh:
+        n_mesh, mesh_binds = run(mesh)
+    assert n_single == n_mesh > 0
+    assert single == mesh_binds

@@ -736,3 +736,121 @@ class TestKubeletProxy:
                 agent.stop()
             informers.stop()
             srv.stop()
+
+
+class TestExecStreaming:
+    def _cluster(self):
+        """Real apiserver + kubelet server + one bound Running pod."""
+        import time
+        from kubernetes_tpu import api
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.node.server import KubeletServer
+        from kubernetes_tpu.state import SharedInformerFactory
+        srv = APIServer().start()
+        client = HTTPClient(srv.address)
+        informers = SharedInformerFactory(client)
+        agent = NodeAgent(client, "xn1", informers, pleg_period=0.2)
+        informers.start()
+        informers.wait_for_cache_sync()
+        agent.start()
+        ks = KubeletServer(agent).start()
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="xp", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="app", image="img")]))
+        pod.spec.node_name = "xn1"
+        client.pods("default").create(pod)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if client.pods("default").get("xp").status.phase == "Running":
+                break
+            time.sleep(0.1)
+        return srv, client, informers, agent, ks
+
+    def test_kubectl_exec_runs_through_apiserver(self, capsys):
+        """kubectl exec POSTs pods/{name}/exec; the apiserver forwards
+        one exec round trip to the pod's kubelet, which drives the
+        runtime's Exec rpc analog (ref: ExecREST + getExec + cmd/exec)."""
+        from kubernetes_tpu.cmd import kubectl
+        srv, client, informers, agent, ks = self._cluster()
+        try:
+            rc = kubectl.main(["--master", srv.address, "exec", "xp",
+                               "--", "echo", "hello", "tpu"])
+            assert rc == 0
+            assert capsys.readouterr().out == "hello tpu\n"
+            # hostname reports the pod, exit codes flow through
+            rc = kubectl.main(["--master", srv.address, "exec", "xp",
+                               "--", "hostname"])
+            assert rc == 0
+            assert capsys.readouterr().out == "xp\n"
+            assert kubectl.main(["--master", srv.address, "exec", "xp",
+                                 "--", "false"]) == 1
+            assert kubectl.main(["--master", srv.address, "exec", "xp",
+                                 "--", "no-such-binary"]) == 127
+        finally:
+            ks.stop(); agent.stop(); informers.stop(); srv.stop()
+
+    def test_kubectl_cp_roundtrip(self, tmp_path, capsys):
+        """kubectl cp carries bytes over the exec transport both ways."""
+        from kubernetes_tpu.cmd import kubectl
+        srv, client, informers, agent, ks = self._cluster()
+        try:
+            src = tmp_path / "conf.txt"
+            src.write_bytes(b"replicas: 3\n")
+            rc = kubectl.main(["--master", srv.address, "cp",
+                               str(src), "xp:/etc/conf.txt"])
+            assert rc == 0
+            # the file is readable in-container...
+            rc = kubectl.main(["--master", srv.address, "exec", "xp",
+                               "--", "cat", "/etc/conf.txt"])
+            assert rc == 0
+            assert capsys.readouterr().out == "replicas: 3\n"
+            # ...and copies back out byte-identical
+            dst = tmp_path / "out.txt"
+            rc = kubectl.main(["--master", srv.address, "cp",
+                               "xp:/etc/conf.txt", str(dst)])
+            assert rc == 0
+            assert dst.read_bytes() == b"replicas: 3\n"
+            # a missing remote file propagates cat's exit code
+            assert kubectl.main(["--master", srv.address, "cp",
+                                 "xp:/nope", str(dst)]) == 1
+        finally:
+            ks.stop(); agent.stop(); informers.stop(); srv.stop()
+
+    def test_kubectl_attach_streams_container(self, capsys):
+        from kubernetes_tpu.cmd import kubectl
+        srv, client, informers, agent, ks = self._cluster()
+        try:
+            rc = kubectl.main(["--master", srv.address, "attach", "xp"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "app" in out and "state=running" in out
+        finally:
+            ks.stop(); agent.stop(); informers.stop(); srv.stop()
+
+
+class TestExecFlagOrder:
+    def test_container_flag_after_pod_name(self, capsys):
+        """`kubectl exec POD -c C -- cmd` (standard order): the -c after
+        the positional must reach container selection, not be executed."""
+        from kubernetes_tpu.cmd import kubectl
+        srv, client, informers, agent, ks = \
+            TestExecStreaming()._cluster()
+        try:
+            rc = kubectl.main(["--master", srv.address, "exec", "xp",
+                               "-c", "app", "--", "echo", "ordered"])
+            assert rc == 0
+            assert capsys.readouterr().out == "ordered\n"
+            # pending pod: clean error, not a traceback
+            from kubernetes_tpu import api
+            client.pods("default").create(api.Pod(
+                metadata=api.ObjectMeta(name="pend", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="i")])))
+            rc = kubectl.main(["--master", srv.address, "exec", "pend",
+                               "--", "true"])
+            assert rc == 1
+            assert "error:" in capsys.readouterr().err
+        finally:
+            ks.stop(); agent.stop(); informers.stop(); srv.stop()
