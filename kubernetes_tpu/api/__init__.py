@@ -33,6 +33,8 @@ from .admissionregistration import (MutatingWebhookConfiguration,
                                     RuleWithOperations,
                                     ValidatingWebhookConfiguration, Webhook,
                                     WebhookClientConfig)
+from .apiregistration import (APIService, APIServiceCondition,
+                              APIServiceSpec, APIServiceStatus)
 from .quantity import Quantity
 from .serde import decode, deepcopy_obj, encode, from_json_str, to_json_str
 from .validation import ValidationError, validate

@@ -1,0 +1,52 @@
+"""apiregistration.k8s.io/v1 — the aggregation layer's APIService.
+
+Ref: staging/src/k8s.io/kube-aggregator/pkg/apis/apiregistration (the
+APIService type) and pkg/apiserver/apiserver.go (the aggregator proxying
+/apis/{group}/{version} to the backing service). The second extension
+mechanism next to CRDs: a whole API group/version served by an EXTERNAL
+server, reached through the main apiserver's URL space.
+
+Reduced to the direct-URL form (like WebhookClientConfig): resolving an
+in-cluster Service reference needs a dataplane; `service_url` names the
+backing server explicitly. A nil/empty url marks a Local APIService (the
+reference's precedence rule for groups the main server itself serves).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .meta import ObjectMeta
+
+
+@dataclass
+class APIServiceCondition:
+    type: str = ""          # Available
+    status: str = ""        # True | False
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class APIServiceSpec:
+    group: str = ""
+    version: str = ""
+    #: direct URL of the backing server ("" = Local: served in-process)
+    service_url: str = ""
+    group_priority_minimum: int = 0
+    version_priority: int = 0
+
+
+@dataclass
+class APIServiceStatus:
+    conditions: List[APIServiceCondition] = field(default_factory=list)
+
+
+@dataclass
+class APIService:
+    api_version: str = "apiregistration.k8s.io/v1"
+    kind: str = "APIService"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: APIServiceSpec = field(default_factory=APIServiceSpec)
+    status: APIServiceStatus = field(default_factory=APIServiceStatus)

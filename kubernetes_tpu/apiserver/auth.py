@@ -46,6 +46,79 @@ class TokenAuthenticator:
         return self._tokens.get(token.strip())
 
 
+class WebhookTokenAuthenticator:
+    """Out-of-process token review (ref: apiserver/pkg/authentication/
+    token/webhook — the TokenReview POST the reference sends to a
+    configured authn webhook, with its success-result cache). The OIDC/
+    external-identity integration point: any issuer that can answer a
+    TokenReview plugs in here.
+
+        POST url  {"apiVersion": "authentication.k8s.io/v1",
+                   "kind": "TokenReview", "spec": {"token": ...}}
+        <-        {"status": {"authenticated": bool,
+                              "user": {"username", "groups": [...]}}}
+    """
+
+    def __init__(self, url: str, fallback=None, cache_ttl: float = 60.0,
+                 timeout: float = 5.0):
+        self.url = url
+        self.fallback = fallback
+        self.cache_ttl = cache_ttl
+        self.timeout = timeout
+        self._cache: Dict[str, tuple] = {}  # token -> (expires, UserInfo)
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        if not authorization_header:
+            return ANONYMOUS
+        scheme, _, token = authorization_header.partition(" ")
+        token = token.strip()
+        if scheme.lower() != "bearer" or not token:
+            return None
+        import time as _time
+        hit = self._cache.get(token)
+        if hit is not None and hit[0] > _time.monotonic():
+            return hit[1]
+        user = self._review(token)
+        if user is not None:
+            # only SUCCESSES cache (the reference's authenticated-token
+            # cache): a rejected token must re-consult the webhook, or a
+            # revocation/latency blip sticks for the TTL. Rotating-token
+            # clients mint a new string per request — sweep expired
+            # entries so the cache stays bounded
+            now = _time.monotonic()
+            if len(self._cache) >= 1024:
+                self._cache = {t: v for t, v in self._cache.items()
+                               if v[0] > now}
+            self._cache[token] = (now + self.cache_ttl, user)
+            return user
+        if self.fallback is not None:
+            return self.fallback.authenticate(authorization_header)
+        return None
+
+    def _review(self, token: str) -> Optional[UserInfo]:
+        import json as _json
+        from urllib import request as urlrequest
+        body = _json.dumps({
+            "apiVersion": "authentication.k8s.io/v1",
+            "kind": "TokenReview",
+            "spec": {"token": token}}).encode()
+        try:
+            req = urlrequest.Request(
+                self.url, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urlrequest.urlopen(req, timeout=self.timeout) as r:
+                status = (_json.loads(r.read()) or {}).get("status", {})
+        except Exception:
+            return None  # unreachable webhook = unverifiable = 401 path
+        if not status.get("authenticated"):
+            return None
+        u = status.get("user", {})
+        if not u.get("username"):
+            return None
+        return UserInfo(u["username"], tuple(u.get("groups", ())))
+
+
 @dataclass
 class PolicyRule:
     """Ref: rbac.PolicyRule — verbs x resources (+ optional namespace

@@ -321,7 +321,9 @@ class HTTPResourceClient:
         return self._decode(self._request(
             "DELETE", self._url(name, namespace=namespace, query=query)))
 
-    #: set by subclasses whose consumers can apply slim frames (pods)
+    #: slim-frame negotiation is an INFORMER opt-in (it materializes
+    #: deltas from its indexer); raw watch consumers iterate full
+    #: objects and must never receive SlimBindRef placeholders
     _SLIM_WATCH = False
 
     def watch(self, namespace: Optional[str] = None,
@@ -342,9 +344,6 @@ class HTTPResourceClient:
 
 
 class HTTPPodClient(HTTPResourceClient):
-    # pod watches negotiate slim bind frames: the SharedInformer's indexer
-    # always holds the previous revision to apply them against
-    _SLIM_WATCH = True
 
     def evict(self, name: str, namespace: Optional[str] = None):
         """POST the pods/eviction subresource (PDB-guarded delete). Raises

@@ -16,9 +16,14 @@ scale testing uses.
 """
 
 from .agent import NodeAgent
+from .cri import RemoteRuntime, RuntimeServer
+from .devicemanager import (DeviceManager, DevicePluginServer,
+                            TPUDevicePlugin)
 from .hollow import HollowCluster
 from .proxy import FakeDataplane, ProxyServer
 from .runtime import ContainerRuntime, FakeRuntime, PodSandbox
 
-__all__ = ["ContainerRuntime", "FakeDataplane", "FakeRuntime",
-           "HollowCluster", "NodeAgent", "PodSandbox", "ProxyServer"]
+__all__ = ["ContainerRuntime", "DeviceManager", "DevicePluginServer",
+           "FakeDataplane", "FakeRuntime", "HollowCluster", "NodeAgent",
+           "PodSandbox", "ProxyServer", "RemoteRuntime", "RuntimeServer",
+           "TPUDevicePlugin"]

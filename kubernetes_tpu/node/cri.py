@@ -1,0 +1,222 @@
+"""CRI remote runtime — the kubelet<->runtime RPC boundary.
+
+Ref: staging/src/k8s.io/cri-api/pkg/apis/runtime/v1alpha2/api.proto (the
+RuntimeService rpcs: RunPodSandbox, StopPodSandbox, ListPodSandbox,
+CreateContainer/StartContainer, Exec, Attach) consumed by
+pkg/kubelet/remote/remote_runtime.go over a unix socket.
+
+Re-shaped: the socket speaks length-prefixed JSON (no gRPC in this
+image) — the same wire discipline as the device-plugin boundary
+(node/devicemanager.py). `RuntimeServer` hosts ANY ContainerRuntime
+(FakeRuntime in tests, a real containerd shim in a deployment) behind
+the socket; `RemoteRuntime` is the kubelet-side client implementing the
+ContainerRuntime interface, so `NodeAgent(runtime=RemoteRuntime(path))`
+crosses a real process-style boundary on every sync."""
+
+from __future__ import annotations
+
+import base64
+import os
+import socket
+import threading
+from typing import List, Optional
+
+from ..api import serde
+from ..api.core import Pod
+from .devicemanager import _recv_msg, _send_msg
+from .runtime import ContainerRuntime, ContainerStatusInfo, PodSandbox
+
+
+def _sandbox_to_wire(sb: PodSandbox) -> dict:
+    return {
+        "pod_uid": sb.pod_uid, "namespace": sb.namespace, "name": sb.name,
+        "state": sb.state,
+        "containers": {n: {"name": c.name, "state": c.state,
+                           "exit_code": c.exit_code,
+                           "started_at": c.started_at,
+                           "finished_at": c.finished_at,
+                           "restarts": c.restarts}
+                       for n, c in sb.containers.items()},
+    }
+
+
+def _sandbox_from_wire(d: dict) -> PodSandbox:
+    sb = PodSandbox(pod_uid=d["pod_uid"], namespace=d["namespace"],
+                    name=d["name"], state=d["state"])
+    for n, c in d.get("containers", {}).items():
+        sb.containers[n] = ContainerStatusInfo(
+            name=c["name"], state=c["state"], exit_code=c["exit_code"],
+            started_at=c["started_at"], finished_at=c["finished_at"],
+            restarts=c["restarts"])
+    return sb
+
+
+class RuntimeServer:
+    """Runtime half: serves a ContainerRuntime on a unix socket (the
+    containerd-shim position)."""
+
+    def __init__(self, runtime: ContainerRuntime, socket_path: str):
+        self.runtime = runtime
+        self.socket_path = socket_path
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+
+    def start(self) -> "RuntimeServer":
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(16)
+        self._sock.settimeout(0.5)
+        threading.Thread(target=self._serve, daemon=True,
+                         name="cri-runtime").start()
+        return self
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_msg(conn)
+                _send_msg(conn, self._call(req))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _call(self, req: dict) -> dict:
+        rt = self.runtime
+        try:
+            op = req.get("op")
+            if op == "run_pod_sandbox":
+                pod = serde.decode(Pod, req["pod"])
+                sb = rt.run_pod_sandbox(pod)
+                return {"sandbox": _sandbox_to_wire(sb)}
+            if op == "start_containers":
+                pod = serde.decode(Pod, req["pod"])
+                sb = rt.pod_sandbox(pod.metadata.uid)
+                if sb is None:
+                    return {"error": "sandbox not found"}
+                rt.start_containers(sb, pod)
+                return {}
+            if op == "stop_pod_sandbox":
+                rt.stop_pod_sandbox(req["pod_uid"])
+                return {}
+            if op == "pod_sandbox":
+                sb = rt.pod_sandbox(req["pod_uid"])
+                return {"sandbox": _sandbox_to_wire(sb)
+                        if sb is not None else None}
+            if op == "list_sandboxes":
+                return {"sandboxes": [_sandbox_to_wire(s)
+                                      for s in rt.list_sandboxes()]}
+            if op == "exec":
+                code, out = rt.exec_in_container(
+                    req["pod_uid"], req["container"], req["command"],
+                    stdin=base64.b64decode(req.get("stdin", "")))
+                return {"exit_code": code,
+                        "output": base64.b64encode(out).decode()}
+            if op == "attach":
+                out = rt.attach(req["pod_uid"], req["container"])
+                return {"output": base64.b64encode(out).decode()}
+            return {"error": f"unknown op {op}"}
+        except Exception as e:  # rpc errors cross the wire, not the stack
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+
+
+class RemoteRuntimeError(RuntimeError):
+    """The runtime answered an rpc with an error."""
+
+
+class RemoteRuntime(ContainerRuntime):
+    """Kubelet half (ref: remote_runtime.go): the ContainerRuntime
+    interface implemented as one rpc per call over the socket."""
+
+    RPC_TIMEOUT_S = 10.0
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(self.RPC_TIMEOUT_S)
+        self._sock.connect(socket_path)
+        self._lock = threading.Lock()
+
+    def _rpc(self, req: dict) -> dict:
+        with self._lock:
+            try:
+                _send_msg(self._sock, req)
+                resp = _recv_msg(self._sock)
+            except (socket.timeout, OSError):
+                # the stream is now desynchronized (a late response would
+                # be read as the NEXT rpc's answer): drop the connection
+                # and redial so every future call starts clean
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+                self._sock.settimeout(self.RPC_TIMEOUT_S)
+                try:
+                    self._sock.connect(self.socket_path)
+                except OSError:
+                    pass  # runtime gone: the raise below reports it
+                raise
+        if resp.get("error"):
+            raise RemoteRuntimeError(resp["error"])
+        return resp
+
+    def run_pod_sandbox(self, pod: Pod) -> PodSandbox:
+        resp = self._rpc({"op": "run_pod_sandbox",
+                          "pod": serde.encode(pod)})
+        return _sandbox_from_wire(resp["sandbox"])
+
+    def start_containers(self, sandbox: PodSandbox, pod: Pod) -> None:
+        self._rpc({"op": "start_containers", "pod": serde.encode(pod)})
+
+    def stop_pod_sandbox(self, pod_uid: str) -> None:
+        self._rpc({"op": "stop_pod_sandbox", "pod_uid": pod_uid})
+
+    def pod_sandbox(self, pod_uid: str) -> Optional[PodSandbox]:
+        resp = self._rpc({"op": "pod_sandbox", "pod_uid": pod_uid})
+        d = resp.get("sandbox")
+        return _sandbox_from_wire(d) if d is not None else None
+
+    def list_sandboxes(self) -> List[PodSandbox]:
+        resp = self._rpc({"op": "list_sandboxes"})
+        return [_sandbox_from_wire(d) for d in resp["sandboxes"]]
+
+    def exec_in_container(self, pod_uid: str, container: str,
+                          command: List[str], stdin: bytes = b""
+                          ) -> "tuple[int, bytes]":
+        resp = self._rpc({"op": "exec", "pod_uid": pod_uid,
+                          "container": container, "command": list(command),
+                          "stdin": base64.b64encode(stdin).decode()})
+        return resp["exit_code"], base64.b64decode(resp["output"])
+
+    def attach(self, pod_uid: str, container: str) -> bytes:
+        resp = self._rpc({"op": "attach", "pod_uid": pod_uid,
+                          "container": container})
+        return base64.b64decode(resp["output"])
+
+    def close(self) -> None:
+        self._sock.close()

@@ -155,6 +155,9 @@ def default_scheme() -> Scheme:
                "admissionregistration.k8s.io/v1",
                "ValidatingWebhookConfiguration",
                "validatingwebhookconfigurations", namespaced=False)
+    from ..api.apiregistration import APIService
+    s.register(APIService, "apiregistration.k8s.io/v1", "APIService",
+               "apiservices", namespaced=False)
     return s
 
 

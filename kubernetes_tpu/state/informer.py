@@ -194,6 +194,11 @@ class SharedInformer:
             for h in handlers:
                 self._dispatch(h.on_delete, prev)
         self._synced.set()
+        # negotiate slim bind frames on transports that support them: the
+        # informer (unlike raw watch consumers) holds every object's
+        # previous revision and can apply the delta
+        if getattr(type(self._rc), "_SLIM_WATCH", None) is False:
+            self._rc._SLIM_WATCH = True
         watch = self._rc.watch(resource_version=rv)
         with self._lock:
             self._watch = watch

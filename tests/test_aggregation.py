@@ -1,0 +1,183 @@
+"""Aggregation layer: APIService routing /apis/{g}/{v} to an external
+server through the main apiserver.
+
+Ref: staging/src/k8s.io/kube-aggregator/pkg/apiserver (proxyHandler) —
+the metrics-server pattern: a whole group/version served out-of-process,
+reached through the primary API surface.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+
+
+class _ExtensionServer:
+    """A tiny aggregated API server (the metrics-server stand-in)."""
+
+    def __init__(self):
+        received = self.received = []
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self, method):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n) if n else b""
+                received.append((method, self.path, body))
+                if "unknown" in self.path:
+                    out = json.dumps({"kind": "Status",
+                                      "status": "Failure"}).encode()
+                    self.send_response(404)
+                else:
+                    out = json.dumps({
+                        "kind": "NodeMetricsList",
+                        "apiVersion": "metrics.example.com/v1beta1",
+                        "items": [{"name": "n1", "cpu": "250m"}]}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+def apiservice(url, group="metrics.example.com", version="v1beta1"):
+    return api.APIService(
+        metadata=api.ObjectMeta(name=f"{version}.{group}"),
+        spec=api.APIServiceSpec(group=group, version=version,
+                                service_url=url))
+
+
+class TestAggregation:
+    def test_routes_claimed_group_to_backing_server(self, server):
+        import urllib.request
+        ext = _ExtensionServer()
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.APIService).create(apiservice(ext.url))
+            url = (f"{server.address}/apis/metrics.example.com/v1beta1/"
+                   f"nodemetrics")
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = json.loads(r.read())
+            assert body["kind"] == "NodeMetricsList"
+            assert body["items"][0]["cpu"] == "250m"
+            # the extension server saw the original path
+            assert ext.received[-1][1] == \
+                "/apis/metrics.example.com/v1beta1/nodemetrics"
+        finally:
+            ext.stop()
+
+    def test_post_bodies_forwarded(self, server):
+        import urllib.request
+        ext = _ExtensionServer()
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.APIService).create(apiservice(ext.url))
+            req = urllib.request.Request(
+                f"{server.address}/apis/metrics.example.com/v1beta1/"
+                f"nodemetrics",
+                data=b'{"probe": true}', method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            method, path, body = ext.received[-1]
+            assert method == "POST"
+            assert json.loads(body) == {"probe": True}
+        finally:
+            ext.stop()
+
+    def test_unclaimed_group_is_404(self, server):
+        import urllib.error
+        import urllib.request
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{server.address}/apis/ghost.example.com/v1/things",
+                timeout=10)
+        assert e.value.code == 404
+
+    def test_upstream_errors_relayed(self, server):
+        import urllib.error
+        import urllib.request
+        ext = _ExtensionServer()
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.APIService).create(apiservice(ext.url))
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{server.address}/apis/metrics.example.com/v1beta1/"
+                    f"unknownthings", timeout=10)
+            assert e.value.code == 404
+        finally:
+            ext.stop()
+
+    def test_deleting_apiservice_unroutes(self, server):
+        import urllib.error
+        import urllib.request
+        ext = _ExtensionServer()
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.APIService).create(apiservice(ext.url))
+            url = (f"{server.address}/apis/metrics.example.com/v1beta1/"
+                   f"nodemetrics")
+            urllib.request.urlopen(url, timeout=10).close()
+            client.resource(api.APIService).delete(
+                "v1beta1.metrics.example.com")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(url, timeout=10)
+            assert e.value.code == 404
+        finally:
+            ext.stop()
+
+    def test_dead_backend_is_503(self, server):
+        import urllib.error
+        import urllib.request
+        client = HTTPClient(server.address)
+        client.resource(api.APIService).create(
+            apiservice("http://127.0.0.1:9"))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{server.address}/apis/metrics.example.com/v1beta1/x",
+                timeout=10)
+        assert e.value.code == 503
+
+    def test_local_groups_take_precedence(self, server):
+        """An APIService claiming a locally-served group/version must not
+        shadow the built-in types (the reference's Local precedence)."""
+        ext = _ExtensionServer()
+        try:
+            client = HTTPClient(server.address)
+            client.resource(api.APIService).create(
+                apiservice(ext.url, group="apps", version="v1"))
+            # the built-in apps/v1 deployments keep serving locally
+            assert client.resource(api.Deployment, "default").list() == []
+            assert not ext.received  # never proxied
+        finally:
+            ext.stop()
