@@ -960,14 +960,22 @@ class APIServer:
         ns = ""
         if len(rest) >= 2 and rest[0] == "namespaces":
             ns, rest = rest[1], rest[2:]
+        if "watch=true" in rawquery or "watch=1" in rawquery:
+            # the buffering relay below cannot stream; refuse up front
+            # instead of hanging the client for the full timeout
+            self._error(h, 501, "NotImplemented",
+                        "watch is not supported through the "
+                        "aggregation proxy")
+            return True
         agg_req = _Request(rest[0] if rest else group, ns,
                            rest[1] if len(rest) > 1 else "",
                            "", {}, tail=())
         ok, agg_user = self._authorized(h, method, agg_req)
+        # aggregated traffic audits like local traffic — including the
+        # denied/probing requests the audit trail exists to catch
+        h._audit_ctx = (method, agg_req, agg_user)
         if not ok:
             return True  # 401/403 already written
-        # aggregated traffic audits like local traffic
-        h._audit_ctx = (method, agg_req, agg_user)
         from urllib import error as urlerror
         from urllib import request as urlrequest
         target = base.rstrip("/") + path

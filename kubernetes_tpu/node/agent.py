@@ -19,13 +19,16 @@ import threading
 import traceback
 from typing import Dict, Optional
 
-from ..api import helpers
+import json as _json
+
+from ..api import helpers, serde
+from ..api.patch import diff_merge_patch
 from ..api.core import (ContainerStatus, Node, NodeCondition, Pod,
                         PodCondition)
 from ..api.meta import ObjectMeta
 from ..api.quantity import Quantity
 from ..state.informer import EventHandlers, SharedInformerFactory
-from ..state.store import NotFoundError
+from ..state.store import ConflictError, NotFoundError
 from ..state.workqueue import RateLimitingQueue
 from ..utils.clock import now_iso
 from .runtime import ContainerRuntime, FakeRuntime
@@ -414,11 +417,6 @@ class NodeAgent:
         # path). The rv precondition catches informer staleness and falls
         # back to read-modify-write, which preserves the terminal-phase
         # guard exactly
-        import json as _json
-
-        from ..api import serde
-        from ..api.patch import diff_merge_patch
-        from ..state.store import ConflictError
         try:
             before = _json.loads(serde.to_json_str(pod))
             updated = mutate(serde.deepcopy_obj(pod))

@@ -1212,16 +1212,51 @@ class BatchScheduler:
                         return True
                 return False
 
+            from .nodeinfo import pod_resource
+            need = pod_resource(pod)
+
             def proxy(item):
+                """Greedy estimate of the MINIMAL victim set (lowest
+                priority first until the preemptor's resources fit) and
+                pick_one_node's criteria over THAT set — ranking by all
+                lower-priority pods instead over-penalizes nodes whose
+                minimal set is tiny (a divergence the proxy-equivalence
+                fixture exposed)."""
                 _, ni = item
-                lower = [p for p in ni.pods
-                         if helpers.pod_priority(p) < prio]
-                has_pdb = any(touches_pdb(p) for p in lower) if pdbs \
+                lower = sorted(
+                    (p for p in ni.pods
+                     if helpers.pod_priority(p) < prio),
+                    key=helpers.pod_priority)
+                free_cpu = ni.allocatable.milli_cpu \
+                    - ni.requested.milli_cpu
+                free_mem = ni.allocatable.memory - ni.requested.memory
+                # extended scalars too (google.com/tpu): a TPU-bound
+                # preemptor on cpu-rich nodes would otherwise estimate
+                # empty victim sets everywhere and rank arbitrarily
+                free_sc = {k: ni.allocatable.scalar_resources.get(k, 0)
+                           - ni.requested.scalar_resources.get(k, 0)
+                           for k in need.scalar_resources}
+
+                def fits_now():
+                    return (free_cpu >= need.milli_cpu
+                            and free_mem >= need.memory
+                            and all(free_sc[k] >= v for k, v in
+                                    need.scalar_resources.items()))
+                victims = []
+                for p in lower:
+                    if fits_now():
+                        break
+                    r = pod_resource(p)
+                    free_cpu += r.milli_cpu
+                    free_mem += r.memory
+                    for k in free_sc:
+                        free_sc[k] += r.scalar_resources.get(k, 0)
+                    victims.append(p)
+                has_pdb = any(touches_pdb(p) for p in victims) if pdbs \
                     else False
-                return (has_pdb,
-                        max((helpers.pod_priority(p) for p in lower),
-                            default=0),
-                        len(lower))
+                prios = [helpers.pod_priority(p) for p in victims]
+                return (has_pdb, max(prios, default=0),
+                        sum(prios), len(victims))
             candidates.sort(key=proxy)
             candidates = candidates[:self.PREEMPT_CANDIDATE_CAP]
         victims_map: Dict[str, Tuple[List[Pod], int]] = {}
