@@ -10,7 +10,7 @@ from .core import (Affinity, Binding, ConfigMap, Container, ContainerImage,
                    Endpoints, Event, Namespace, Node, NodeAffinity,
                    NodeCondition, NodeSelector, NodeSelectorRequirement,
                    NodeSelectorTerm, NodeSpec, NodeStatus, ObjectReference,
-                   PersistentVolume, PersistentVolumeClaim,
+                   AttachedVolume, PersistentVolume, PersistentVolumeClaim,
                    PersistentVolumeClaimSpec, PersistentVolumeClaimVolumeSource,
                    PersistentVolumeSpec, Pod, PodAffinity, Probe,
                    PodAffinityTerm, PodAntiAffinity, PodCondition, PodSpec,

@@ -271,6 +271,99 @@ def cmd_apply(args) -> int:
     return 0
 
 
+def cmd_diff(args) -> int:
+    """kubectl diff: unified diff of each manifest's POST-APPLY state
+    against the live object — the same 3-way merge `kubectl apply` would
+    perform, so diff-clean exactly when apply would print "unchanged"
+    (ref: k8s.io/kubectl/pkg/cmd/diff; exit 0 clean, 1 differences)."""
+    import difflib
+
+    from ..api.patch import (LAST_APPLIED, json_merge_patch,
+                             three_way_merge_patch)
+    from ..state.store import NotFoundError
+    client = _client(args)
+    changed = False
+    for raw in _load_manifest_dicts(args.filename):
+        obj = _decode_with_discovery(raw, client)
+        ns = obj.metadata.namespace or args.namespace
+        kind = SCHEME.resource_for(obj)
+        name = obj.metadata.name
+        rc = client.resource(type(obj), ns)
+        try:
+            live = rc.get(name, namespace=ns)
+        except NotFoundError:
+            live = None
+        if live is None:
+            live_doc = {}
+            merged = serde.encode(obj)
+        else:
+            # the exact merge cmd_apply performs: fields WE own (the
+            # last-applied config) update/delete; foreign fields stay
+            live_doc = serde.encode(live)
+            original = json.loads(
+                live.metadata.annotations.get(LAST_APPLIED, "") or "{}")
+            patch = three_way_merge_patch(original, raw, live_doc)
+            patch.pop("status", None)
+            md = patch.setdefault("metadata", {})
+            md.pop("resourceVersion", None)
+            md.setdefault("annotations", {})[LAST_APPLIED] = \
+                json.dumps(raw, sort_keys=True)
+            merged = json_merge_patch(live_doc, patch)
+        a = json.dumps(live_doc, indent=2, sort_keys=True).splitlines()
+        b = json.dumps(merged, indent=2, sort_keys=True).splitlines()
+        delta = list(difflib.unified_diff(
+            a, b, fromfile=f"live/{kind}/{name}",
+            tofile=f"merged/{kind}/{name}", lineterm=""))
+        if delta:
+            changed = True
+            print("\n".join(delta))
+    return 1 if changed else 0
+
+
+def cmd_edit(args) -> int:
+    """kubectl edit: dump the live object to a temp file, run $EDITOR,
+    PUT the edited version back under CAS (ref: kubectl/pkg/cmd/edit)."""
+    import os
+    import subprocess
+    import tempfile
+    client = _client(args)
+    resource, cls = _resolve(args.resource, client)
+    rc = client.resource(cls, args.namespace)
+    live = rc.get(args.name, namespace=args.namespace)
+    doc = serde.encode(live)
+    import shlex
+    editor = shlex.split(os.environ.get("EDITOR", "vi"))
+    with tempfile.NamedTemporaryFile("w+", suffix=".json",
+                                     delete=False) as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        path = f.name
+    # the temp file is only removed on the SUCCESS and no-change paths:
+    # a parse error or CAS conflict must not destroy the user's edits
+    # (the reference preserves the file and names it)
+    try:
+        if subprocess.call(editor + [path]) != 0:
+            print(f"error: editor failed; edits preserved at {path}",
+                  file=sys.stderr)
+            return 1
+        with open(path) as f:
+            edited = json.load(f)
+        if edited == doc:
+            print("Edit cancelled, no changes made.")
+            os.unlink(path)
+            return 0
+        obj = SCHEME.decode_any(edited)
+        # CAS: the rv captured at read time rides the PUT, so an edit
+        # raced by another writer 409s instead of clobbering
+        obj.metadata.resource_version = live.metadata.resource_version
+        rc.update(obj)
+    except Exception as e:
+        print(f"error: {e}; edits preserved at {path}", file=sys.stderr)
+        return 1
+    os.unlink(path)
+    print(f"{resource}/{args.name} edited")
+    return 0
+
+
 def cmd_delete(args) -> int:
     resource, cls = _resolve(args.resource, _client(args))
     _client(args).resource(cls, args.namespace).delete(
@@ -706,10 +799,16 @@ def main(argv=None) -> int:
     d.add_argument("name")
     d.set_defaults(fn=cmd_describe)
 
-    for verb, fn in (("create", cmd_create), ("apply", cmd_apply)):
+    for verb, fn in (("create", cmd_create), ("apply", cmd_apply),
+                     ("diff", cmd_diff)):
         c = sub.add_parser(verb)
         c.add_argument("--filename", "-f", required=True)
         c.set_defaults(fn=fn)
+
+    ed = sub.add_parser("edit")
+    ed.add_argument("resource")
+    ed.add_argument("name")
+    ed.set_defaults(fn=cmd_edit)
 
     x = sub.add_parser("delete")
     x.add_argument("resource")

@@ -22,8 +22,9 @@ from .devicemanager import (DeviceManager, DevicePluginServer,
 from .hollow import HollowCluster
 from .proxy import FakeDataplane, ProxyServer
 from .runtime import ContainerRuntime, FakeRuntime, PodSandbox
+from .volumemanager import VolumeManager
 
 __all__ = ["ContainerRuntime", "DeviceManager", "DevicePluginServer",
            "FakeDataplane", "FakeRuntime", "HollowCluster", "NodeAgent",
            "PodSandbox", "ProxyServer", "RemoteRuntime", "RuntimeServer",
-           "TPUDevicePlugin"]
+           "TPUDevicePlugin", "VolumeManager"]

@@ -46,7 +46,7 @@ class NodeAgent:
                  heartbeat_period: float = 10.0,
                  pleg_period: float = 1.0, eviction=None,
                  static_pod_dir=None, serve_port=None,
-                 device_manager=None):
+                 device_manager=None, volume_manager=None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity or DEFAULT_CAPACITY)
@@ -92,6 +92,10 @@ class NodeAgent:
         #: allocates device IDs at sandbox creation, checkpoints
         #: (ref: kubelet cm/devicemanager wiring in container manager)
         self.device_manager = device_manager
+        #: mount gating (ref: kubelet volumemanager WaitForAttachAndMount)
+        #: — PVC-backed pods wait for the attach-detach controller's
+        #: attachment before containers start
+        self.volume_manager = volume_manager
 
     def _on_pod_event(self, pod: Pod) -> None:
         if pod.spec.node_name == self.node_name:
@@ -261,6 +265,8 @@ class NodeAgent:
                 self._reported.pop(uid, None)
                 if self.device_manager is not None:
                     self.device_manager.free(uid)
+                if self.volume_manager is not None:
+                    self.volume_manager.teardown(uid)
             return
         if helpers.pod_is_terminal(pod):
             self.runtime.stop_pod_sandbox(pod.metadata.uid)
@@ -268,6 +274,8 @@ class NodeAgent:
             self._reported.pop(pod.metadata.uid, None)
             if self.device_manager is not None:
                 self.device_manager.free(pod.metadata.uid)
+            if self.volume_manager is not None:
+                self.volume_manager.teardown(pod.metadata.uid)
             return
         sb = self.runtime.pod_sandbox(pod.metadata.uid)
         if sb is None:
@@ -293,6 +301,17 @@ class NodeAgent:
                                        reason="UnexpectedAdmissionError")
                     raise RuntimeError(
                         f"pod {key} device allocation failed: {e}")
+            if self.volume_manager is not None:
+                # WaitForAttachAndMount: PVC-backed volumes gate on the
+                # attach-detach controller's actuation; a not-yet-attached
+                # volume requeues the sync (pod shows ContainerCreating)
+                from .volumemanager import VolumeNotAttached
+                try:
+                    self.volume_manager.wait_for_attach_and_mount(pod)
+                except VolumeNotAttached as e:
+                    self._write_status(pod, "Pending", ready=False,
+                                       reason="ContainerCreating")
+                    raise RuntimeError(str(e))
             sb = self.runtime.run_pod_sandbox(pod)
             self.runtime.start_containers(sb, pod)
         # status write runs on EVERY sync, not only sandbox creation — the

@@ -1,0 +1,103 @@
+"""Kubelet volume manager — mount gating for pod volumes.
+
+Ref: pkg/kubelet/volumemanager/volume_manager.go (WaitForAttachAndMount
+blocking SyncPod until every pod volume is attached+mounted) with its
+desired/actual state worlds (pkg/kubelet/volumemanager/cache) and
+reconciler collapsed into a synchronous mount step: the hollow dataplane
+has no real mount syscalls, so recording the mount IS the actuation —
+the GATING semantics (a PVC-backed pod must not start before the
+attach-detach controller attaches its PV to this node) are real.
+
+The attach signal is the API state the reference's reconciler also
+consumes: node.status.volumesAttached, written by the attachdetach
+controller (controllers/misc.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..api.core import Pod
+from ..state.store import NotFoundError
+
+
+class VolumeNotAttached(Exception):
+    """A PVC-backed volume's PV is not (yet) attached to this node —
+    the sync retries (the pod reports ContainerCreating meanwhile)."""
+
+
+class VolumeManager:
+    def __init__(self, client, node_name: str,
+                 attach_timeout: float = 0.0,
+                 poll_interval: float = 0.0):
+        # attach_timeout/poll_interval kept for call-site compatibility;
+        # the check is a SINGLE pass — retries ride the sync workqueue's
+        # rate-limited requeue (polling here would head-of-line block the
+        # node's one sync worker for every other pod)
+        self.client = client
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        #: pod_uid -> {volume name: mount device/path} (actual state)
+        self._mounts: Dict[str, Dict[str, str]] = {}
+
+    # ------------------------------------------------------------ queries
+
+    def mounted_volumes(self, pod_uid: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._mounts.get(pod_uid, {}))
+
+    # ------------------------------------------------------------- mount
+
+    def _pv_name_of(self, pod: Pod, claim_name: str) -> Optional[str]:
+        try:
+            pvc = self.client.persistent_volume_claims(
+                pod.metadata.namespace).get(claim_name)
+        except NotFoundError:
+            return None
+        return pvc.spec.volume_name or None
+
+    def _attached_names(self) -> List[str]:
+        try:
+            node = self.client.nodes().get(self.node_name)
+        except NotFoundError:
+            return []
+        return [av.name for av in node.status.volumes_attached]
+
+    def wait_for_attach_and_mount(self, pod: Pod) -> None:
+        """One-pass attach+mount check (ref: WaitForAttachAndMount,
+        kubelet.go calling it before containers start — but NON-blocking
+        here: the reference blocks a per-pod goroutine; this runtime has
+        ONE sync worker per node, so a not-ready volume raises and the
+        workqueue's rate-limited requeue is the wait). Local sources
+        (emptyDir/hostPath/configMap/secret) mount immediately;
+        PVC-backed volumes gate on the PV appearing in this node's
+        status.volumesAttached."""
+        wanted: Dict[str, str] = {}
+        pvc_backed = [(v.name, v.persistent_volume_claim.claim_name)
+                      for v in pod.spec.volumes
+                      if v.persistent_volume_claim is not None]
+        for v in pod.spec.volumes:
+            if v.persistent_volume_claim is None:
+                wanted[v.name] = f"local/{pod.metadata.uid}/{v.name}"
+        if pvc_backed:
+            attached = set(self._attached_names())
+            pending = []
+            for vname, claim in pvc_backed:
+                pv = self._pv_name_of(pod, claim)
+                if pv is not None and pv in attached:
+                    wanted[vname] = f"/dev/disk/{pv}"
+                else:
+                    pending.append(vname)
+            if pending:
+                raise VolumeNotAttached(
+                    f"pod {pod.metadata.name}: volumes {sorted(pending)} "
+                    f"not attached to {self.node_name}")
+        with self._lock:
+            self._mounts[pod.metadata.uid] = wanted
+
+    def teardown(self, pod_uid: str) -> None:
+        """Unmount everything the pod held (ref: the reconciler's
+        unmount path on pod removal)."""
+        with self._lock:
+            self._mounts.pop(pod_uid, None)

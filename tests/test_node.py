@@ -854,3 +854,70 @@ class TestExecFlagOrder:
             assert "error:" in capsys.readouterr().err
         finally:
             ks.stop(); agent.stop(); informers.stop(); srv.stop()
+
+
+class TestKubectlDiffEdit:
+    @pytest.fixture()
+    def cluster(self):
+        from kubernetes_tpu.apiserver import APIServer
+        srv = APIServer().start()
+        yield srv
+        srv.stop()
+
+    def _run(self, capsys, srv, *argv):
+        from kubernetes_tpu.cmd import kubectl
+        rc = kubectl.main(["--master", srv.address, *argv])
+        return rc, capsys.readouterr().out
+
+    def test_diff_reports_changes_then_clean(self, cluster, capsys,
+                                             tmp_path):
+        """kubectl diff: exit 1 + unified diff when the manifest differs
+        from live, exit 0 when clean (ref: kubectl/pkg/cmd/diff)."""
+        cm = {"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "conf", "namespace": "default"},
+              "data": {"replicas": "2"}}
+        f = tmp_path / "cm.json"
+        f.write_text(json.dumps(cm))
+        rc, _ = self._run(capsys, cluster, "apply", "-f", str(f))
+        assert rc == 0
+        # clean: diff simulates apply's 3-way merge, so an unchanged
+        # manifest diffs empty (exactly when apply would say unchanged)
+        rc, out = self._run(capsys, cluster, "diff", "-f", str(f))
+        assert rc == 0 and out == ""
+        # drifted manifest: non-zero with a readable diff
+        cm["data"]["replicas"] = "5"
+        f.write_text(json.dumps(cm))
+        rc, out = self._run(capsys, cluster, "diff", "-f", str(f))
+        assert rc == 1
+        assert '-    "replicas": "2"' in out
+        assert '+    "replicas": "5"' in out
+
+    def test_edit_roundtrip_with_editor(self, cluster, capsys, tmp_path,
+                                        monkeypatch):
+        """kubectl edit: $EDITOR mutates the dumped object; the PUT rides
+        the read's resourceVersion (CAS)."""
+        import stat
+        cm = {"apiVersion": "v1", "kind": "ConfigMap",
+              "metadata": {"name": "ed", "namespace": "default"},
+              "data": {"k": "v1"}}
+        f = tmp_path / "cm.json"
+        f.write_text(json.dumps(cm))
+        assert self._run(capsys, cluster, "create", "-f", str(f))[0] == 0
+        editor = tmp_path / "editor.py"
+        editor.write_text(
+            "#!/usr/bin/env python3\n"
+            "import json, sys\n"
+            "d = json.load(open(sys.argv[1]))\n"
+            "d['data']['k'] = 'edited'\n"
+            "json.dump(d, open(sys.argv[1], 'w'))\n")
+        editor.chmod(editor.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("EDITOR", str(editor))
+        rc, out = self._run(capsys, cluster, "edit", "configmaps", "ed")
+        assert rc == 0 and "edited" in out
+        from kubernetes_tpu.apiserver import HTTPClient
+        got = HTTPClient(cluster.address).config_maps("default").get("ed")
+        assert got.data["k"] == "edited"
+        # a no-op edit changes nothing
+        editor.write_text("#!/usr/bin/env python3\n")
+        rc, out = self._run(capsys, cluster, "edit", "configmaps", "ed")
+        assert rc == 0 and "no changes" in out

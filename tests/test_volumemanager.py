@@ -1,0 +1,108 @@
+"""Kubelet volume manager: mount gating on the attach-detach
+controller's actuation (ref: pkg/kubelet/volumemanager
+WaitForAttachAndMount + reconciler)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.node import NodeAgent
+from kubernetes_tpu.node.volumemanager import VolumeManager
+from kubernetes_tpu.state import Client, SharedInformerFactory
+
+
+def wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return fn()
+
+
+def pvc_pod(name, claim, node="vm1"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(
+            node_name=node,
+            containers=[api.Container(name="c", image="img")],
+            volumes=[api.Volume(
+                name="data",
+                persistent_volume_claim=api.PersistentVolumeClaimVolumeSource(
+                    claim_name=claim))]))
+
+
+class TestVolumeManager:
+    def test_pvc_pod_gates_on_attachment_then_runs(self):
+        """A PVC-backed pod stays ContainerCreating until the PV appears
+        in node.status.volumesAttached; local-source pods run at once."""
+        client = Client()
+        informers = SharedInformerFactory(client)
+        vm = VolumeManager(client, "vm1", attach_timeout=0.4,
+                           poll_interval=0.05)
+        agent = NodeAgent(client, "vm1", informers, pleg_period=0.2,
+                          volume_manager=vm)
+        informers.start()
+        agent.start()
+        try:
+            # bound PVC -> PV, but the PV is NOT attached yet
+            client.persistent_volumes().create(api.PersistentVolume(
+                metadata=api.ObjectMeta(name="pv-1"),
+                spec=api.PersistentVolumeSpec(
+                    capacity={"storage": Quantity("1Gi")})))
+            client.persistent_volume_claims("default").create(
+                api.PersistentVolumeClaim(
+                    metadata=api.ObjectMeta(name="claim",
+                                            namespace="default"),
+                    spec=api.PersistentVolumeClaimSpec(
+                        volume_name="pv-1")))
+            pod = client.pods("default").create(pvc_pod("pp", "claim"))
+            # gated: ContainerCreating, no mounts
+            assert wait_for(lambda: client.pods("default").get(
+                "pp").status.reason == "ContainerCreating", 10)
+            assert vm.mounted_volumes(pod.metadata.uid) == {}
+            # the attach-detach controller's actuation arrives
+            def attach(cur):
+                cur.status.volumes_attached = [api.AttachedVolume(
+                    name="pv-1", device_path="/dev/disk/pv-1")]
+                return cur
+            client.nodes().patch("vm1", attach)
+            assert wait_for(lambda: client.pods("default").get(
+                "pp").status.phase == "Running", 15)
+            mounts = vm.mounted_volumes(
+                client.pods("default").get("pp").metadata.uid)
+            assert mounts == {"data": "/dev/disk/pv-1"}
+            # teardown on delete
+            uid = client.pods("default").get("pp").metadata.uid
+            client.pods("default").delete("pp")
+            assert wait_for(lambda: vm.mounted_volumes(uid) == {}, 10)
+        finally:
+            agent.stop()
+            informers.stop()
+
+    def test_local_sources_mount_immediately(self):
+        client = Client()
+        informers = SharedInformerFactory(client)
+        vm = VolumeManager(client, "vm1")
+        agent = NodeAgent(client, "vm1", informers, pleg_period=0.2,
+                          volume_manager=vm)
+        informers.start()
+        agent.start()
+        try:
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="lp", namespace="default"),
+                spec=api.PodSpec(
+                    node_name="vm1",
+                    containers=[api.Container(name="c", image="img")],
+                    volumes=[api.Volume(name="scratch",
+                                        empty_dir={})]))
+            client.pods("default").create(pod)
+            assert wait_for(lambda: client.pods("default").get(
+                "lp").status.phase == "Running", 15)
+            uid = client.pods("default").get("lp").metadata.uid
+            assert "scratch" in vm.mounted_volumes(uid)
+        finally:
+            agent.stop()
+            informers.stop()
