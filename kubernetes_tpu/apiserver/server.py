@@ -205,6 +205,7 @@ class APIServer:
         bootstrap controller creating default/kube-system/kube-public)."""
         from ..api.core import Namespace
         from ..api.meta import ObjectMeta
+        from ..state.replication import ReplicaNotPromoted
         for name in ("default", "kube-system", "kube-node-lease",
                      "kube-public"):
             try:
@@ -212,6 +213,9 @@ class APIServer:
                     Namespace(metadata=ObjectMeta(name=name)))
             except AlreadyExistsError:
                 pass  # WAL replay already restored it
+            except ReplicaNotPromoted:
+                return  # standby over a follower store: the primary's
+                # replicated namespaces arrive through replication
             self._ensure_default_sa(name)
 
     def _ensure_default_sa(self, namespace: str) -> None:
@@ -458,6 +462,13 @@ class APIServer:
         except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:
+            from ..state.replication import ReplicaNotPromoted
+            if isinstance(e, ReplicaNotPromoted):
+                # a standby serving a follower store: writes 503 until
+                # promote() (the learner's not-the-leader answer)
+                self._error(h, 503, "ServiceUnavailable", str(e),
+                            headers={"Retry-After": "1"})
+                return
             traceback.print_exc()
             try:
                 self._error(h, 500, "InternalError", str(e))
@@ -618,6 +629,14 @@ class APIServer:
 
     def _handle(self, h, method: str, req: _Request, cls, user=None) -> None:
         self._req_local.user = user
+        if method != "GET" and self.store.read_only:
+            # a standby over a follower store refuses writes BEFORE
+            # admission — the guard, not an admission side effect, must
+            # be the answer (503 like a learner's not-the-leader)
+            self._error(h, 503, "ServiceUnavailable",
+                        "replica is read-only until promote()",
+                        headers={"Retry-After": "1"})
+            return
         if req.resource == "nodes" and req.subresource == "proxy" and \
                 method != "GET":
             # the proxy subresource is GET-only here; falling through

@@ -6,6 +6,7 @@ Ref layers L0/L1/L4 of SURVEY.md — etcd3 store + watch cache + client-go.
 from .client import Client, PodClient, ResourceClient
 from .informer import (EventHandlers, Indexer, SharedInformer,
                        SharedInformerFactory)
+from .replication import ReadOnlyStore, ReplicaNotPromoted, StoreReplica
 from .store import (ADDED, BOOKMARK, DELETED, MODIFIED, AlreadyExistsError,
                     ConflictError, ExpiredError, NotFoundError, Store, Watch,
                     WatchEvent)

@@ -1,0 +1,171 @@
+"""Store replication — a warm standby for the control plane's L0.
+
+Ref: the reference's L0 is raft-replicated etcd; this runtime's analog
+is an etcd LEARNER: a follower store that replicates every resource from
+the primary apiserver over the same list+watch protocol the informers
+use, preserving the PRIMARY's resourceVersions so a promoted replica
+continues the same optimistic-concurrency timeline (a client holding a
+pre-failover rv conflicts or succeeds exactly as it would have against
+the primary). Not a quorum protocol — split-brain safety is the
+operator's (or an external lease's) job, exactly like promoting an etcd
+learner; the replica REFUSES writes until promote() so it cannot fork
+history while the primary lives.
+
+Topology: primary APIServer <- StoreReplica (follower) <- standby
+APIServer over the replica store serving reads; on primary death:
+replica.promote() -> the standby serves writes and controllers fail
+over to it (leader election rides the same store).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..runtime.scheme import SCHEME
+from .store import Store
+
+
+class ReplicaNotPromoted(Exception):
+    """Write attempted against a follower (HTTP 503 analog)."""
+
+
+class ReadOnlyStore(Store):
+    """A Store that refuses mutations until promoted — the follower's
+    guard against forking history while the primary is alive. Reads,
+    watches, and the replication writer (apply_replicated) work."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.read_only = True  # the Store capability the apiserver checks
+
+    def _guard(self) -> None:
+        if self.read_only:
+            raise ReplicaNotPromoted(
+                "replica is read-only until promote()")
+
+    def create(self, resource, obj):
+        self._guard()
+        return super().create(resource, obj)
+
+    def create_bulk(self, resource, objs):
+        self._guard()
+        return super().create_bulk(resource, objs)
+
+    def update(self, resource, obj):
+        self._guard()
+        return super().update(resource, obj)
+
+    def delete(self, resource, namespace, name, **kw):
+        self._guard()
+        return super().delete(resource, namespace, name, **kw)
+
+    def bulk_apply(self, resource, items, **kw):
+        self._guard()
+        return super().bulk_apply(resource, items, **kw)
+
+    def guaranteed_update(self, resource, namespace, name, mutate,
+                          retries: int = 16):
+        self._guard()
+        return super().guaranteed_update(resource, namespace, name,
+                                         mutate, retries=retries)
+
+
+class StoreReplica:
+    """Follower: one reflector (list + watch, relist on expiry) per
+    registered resource, applying frames into a local store at the
+    primary's revisions."""
+
+    def __init__(self, primary_client, store: Optional[Store] = None,
+                 resources: Optional[List[str]] = None):
+        self.client = primary_client
+        self.store = store if store is not None else ReadOnlyStore()
+        self._resources = list(resources) if resources is not None \
+            else list(SCHEME.resources())
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        #: resource -> highest primary rv applied (lag observability)
+        self.applied_rv: Dict[str, int] = {}
+
+    def start(self) -> "StoreReplica":
+        for resource in self._resources:
+            cls = SCHEME.type_for_resource(resource)
+            if cls is None:
+                continue
+            t = threading.Thread(target=self._follow, args=(resource, cls),
+                                 daemon=True,
+                                 name=f"replica-{resource}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _follow(self, resource: str, cls) -> None:
+        import time
+        rc = self.client.resource(cls)
+        while not self._stop.is_set():
+            try:
+                items, rv = rc.list_rv()
+                # Replace semantics: upserts + prunes ghosts deleted on
+                # the primary during a watch outage, and advances the
+                # replica's rv/uid clocks past the primary's
+                self.store.replace_replicated(resource, items, int(rv))
+                self.applied_rv[resource] = int(rv)
+                w = rc.watch(resource_version=int(rv))
+                try:
+                    import queue as qm
+                    while not self._stop.is_set():
+                        # poll with a timeout: a dead-but-heartbeating
+                        # primary (or one that died after handshake)
+                        # yields no events, and a blocking get() would
+                        # pin this thread past stop()/promote()
+                        try:
+                            ev = w.events.get(timeout=0.5)
+                        except qm.Empty:
+                            continue
+                        if ev is None:
+                            break  # stream closed: relist
+                        obj = ev.object
+                        self.store.apply_replicated(
+                            resource, obj, ev.resource_version,
+                            deleted=(ev.type == "DELETED"))
+                        self.applied_rv[resource] = ev.resource_version
+                finally:
+                    w.stop()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.2)  # primary down or 410: relist
+
+    def caught_up(self, resource: str, rv: int) -> bool:
+        return self.applied_rv.get(resource, 0) >= rv
+
+    def wait_synced(self, timeout: float = 30.0) -> bool:
+        """True once EVERY followed resource completed its initial list —
+        the barrier to require before trusting reads or promoting (a
+        promote before full sync silently loses never-listed resources;
+        the learner analog: etcd refuses to promote a learner that is
+        not caught up)."""
+        import time
+        deadline = time.monotonic() + timeout
+        want = {r for r in self._resources
+                if SCHEME.type_for_resource(r) is not None}
+        while time.monotonic() < deadline:
+            if want <= set(self.applied_rv):
+                return True
+            time.sleep(0.05)
+        return want <= set(self.applied_rv)
+
+    def promote(self) -> Store:
+        """Stop following and open the store for writes — the standby
+        apiserver over it becomes the primary. One-way, like promoting
+        an etcd learner. Callers should gate on wait_synced() first
+        (etcd refuses to promote a learner that is not caught up)."""
+        self.stop()
+        self.store.read_only = False
+        return self.store
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()

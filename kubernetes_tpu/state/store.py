@@ -471,6 +471,74 @@ class Store:
                 self._publish(res, ev)
         return out
 
+    #: False on the base store; a follower's store (replication.py
+    #: ReadOnlyStore) overrides to True until promoted — the apiserver
+    #: answers 503 on writes against a read-only store
+    read_only = False
+
+    def _follow_clock_locked(self, rv: int) -> None:
+        """Advance the replica's clock to the primary's. The uid/name
+        counter tracks 2*rv: the primary bumps it at most twice per
+        create (generated name + uid) while rv advances at least once,
+        so counter <= 2*rv there — overshooting keeps every post-promote
+        generated suffix/uid above anything the primary ever minted."""
+        self._rv = max(self._rv, rv)
+        self._uid_counter = max(self._uid_counter, 2 * rv)
+
+    def apply_replicated(self, resource: str, obj: Any, rv: int,
+                         deleted: bool = False) -> None:
+        """Apply one event from a PRIMARY store at the primary's
+        resourceVersion (the replication follower's write path — see
+        state/replication.py). The replica's clock follows the primary's
+        so a promote continues the same CAS timeline; local watches fire
+        so read clients of the replica see live events."""
+        with self._lock:
+            bucket = self._data.setdefault(resource, {})
+            key = (obj.metadata.namespace, obj.metadata.name)
+            self._follow_clock_locked(rv)
+            if deleted:
+                existed = bucket.pop(key, None)
+                if existed is not None:
+                    self._journal("DELETE", resource, obj, rv)
+                    self._wal_commit()
+                    self._publish(resource, WatchEvent(DELETED, obj, rv))
+                return
+            cur = bucket.get(key)
+            if cur is not None and cur[1] >= rv:
+                return  # stale or duplicate frame (relist overlap)
+            bucket[key] = (obj, rv)
+            self._journal("PUT", resource, obj, rv)
+            self._wal_commit()
+            self._publish(resource, WatchEvent(
+                ADDED if cur is None else MODIFIED, obj, rv))
+
+    def replace_replicated(self, resource: str, objs: List[Any],
+                           rv: int) -> None:
+        """Apply a full primary LIST as a replace (the reflector's
+        Replace semantics): upsert every listed object and PRUNE local
+        keys the primary no longer has — an object deleted during a
+        watch outage must not survive as a ghost on the replica."""
+        with self._lock:
+            bucket = self._data.setdefault(resource, {})
+            listed = set()
+            for obj in objs:
+                key = (obj.metadata.namespace, obj.metadata.name)
+                listed.add(key)
+                obj_rv = int(obj.metadata.resource_version or 0)
+                cur = bucket.get(key)
+                if cur is not None and cur[1] >= obj_rv:
+                    continue
+                bucket[key] = (obj, obj_rv)
+                self._journal("PUT", resource, obj, obj_rv)
+                self._publish(resource, WatchEvent(
+                    ADDED if cur is None else MODIFIED, obj, obj_rv))
+            for key in [k for k in bucket if k not in listed]:
+                gone, gone_rv = bucket.pop(key)
+                self._journal("DELETE", resource, gone, rv)
+                self._publish(resource, WatchEvent(DELETED, gone, rv))
+            self._follow_clock_locked(rv)
+            self._wal_commit()
+
     def guaranteed_update(self, resource: str, namespace: str, name: str,
                           mutate: Callable[[Any], Any], retries: int = 16) -> Any:
         """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238)."""

@@ -1,0 +1,243 @@
+"""Store replication: a warm-standby follower + promote failover.
+
+Ref: the reference's L0 is raft-replicated etcd; this is the etcd
+LEARNER analog — the follower replicates every resource over the same
+list+watch wire the informers use, preserves the PRIMARY's
+resourceVersions, refuses writes until promoted, and continues the same
+CAS timeline after failover.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.state.replication import StoreReplica
+from kubernetes_tpu.state.store import ConflictError
+
+
+def make_pod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("100m"),
+                          "memory": Quantity("64Mi")}))]))
+
+
+def wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return fn()
+
+
+class TestReplication:
+    def test_follower_replicates_and_promote_fails_over(self):
+        primary = APIServer().start()
+        pc = HTTPClient(primary.address)
+        replica = StoreReplica(pc).start()
+        standby = APIServer(store=replica.store).start()
+        sc = HTTPClient(standby.address)
+        try:
+            # writes through the primary appear on the standby's READ path
+            created = pc.pods("default").create(make_pod("r1"))
+            rv1 = created.metadata.resource_version
+            assert wait_for(lambda: any(
+                p.metadata.name == "r1"
+                for p in sc.pods("default").list()), 15)
+            got = sc.pods("default").get("r1")
+            # the PRIMARY's resourceVersion is preserved on the replica
+            assert got.metadata.resource_version == rv1
+            # updates + deletes replicate too
+            created.metadata.labels["v"] = "2"
+            pc.pods("default").update(created)
+            assert wait_for(lambda: sc.pods("default").get(
+                "r1").metadata.labels.get("v") == "2", 15)
+            pc.pods("default").create(make_pod("gone"))
+            assert wait_for(lambda: any(
+                p.metadata.name == "gone"
+                for p in sc.pods("default").list()), 15)
+            pc.pods("default").delete("gone")
+            assert wait_for(lambda: all(
+                p.metadata.name != "gone"
+                for p in sc.pods("default").list()), 15)
+            # the follower REFUSES writes (503) while the primary lives
+            with pytest.raises(Exception, match="read-only|Unavailable"):
+                sc.pods("default").create(make_pod("forbidden"))
+            # ---- failover: primary dies, replica promotes
+            pre = sc.pods("default").get("r1")
+            assert replica.wait_synced(30)
+            primary.stop()
+            replica.promote()
+            # the standby now accepts writes, continuing the SAME CAS
+            # timeline: an update with the pre-failover rv succeeds...
+            pre.metadata.labels["owner"] = "standby"
+            out = sc.pods("default").update(pre)
+            assert out.metadata.labels["owner"] == "standby"
+            # ...and the stale pre-update copy now conflicts
+            stale = pre
+            stale.metadata.labels["owner"] = "lost"
+            with pytest.raises(ConflictError):
+                sc.pods("default").update(stale)
+            # fresh creates work post-promote
+            sc.pods("default").create(make_pod("post-failover"))
+            assert sc.pods("default").get("post-failover")
+        finally:
+            replica.stop()
+            standby.stop()
+            try:
+                primary.stop()
+            except Exception:
+                pass
+
+    def test_replica_watch_serves_live_events(self):
+        """Read clients of the STANDBY get watch events as frames arrive
+        from the primary (the learner serves reads, watches included)."""
+        primary = APIServer().start()
+        pc = HTTPClient(primary.address)
+        replica = StoreReplica(pc).start()
+        standby = APIServer(store=replica.store).start()
+        sc = HTTPClient(standby.address)
+        try:
+            rc = sc.pods("default")
+            w = rc.watch(resource_version=0)
+            try:
+                pc.pods("default").create(make_pod("ev1"))
+                import queue as qm
+                deadline = time.time() + 15
+                seen = None
+                while time.time() < deadline:
+                    try:
+                        ev = w.events.get(timeout=0.5)
+                    except qm.Empty:
+                        continue
+                    if ev is None:
+                        break
+                    if ev.type == "ADDED" and \
+                            ev.object.metadata.name == "ev1":
+                        seen = ev
+                        break
+                assert seen is not None
+            finally:
+                w.stop()
+        finally:
+            replica.stop()
+            standby.stop()
+            primary.stop()
+
+    def test_controllers_fail_over_to_promoted_replica(self):
+        """The full story: leader-elected controllers move to the standby
+        after promote and reconcile through it."""
+        from kubernetes_tpu.controllers import ControllerManager
+        primary = APIServer().start()
+        pc = HTTPClient(primary.address)
+        replica = StoreReplica(pc).start()
+        standby = APIServer(store=replica.store).start()
+        sc = HTTPClient(standby.address)
+        try:
+            pc.replica_sets("default").create(api.ReplicaSet(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicaSetSpec(
+                    replicas=2,
+                    selector=api.LabelSelector(match_labels={"a": "w"}),
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"a": "w"}),
+                        spec=make_pod("t").spec))))
+            assert wait_for(lambda: any(
+                r.metadata.name == "web"
+                for r in sc.replica_sets("default").list()), 15)
+            assert replica.wait_synced(30)
+            primary.stop()
+            replica.promote()
+            mgr = ControllerManager(sc)
+            mgr.start()
+            try:
+                assert wait_for(lambda: len(
+                    sc.pods("default").list()) == 2, 30)
+            finally:
+                mgr.stop()
+        finally:
+            replica.stop()
+            standby.stop()
+            try:
+                primary.stop()
+            except Exception:
+                pass
+
+
+class TestRelistPrune:
+    def test_relist_prunes_ghosts_after_outage(self):
+        """Objects deleted on the primary while the follower's watch was
+        down must vanish on relist (the reflector's Replace semantics) —
+        a ghost surviving into a promote would make controllers count a
+        pod that no longer exists."""
+        primary = APIServer().start()
+        pc = HTTPClient(primary.address)
+        replica = StoreReplica(pc).start()
+        try:
+            pc.pods("default").create(make_pod("keep"))
+            pc.pods("default").create(make_pod("ghost"))
+            assert replica.wait_synced(30)
+            assert wait_for(lambda: {
+                p.metadata.name for p in
+                replica.store.list("pods", "default")[0]} ==
+                {"keep", "ghost"}, 15)
+            # outage: follower down while the primary deletes
+            replica.stop()
+            pc.pods("default").delete("ghost")
+            # a NEW follower over the SAME replica store relists
+            replica2 = StoreReplica(pc, store=replica.store).start()
+            try:
+                assert wait_for(lambda: {
+                    p.metadata.name for p in
+                    replica.store.list("pods", "default")[0]} ==
+                    {"keep"}, 15)
+            finally:
+                replica2.stop()
+        finally:
+            replica.stop()
+            primary.stop()
+
+    def test_generate_name_after_promote_never_collides(self):
+        """Post-promote generateName/uid counters stay above anything the
+        primary minted (the counter<=2*rv bound)."""
+        primary = APIServer().start()
+        pc = HTTPClient(primary.address)
+        # primary mints generated names/uids
+        for i in range(5):
+            p = make_pod("x")
+            p.metadata.name = ""
+            p.metadata.generate_name = "gen-"
+            pc.pods("default").create(p)
+        replica = StoreReplica(pc).start()
+        standby = APIServer(store=replica.store).start()
+        sc = HTTPClient(standby.address)
+        try:
+            assert replica.wait_synced(30)
+            primary.stop()
+            replica.promote()
+            names = {p.metadata.name
+                     for p in sc.pods("default").list()}
+            uids = {p.metadata.uid for p in sc.pods("default").list()}
+            for i in range(5):
+                p = make_pod("y")
+                p.metadata.name = ""
+                p.metadata.generate_name = "gen-"
+                out = sc.pods("default").create(p)
+                assert out.metadata.name not in names
+                assert out.metadata.uid not in uids
+                names.add(out.metadata.name)
+                uids.add(out.metadata.uid)
+        finally:
+            replica.stop()
+            standby.stop()
+            try:
+                primary.stop()
+            except Exception:
+                pass
