@@ -439,7 +439,6 @@ def cmd_logs(args) -> int:
     """kubectl logs <pod> [-c container]: resolve the pod's node, then
     ride the apiserver->kubelet proxy to /containerLogs (ref:
     pkg/kubectl/cmd/logs + the kubelet server's log endpoint)."""
-    from urllib import request as urlrequest
     client = _client(args)
     pod = client.pods(args.namespace).get(args.name,
                                           namespace=args.namespace)
@@ -448,11 +447,12 @@ def cmd_logs(args) -> int:
               file=sys.stderr)
         return 1
     container = args.container or pod.spec.containers[0].name
-    url = (f"{args.master}/api/v1/nodes/{pod.spec.node_name}/proxy/"
-           f"containerLogs/{args.namespace}/{args.name}/{container}")
     try:
-        with urlrequest.urlopen(url, timeout=15) as r:
-            sys.stdout.write(r.read().decode(errors="replace"))
+        body = _proxy_get(
+            args.master, pod.spec.node_name,
+            f"containerLogs/{args.namespace}/{args.name}/{container}",
+            timeout=15)
+        sys.stdout.write(body.decode(errors="replace"))
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -559,6 +559,76 @@ def cmd_cp(args) -> int:
     if code != 0:
         sys.stderr.write(out.decode(errors="replace"))
     return code
+
+
+def _proxy_get(master: str, node: str, path: str, timeout: float = 4.0):
+    """GET through the apiserver->kubelet proxy (shared by logs/top —
+    one place owns the URL shape; the server-side dial cap is 3s, so a
+    4s client timeout bounds a dead node without dead weight)."""
+    from urllib import request as urlrequest
+    url = f"{master}/api/v1/nodes/{node}/proxy/{path}"
+    with urlrequest.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def cmd_top(args) -> int:
+    """kubectl top nodes|pods: live resource usage scraped from each
+    kubelet's /stats/summary through the apiserver->kubelet proxy (ref:
+    kubectl top's resource-metrics pipeline; this rides the same summary
+    endpoint the HPA consumes instead of a metrics-server deployment).
+    Nodes are scraped CONCURRENTLY; a node without a kubelet endpoint
+    (503) is skipped, any other failure is reported."""
+    import urllib.error
+    from concurrent.futures import ThreadPoolExecutor
+    client = _client(args)
+    nodes = client.nodes().list()
+
+    def scrape(node):
+        try:
+            return node, json.loads(
+                _proxy_get(args.master, node.metadata.name,
+                           "stats/summary")), None
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                return node, None, None  # no kubelet endpoint published
+            return node, None, f"HTTP {e.code}"
+        except Exception as e:
+            return node, None, str(e)
+    with ThreadPoolExecutor(max_workers=16) as ex:
+        scraped = list(ex.map(scrape, nodes))
+    rows = []
+    errors = 0
+    for node, summary, err in scraped:
+        if err is not None:
+            print(f"error scraping {node.metadata.name}: {err}",
+                  file=sys.stderr)
+            errors += 1
+            continue
+        if summary is None:
+            continue
+        pods = summary.get("pods", [])
+        if args.kind == "nodes":
+            total = sum(p.get("cpu", {}).get("usageNanoCores", 0)
+                        for p in pods)
+            rows.append((node.metadata.name,
+                         f"{total / 1_000_000:.0f}m", str(len(pods))))
+        else:
+            for p in pods:
+                ref = p.get("podRef", {})
+                if args.namespace and \
+                        ref.get("namespace") != args.namespace:
+                    continue
+                rows.append((ref.get("name", ""),
+                             f"{p.get('cpu', {}).get('usageNanoCores', 0) / 1_000_000:.0f}m",
+                             node.metadata.name))
+    hdr = ("NAME", "CPU(cores)", "PODS") if args.kind == "nodes" \
+        else ("NAME", "CPU(cores)", "NODE")
+    widths = [max(len(hdr[i]), *(len(r[i]) for r in rows), 1)
+              for i in range(3)] if rows else [len(h) for h in hdr]
+    print("  ".join(h.ljust(w) for h, w in zip(hdr, widths)))
+    for r in sorted(rows):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return 1 if errors else 0
 
 
 def cmd_drain(args) -> int:
@@ -809,6 +879,10 @@ def main(argv=None) -> int:
     ed.add_argument("resource")
     ed.add_argument("name")
     ed.set_defaults(fn=cmd_edit)
+
+    tp = sub.add_parser("top")
+    tp.add_argument("kind", choices=["nodes", "pods"])
+    tp.set_defaults(fn=cmd_top)
 
     x = sub.add_parser("delete")
     x.add_argument("resource")

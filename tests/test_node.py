@@ -921,3 +921,46 @@ class TestKubectlDiffEdit:
         editor.write_text("#!/usr/bin/env python3\n")
         rc, out = self._run(capsys, cluster, "edit", "configmaps", "ed")
         assert rc == 0 and "no changes" in out
+
+
+class TestKubectlTop:
+    def test_top_nodes_and_pods(self, capsys):
+        """kubectl top scrapes each kubelet's /stats/summary through the
+        apiserver proxy — live usage, no metrics-server deployment."""
+        from kubernetes_tpu.apiserver import APIServer, HTTPClient
+        from kubernetes_tpu.cmd import kubectl
+        from kubernetes_tpu.node.agent import NodeAgent
+        from kubernetes_tpu.node.server import KubeletServer
+        from kubernetes_tpu.state import SharedInformerFactory
+        srv = APIServer().start()
+        agent = ks = None
+        informers = None
+        try:
+            client = HTTPClient(srv.address)
+            informers = SharedInformerFactory(client)
+            agent = NodeAgent(client, "tn1", informers, pleg_period=0.2)
+            informers.start()
+            informers.wait_for_cache_sync()
+            agent.start()
+            agent.cpu_utilization = 0.5
+            ks = KubeletServer(agent).start()
+            pod = make_pod("tp1", node="tn1", cpu="200m")
+            client.pods("default").create(pod)
+            assert wait_for(lambda: client.pods("default").get(
+                "tp1").status.phase == "Running", 15)
+            rc = kubectl.main(["--master", srv.address, "top", "nodes"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "tn1" in out and "100m" in out  # 200m * 0.5
+            rc = kubectl.main(["--master", srv.address, "top", "pods"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "tp1" in out and "100m" in out
+        finally:
+            if ks is not None:
+                ks.stop()
+            if agent is not None:
+                agent.stop()
+            if informers is not None:
+                informers.stop()
+            srv.stop()
