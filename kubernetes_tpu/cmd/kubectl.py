@@ -621,13 +621,9 @@ def cmd_top(args) -> int:
                 rows.append((ref.get("name", ""),
                              f"{p.get('cpu', {}).get('usageNanoCores', 0) / 1_000_000:.0f}m",
                              node.metadata.name))
-    hdr = ("NAME", "CPU(cores)", "PODS") if args.kind == "nodes" \
-        else ("NAME", "CPU(cores)", "NODE")
-    widths = [max(len(hdr[i]), *(len(r[i]) for r in rows), 1)
-              for i in range(3)] if rows else [len(h) for h in hdr]
-    print("  ".join(h.ljust(w) for h, w in zip(hdr, widths)))
-    for r in sorted(rows):
-        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    hdr = ["NAME", "CPU(cores)", "PODS"] if args.kind == "nodes" \
+        else ["NAME", "CPU(cores)", "NODE"]
+    _print_table(sorted(rows), hdr)
     return 1 if errors else 0
 
 

@@ -552,6 +552,22 @@ class BatchScheduler:
     #: — whose topology credits still freeze at batch start — sub-chunks.
     SOFT_SCORE_CHUNK = 256
 
+    def topo_scan_likely(self, pods: List[Pod]) -> bool:
+        """True when this batch carries required ANTI-affinity — the
+        in-scan counter workload whose ungrouped (GT=1) power-of-two
+        padding is worth splitting away (drain_pipelined's alignment
+        split, measured +30%). Required AFFINITY batches measure FASTER
+        unsplit (their tight feasible sets retry across launches), so
+        they keep the padded single scan."""
+        if self.topology.has_required_anti_carriers():
+            return True
+        return any(
+            p.spec.affinity is not None
+            and p.spec.affinity.pod_anti_affinity is not None
+            and p.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution
+            for p in pods)
+
     def soft_batch_limit(self, pods: List[Pod]) -> int:
         """How many of these pods may schedule in ONE kernel batch without
         visible soft-score drift. Preferred inter-pod (anti-)affinity

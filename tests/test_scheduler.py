@@ -1173,3 +1173,40 @@ class TestPreemptionProxyScalars:
         assert plan_capped.node_name == "cheap"
         assert [v.metadata.name for v in plan_capped.victims] == \
             ["cheapie"]
+
+
+class TestAlignSplitGate:
+    def test_topo_scan_likely_anti_only(self):
+        """The drain's power-of-two alignment split applies exactly to
+        required-ANTI-affinity batches (measured +30% there, -17% on
+        required-affinity batches, -20% on plain ones)."""
+        cache = Cache()
+        cache.add_node(make_node(
+            "n1", labels={api.wellknown.LABEL_HOSTNAME: "n1"}))
+        sched = BatchScheduler(cache)
+        plain = make_pod("p")
+        assert not sched.topo_scan_likely([plain])
+        aff = make_pod("a")
+        aff.spec.affinity = api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels={"x": "y"}),
+                    topology_key=api.wellknown.LABEL_ZONE)]))
+        assert not sched.topo_scan_likely([aff])
+        anti = make_pod("z")
+        anti.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"x": "y"}),
+                        topology_key=api.wellknown.LABEL_HOSTNAME)]))
+        assert sched.topo_scan_likely([plain, anti])
+        # a bound anti carrier in the cluster flips the gate for every
+        # batch (the index's carriers constrain any new pod)
+        bound = make_pod("carrier", node="n1")
+        bound.spec.affinity = anti.spec.affinity
+        cache.add_pod(bound)
+        sched.refresh()
+        assert sched.topo_scan_likely([plain])
