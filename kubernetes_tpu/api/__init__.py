@@ -36,6 +36,8 @@ from .admissionregistration import (MutatingWebhookConfiguration,
 from .apiregistration import (APIService, APIServiceCondition,
                               APIServiceSpec, APIServiceStatus)
 from .quantity import Quantity
+from .scheduling import (PodGroup, PodGroupSpec, PodGroupStatus,
+                         pod_group_key, pod_group_name)
 from .serde import decode, deepcopy_obj, encode, from_json_str, to_json_str
 from .validation import ValidationError, validate
 

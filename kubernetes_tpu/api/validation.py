@@ -125,6 +125,25 @@ def validate_node(node: Node) -> None:
         raise ValidationError(errs)
 
 
+def validate_pod_group(pg) -> None:
+    errs: List[str] = []
+    validate_object_meta(pg.metadata, namespaced=True, errs=errs)
+    if pg.spec.min_member < 1:
+        errs.append("spec.minMember: must be >= 1")
+    if pg.spec.topology_key and not is_qualified_name(pg.spec.topology_key):
+        errs.append(
+            f"spec.topologyKey: invalid key {pg.spec.topology_key!r}")
+    if pg.spec.schedule_timeout_seconds < 0:
+        errs.append("spec.scheduleTimeoutSeconds: must be non-negative")
+    from .scheduling import (PHASE_FAILED, PHASE_PENDING, PHASE_RUNNING,
+                             PHASE_SCHEDULING)
+    if pg.status.phase not in (PHASE_PENDING, PHASE_SCHEDULING,
+                               PHASE_RUNNING, PHASE_FAILED):
+        errs.append(f"status.phase: invalid phase {pg.status.phase!r}")
+    if errs:
+        raise ValidationError(errs)
+
+
 def _validate_workload_selector(spec, kind: str, errs: List[str]) -> None:
     if spec.selector is None or labelsmod.selector_empty(spec.selector):
         errs.append("spec.selector: required and must not be empty")
@@ -158,12 +177,15 @@ CLUSTER_SCOPED_TYPES: set = set()
 
 
 def validate(obj) -> None:
+    from .scheduling import PodGroup
     if isinstance(obj, Pod):
         validate_pod(obj)
     elif isinstance(obj, Node):
         validate_node(obj)
     elif isinstance(obj, (Deployment, ReplicaSet, StatefulSet, DaemonSet, Job)):
         validate_workload(obj)
+    elif isinstance(obj, PodGroup):
+        validate_pod_group(obj)
     else:
         errs: List[str] = []
         meta = getattr(obj, "metadata", None)

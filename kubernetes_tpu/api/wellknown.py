@@ -38,6 +38,10 @@ TAINT_NODE_NETWORK_UNAVAILABLE = "node.kubernetes.io/network-unavailable"
 # annotation used for preemption nominations (ref NominatedNodeName field)
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
+# gang scheduling: the pod label naming its PodGroup (the coscheduling
+# plugin's convention — ref: sigs.k8s.io/scheduler-plugins coscheduling)
+LABEL_POD_GROUP = "scheduling.k8s.io/pod-group"
+
 
 def is_extended_resource(name: str) -> bool:
     """A resource name outside the default kubernetes.io namespace.

@@ -27,6 +27,7 @@ from .misc import (AttachDetachController, PVExpanderController,
                    RootCACertPublisher, TTLController)
 from .clusterroleaggregation import ClusterRoleAggregationController
 from .nodeipam import NodeIpamController
+from .podgroup import PodGroupController
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
@@ -92,6 +93,7 @@ class ControllerManager:
                 client, self.informers, cluster_ca[0], cluster_ca[1])
             self.root_ca_publisher = RootCACertPublisher(
                 client, self.informers, cluster_ca[0])
+        self.podgroup = PodGroupController(client, self.informers)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -109,7 +111,7 @@ class ControllerManager:
             self.clusterrole_aggregation, self.nodeipam,
             self.pvc_protection, self.pv_protection, self.ttl,
             self.attachdetach, self.pv_expander,
-            self.bootstrapsigner, self.tokencleaner]
+            self.bootstrapsigner, self.tokencleaner, self.podgroup]
         if self.csrapproving is not None:
             self.controllers += [self.csrapproving, self.csrsigning,
                                  self.root_ca_publisher]

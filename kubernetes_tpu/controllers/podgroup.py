@@ -1,0 +1,86 @@
+"""PodGroup controller — reconciles gang phase from member pod status.
+
+Ref: the coscheduling operator lineage (sigs.k8s.io/scheduler-plugins'
+podgroup controller): the scheduler owns placement, this loop owns the
+OBSERVED lifecycle — Pending (below minMember), Scheduling (members
+assigned but the gang not yet running), Running (>= minMember members
+run), Failed (enough members failed that minMember is out of reach).
+Phase is recomputed from the live member set on every relevant event, so
+a rescheduled gang (e.g. after a permit-timeout rollback plus node churn)
+walks back through Scheduling without controller-side state.
+"""
+
+from __future__ import annotations
+
+from ..api.core import Pod
+from ..api.scheduling import (PHASE_FAILED, PHASE_PENDING, PHASE_RUNNING,
+                              PHASE_SCHEDULING, PodGroup, pod_group_key,
+                              pod_group_name)
+from ..state.informer import EventHandlers, SharedInformerFactory
+from .base import Controller
+
+
+class PodGroupController(Controller):
+    name = "podgroup"
+
+    def __init__(self, client, informers: SharedInformerFactory,
+                 workers: int = 1):
+        super().__init__(workers)
+        self.client = client
+        self.pg_informer = informers.informer_for(PodGroup)
+        self.pod_informer = informers.informer_for(Pod)
+        self.pg_informer.add_event_handlers(EventHandlers(
+            on_add=lambda g: self.enqueue(g.metadata.key()),
+            on_update=lambda old, new: self.enqueue(new.metadata.key())))
+        self.pod_informer.add_event_handlers(EventHandlers(
+            on_add=self._enqueue_owner,
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=self._enqueue_owner))
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        key = pod_group_key(pod)
+        if key is not None:
+            self.enqueue(key)
+
+    def sync(self, key: str) -> None:
+        pg = self.pg_informer.indexer.get_by_key(key)
+        if pg is None or pg.metadata.deletion_timestamp is not None:
+            return
+        ns, _, name = key.partition("/")
+        members = [p for p in self.pod_informer.indexer.list(ns)
+                   if pod_group_name(p) == name]
+        scheduled = sum(1 for p in members if p.spec.node_name)
+        running = sum(1 for p in members if p.status.phase == "Running")
+        succeeded = sum(1 for p in members if p.status.phase == "Succeeded")
+        failed = sum(1 for p in members if p.status.phase == "Failed")
+        mm = max(1, pg.spec.min_member)
+        if running + succeeded >= mm:
+            phase = PHASE_RUNNING
+        elif failed > 0 and len(members) - failed < mm:
+            # the healthy members remaining can never reach minMember
+            phase = PHASE_FAILED
+        elif scheduled > 0:
+            phase = PHASE_SCHEDULING
+        else:
+            phase = PHASE_PENDING
+        st = pg.status
+        if (st.phase == phase and st.scheduled == scheduled
+                and st.running == running and st.succeeded == succeeded
+                and st.failed == failed):
+            return
+
+        def mutate(cur):
+            cur.status.phase = phase
+            cur.status.scheduled = scheduled
+            cur.status.running = running
+            cur.status.succeeded = succeeded
+            cur.status.failed = failed
+            return cur
+        from ..state.store import NotFoundError
+        try:
+            self.client.pod_groups(ns).patch(name, mutate)
+        except NotFoundError:
+            pass  # deleted between get and patch; nothing to reconcile
+        # other failures (conflicts, transient store errors) propagate so
+        # the base Controller re-enqueues the key rate-limited — swallowing
+        # them would leave the phase stale until an unrelated member event

@@ -134,6 +134,9 @@ def default_scheme() -> Scheme:
     s.register(StorageClass, "storage.k8s.io/v1", "StorageClass",
                "storageclasses", namespaced=False)
     s.register(Lease, "coordination.k8s.io/v1", "Lease", "leases")
+    from ..api.scheduling import PodGroup
+    s.register(PodGroup, "scheduling.k8s.io/v1alpha1", "PodGroup",
+               "podgroups")
     from .crd import CustomResourceDefinition
     s.register(CustomResourceDefinition, "apiextensions.k8s.io/v1",
                "CustomResourceDefinition", "customresourcedefinitions",

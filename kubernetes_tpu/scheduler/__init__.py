@@ -20,9 +20,11 @@ against, and serve preemption's host-side victim search.
 
 from .cache import Cache, Snapshot
 from .core import BatchScheduler, FitError, ScheduleResult
+from .gang import GangManager
 from .nodeinfo import NodeInfo, Resource
 from .queue import SchedulingQueue
 from .scheduler import Scheduler
 
-__all__ = ["BatchScheduler", "Cache", "FitError", "NodeInfo", "Resource",
-           "ScheduleResult", "Scheduler", "SchedulingQueue", "Snapshot"]
+__all__ = ["BatchScheduler", "Cache", "FitError", "GangManager", "NodeInfo",
+           "Resource", "ScheduleResult", "Scheduler", "SchedulingQueue",
+           "Snapshot"]

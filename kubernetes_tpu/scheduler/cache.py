@@ -125,6 +125,21 @@ class Cache:
                 raise ValueError(f"pod {key} is not assumed")
             self._remove_pod_state(key)
 
+    def forget_pods(self, pods) -> int:
+        """Roll back a set of assumed reservations in ONE lock acquisition
+        — the gang permit-timeout path drops a whole gang's reservations
+        atomically, so no scheduling cycle can observe a half-rolled-back
+        gang. Pods no longer assumed (confirmed or already forgotten) are
+        skipped; returns the number actually rolled back."""
+        with self._lock:
+            n = 0
+            for pod in pods:
+                key = pod.metadata.key()
+                if key in self._assumed:
+                    self._remove_pod_state(key)
+                    n += 1
+            return n
+
     def _remove_pod_state(self, key: str) -> None:
         pod = self._pod_states.pop(key)
         self._assumed.discard(key)

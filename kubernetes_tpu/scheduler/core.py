@@ -107,6 +107,10 @@ class PendingBatch:
     #: (repair demotions / commit drops): chained usage over-states, so
     #: kernel-unassigned pods here must RETRY, not park as unschedulable
     phantom: bool = False
+    #: gang placement units [(pod indices, topology key, is_gang)] when
+    #: this batch routed through the all-or-nothing kernel; finish uses
+    #: them to demote whole gangs when repair invalidates any member
+    gang_units: Optional[list] = None
 
 
 class _RepairReassigner:
@@ -298,6 +302,10 @@ class BatchScheduler:
         self._seq_base = 0  # selectHost round-robin state across batches
         # True while host-computed static scores contribute (chain pre-check)
         self._static_likely = False
+        #: gang.GangManager, installed by the scheduler shell; batches
+        #: carrying PodGroup members route through the all-or-nothing
+        #: kernel (kernels/gang.py) instead of schedule_batch
+        self.gang = None
 
     def refresh(self) -> None:
         dirty = self.cache.update_snapshot(self.snapshot)
@@ -999,6 +1007,13 @@ class BatchScheduler:
             for p in pods)
         affinity_chainable = affinity_only and not any(
             helpers.pod_host_ports(p) for p in pods)
+        #: gang units present -> the all-or-nothing kernel decides this
+        #: batch; such batches never chain in either direction (the gang
+        #: trial/commit windows need the committed usage as their base)
+        gang_units = self.gang.batch_groups(pods) \
+            if self.gang is not None else None
+        if chaining and gang_units is not None:
+            return None
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
@@ -1006,8 +1021,16 @@ class BatchScheduler:
         w = self.scorer.weights
         batch.resource_weights[0] = w.get("LeastRequestedPriority", 1)
         batch.resource_weights[1] = w.get("BalancedResourceAllocation", 1)
-        spread_present = self._assign_spread_groups(pods, batch)
-        self._assign_topology_terms(pods, batch, profiles)
+        # gang batches skip the in-scan spread/topology tables — the gang
+        # kernel's trial/commit scan does not carry them; repair (with
+        # whole-gang demotion) validates affinity interactions, matching
+        # the pre-in-scan semantics. Nominated reservations DO ride along
+        # (both kernels take the same phantom overlay — a mixed batch's
+        # singletons must not steal a preemptor's freed space).
+        spread_present = False
+        if gang_units is None:
+            spread_present = self._assign_spread_groups(pods, batch)
+            self._assign_topology_terms(pods, batch, profiles)
         nom_dev = self._nominated_device()
         if nom_dev is not None:
             # each pod's own nominated row, from the EXACT snapshot the
@@ -1043,16 +1066,24 @@ class BatchScheduler:
             node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
-        assign_d, scores_d, new_usage = schedule_batch(
-            node_cfg, usage, batch.device(self.mirror.mesh),
-            self._nominated_device())
+        if gang_units is not None:
+            from .kernels.gang import gang_schedule_batch
+            assign_d, scores_d, new_usage = gang_schedule_batch(
+                node_cfg, usage, batch.device(self.mirror.mesh),
+                self._gang_device_table(gang_units, batch), nom_dev)
+        else:
+            assign_d, scores_d, new_usage = schedule_batch(
+                node_cfg, usage, batch.device(self.mirror.mesh), nom_dev)
         return PendingBatch(pods=pods, profiles=profiles, batch=batch,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
-                            residual_free=residual_free,
-                            affinity_chainable=affinity_chainable,
+                            residual_free=(residual_free
+                                           and gang_units is None),
+                            affinity_chainable=(affinity_chainable
+                                                and gang_units is None),
                             chained=chaining,
-                            usage_epoch=self.mirror.usage_epoch)
+                            usage_epoch=self.mirror.usage_epoch,
+                            gang_units=gang_units)
 
     def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
         """Back half: fetch results, host repair, adopt chained usage."""
@@ -1071,9 +1102,15 @@ class BatchScheduler:
             for r in out:
                 if r.node_name is None:
                     r.retry = True
-        moved = self._repair_batch(out, pending.profiles,
-                                   pending.stale_winners,
-                                   batch=pending.batch)
+        moved = self._repair_batch(
+            out, pending.profiles, pending.stale_winners,
+            # no serial reassignment for gang batches: the reassigner is
+            # blind to the gang's ICI-domain pin, so a "repaired" member
+            # could land outside the slice — demote-and-retry instead,
+            # and atomicity below demotes the rest of its gang with it
+            batch=None if pending.gang_units else pending.batch)
+        if pending.gang_units:
+            self._enforce_gang_atomicity(out, pending.gang_units)
         if moved and pending.batch.anti_dom is not None:
             # the in-scan (anti-)affinity counters counted a winner the
             # repair moved/demoted: pods the scan left unassigned may have
@@ -1090,6 +1127,97 @@ class BatchScheduler:
             # batch launched: its usage input carries the phantom state that
             # invalidation dropped — re-adopting would resurrect it.
             self.mirror.adopt_usage(pending.new_usage)
+        return out
+
+    def _enforce_gang_atomicity(self, results: List[ScheduleResult],
+                                units: list) -> None:
+        """Post-repair all-or-nothing: host repair may demote individual
+        members (ports/affinity/volume conflicts the kernel cannot see); a
+        gang that lost ANY member binds none, and the survivors retry
+        together next cycle. Kernel-level rejections (the whole gang
+        already unassigned) park as unschedulable instead and are counted
+        as rejected."""
+        gm = self.gang
+        for idxs, _tk, is_gang, _pin in units:
+            if not is_gang:
+                continue
+            rs = [results[i] for i in idxs]
+            placed = sum(1 for r in rs if r.node_name is not None)
+            if 0 < placed < len(rs):
+                for r in rs:
+                    r.node_name = None
+                    r.reassigned = False
+                    r.retry = True
+            elif placed == 0 and gm is not None and gm.metrics is not None:
+                gm.metrics.gangs_rejected.inc()
+
+    def _gang_device_table(self, units: list, batch: PodBatchTensors) -> dict:
+        """Flattened gang-entry tensors for kernels/gang.py (entry-stream
+        layout documented there). The entry axis equals the batch's padded
+        pod axis, so gang batches introduce no new XLA bucket shapes;
+        padding entries are their own empty units. Topology-key domain
+        vectors come from the incremental topology index
+        (TopologyIndex.node_domain_vector)."""
+        P = batch.req.shape[0]
+        N = self.mirror.t.capacity
+        pod_idx = np.full((P,), -1, np.int32)
+        start = np.zeros((P,), bool)
+        end = np.zeros((P,), bool)
+        # pads default to their own (position-numbered) unit ids; real
+        # units use list order, which pad positions can never collide with
+        gang_id = np.arange(P, dtype=np.int32)
+        entry_dom = np.full((P,), -1, np.int32)
+        pin_dom = np.full((P,), -1, np.int32)
+        dom_index: Dict[str, int] = {}
+        dom_rows: List[np.ndarray] = []
+        t = 0
+        for u, (idxs, tk, _is_gang, pin) in enumerate(units):
+            d = -1
+            p_id = -1
+            if tk:
+                d = dom_index.get(tk, -1)
+                if d < 0:
+                    d = len(dom_rows)
+                    dom_index[tk] = d
+                    dom_rows.append(self.topology.node_domain_vector(tk)
+                                    [:N].astype(np.int32))
+                if pin is not None:
+                    # the gang's earlier batches reserved in this domain:
+                    # seed the kernel's carry so stragglers only join it.
+                    # Interning handles a value no live node carries (the
+                    # slice vanished) — the id matches nothing and the
+                    # members wait for the permit timeout to clear the pin
+                    p_id = self.topology._dom_id(tk, pin)
+            for j, i in enumerate(idxs):
+                pod_idx[t] = i
+                start[t] = j == 0
+                end[t] = j == len(idxs) - 1
+                gang_id[t] = u
+                entry_dom[t] = d
+                pin_dom[t] = p_id
+                t += 1
+        start[t:] = True
+        end[t:] = True
+        from .tensorize import _bucket
+        K = _bucket(len(dom_rows), minimum=1)
+        dom_tab = np.full((K, N), -1, np.int32)
+        if dom_rows:
+            dom_tab[:len(dom_rows)] = np.stack(dom_rows)
+        put = self.mirror.put_replicated
+        out = {"pod_idx": put(pod_idx), "start": put(start),
+               "end": put(end), "gang_id": put(gang_id),
+               "entry_dom_idx": put(entry_dom), "pin_dom": put(pin_dom)}
+        mesh = self.mirror.mesh
+        if mesh is None:
+            import jax.numpy as jnp
+            out["dom_tab"] = jnp.asarray(dom_tab)
+        else:
+            # node axis shards with the mirror, like the mask tables
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PSpec
+            out["dom_tab"] = jax.device_put(
+                dom_tab, NamedSharding(mesh, PSpec(None, "nodes")))
         return out
 
     def _nominated_device(self) -> Optional[dict]:

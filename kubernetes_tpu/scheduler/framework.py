@@ -8,6 +8,13 @@ the same supporting pieces: a name->factory Registry, a per-scheduling-
 cycle PluginContext K/V store, and a Framework runner that calls every
 registered plugin in registration order and stops on the first failure.
 
+Gang extension: a Permit point between Reserve and Prebind (the later
+framework versions' WaitOnPermit, interface.go in >= v1.17; coscheduling
+builds on it). A permit plugin may return Status.wait(), which parks the
+winner with its node RESERVED (assumed in the cache) but unbound; the
+shell binds it when a later cycle's permit allows it, or rolls the
+reservation back on timeout (scheduler/gang.py drives both edges).
+
 Batch adaptation: the reference runs plugins inside scheduleOne, once per
 pod; here the shell calls run_reserve_plugins per winner before its assume
 and run_prebind_plugins per winner before the bulk bind — same per-pod
@@ -46,7 +53,10 @@ class PluginContext:
 
 
 class Status:
-    """Ref: interface.go Status — Success or an error message."""
+    """Ref: interface.go Status — Success, an error message, or Wait
+    (the Permit point's third verdict: hold the reservation, bind later)."""
+
+    WAIT = 2
 
     def __init__(self, code: int = 0, message: str = ""):
         self.code = code
@@ -56,6 +66,10 @@ class Status:
     def success(self) -> bool:
         return self.code == 0
 
+    @property
+    def is_wait(self) -> bool:
+        return self.code == Status.WAIT
+
     @staticmethod
     def ok() -> "Status":
         return Status()
@@ -64,15 +78,23 @@ class Status:
     def error(message: str) -> "Status":
         return Status(1, message)
 
+    @staticmethod
+    def wait(message: str = "") -> "Status":
+        return Status(Status.WAIT, message)
+
 
 class Plugin:
-    """Base plugin; subclasses implement reserve and/or prebind
-    (ref: ReservePlugin/PrebindPlugin interfaces)."""
+    """Base plugin; subclasses implement reserve, permit and/or prebind
+    (ref: ReservePlugin/PrebindPlugin interfaces + the later PermitPlugin)."""
 
     name = "plugin"
 
     def reserve(self, ctx: PluginContext, pod: Pod,
                 node_name: str) -> Status:
+        return Status.ok()
+
+    def permit(self, ctx: PluginContext, pod: Pod,
+               node_name: str) -> Status:
         return Status.ok()
 
     def prebind(self, ctx: PluginContext, pod: Pod,
@@ -119,6 +141,21 @@ class Framework:
                     f"error while running {p.name} reserve plugin for pod "
                     f"{pod.metadata.name}: {st.message}")
         return Status.ok()
+
+    def run_permit_plugins(self, ctx: PluginContext, pod: Pod,
+                           node_name: str) -> Status:
+        """First error wins; otherwise a single Wait verdict makes the
+        whole point Wait (ref: RunPermitPlugins — max of the statuses)."""
+        wait: Optional[Status] = None
+        for p in self.plugins:
+            st = p.permit(ctx, pod, node_name)
+            if st.is_wait:
+                wait = st
+            elif not st.success:
+                return Status.error(
+                    f"error while running {p.name} permit plugin for pod "
+                    f"{pod.metadata.name}: {st.message}")
+        return wait if wait is not None else Status.ok()
 
     def run_prebind_plugins(self, ctx: PluginContext, pod: Pod,
                             node_name: str) -> Status:

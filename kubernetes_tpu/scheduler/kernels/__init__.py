@@ -1,5 +1,7 @@
 """Device kernels for the scheduling hot loop."""
 
 from .batch import schedule_batch, filter_score
+from .gang import gang_schedule_batch, gang_schedule_reference
 
-__all__ = ["schedule_batch", "filter_score"]
+__all__ = ["schedule_batch", "filter_score", "gang_schedule_batch",
+           "gang_schedule_reference"]

@@ -1,0 +1,344 @@
+"""All-or-nothing gang assignment on device.
+
+Extends the batched Filter+Score+Assign kernel (batch.py schedule_batch)
+with the gang-scheduling contract: a PodGroup's members either ALL place —
+each against the running usage, all inside one ICI topology domain — or
+NONE do. Placing 3 of 4 workers of a v4-32 slice wedges the slice and
+deadlocks against other partial gangs, so partial placement is strictly
+worse than no placement.
+
+Layout: the batch's placement units (gangs, and every singleton as a gang
+of one) are FLATTENED into one member-entry stream, so the scan length is
+O(total members) regardless of gang sizes — a 512-member slice costs the
+same HLO as 512 singletons, where a per-gang scan with the max gang size
+unrolled in its step would blow up compilation:
+
+    pod_idx [T] int32   pod-axis index of the entry (-1 = padding)
+    start   [T] bool    first entry of its gang (opens a trial window)
+    end     [T] bool    last entry of its gang (commit-or-rollback point)
+    gang_id [T] int32   unit id, for the post-scan all-or-nothing mask
+    dom_idx [T] int32   row into dom_tab (-1 = no topology constraint)
+    pin_dom [T] int32   pre-pinned domain id (-1 = free): a gang whose
+                        EARLIER batches already reserved in a domain seeds
+                        the carry with it, so stragglers can only join
+                        that slice
+    dom_tab [K, N] int32  node row -> topology-domain id (-1 = label absent)
+
+The scan carry holds TWO usage states: `committed` (last gang boundary)
+and `trial` (running placements of the open gang). A gang start copies
+committed into trial; each member places greedily against trial exactly
+like schedule_batch's step (same feasibility, same resource scores, same
+(row, seq) tie-break hash — a singleton-only batch is bit-identical to
+schedule_batch modulo the spread/topology in-scan extras, which gang
+batches do not carry); the gang's end either folds trial into committed or
+drops it. The first placed member of a topology-constrained gang pins the
+gang's domain; every later member's mask is restricted to that domain.
+
+Members that individually placed inside a gang that later failed are
+masked to -1 AFTER the scan via the per-gang ok vector — the usage they
+touched only ever lived in the discarded trial, so no rollback scatter is
+needed.
+
+`gang_schedule_reference` is the host numpy mirror (same op order, f32
+throughout) — the parity oracle for tests/test_gang.py's randomized
+instances, in the same role predicates.py/priorities.py play for the
+plain batch kernel.
+"""
+
+from __future__ import annotations
+
+import os as _os
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .batch import (COL_CPU, COL_MEM, NEG, _pod_feasible, _pod_score,
+                    _split_batch)
+
+#: entries per scan step (unrolled inside, same op sequence — see
+#: batch.py's step grouping); must divide the bucketed T (a power of two)
+_STEP_GROUP_GANG = int(_os.environ.get("KTPU_SCAN_GROUP_GANG", "8"))
+
+
+@jax.jit
+def gang_feasible(fits: jnp.ndarray, members: jnp.ndarray) -> jnp.ndarray:
+    """[G] bool per-gang static-feasibility reduction over the pods x nodes
+    mask (filter_score output): False when some member fits NOWHERE even
+    on the empty batch-start snapshot — such a gang can never place, so a
+    caller may reject it without paying the assignment scan. A reduction,
+    not a placement: True only means "not provably impossible". NOT yet
+    routed by core.schedule_launch (the scan subsumes it); kept as the
+    building block for a cheap pre-reject / gang-aware autoscaling signal
+    (ROADMAP), exercised by tests/test_gang.py.
+
+    members: [G, M] int32 pod rows, -1 padded."""
+    ok_pod = fits.any(axis=1)                       # [P]
+    valid = members >= 0                            # [G, M]
+    ok_m = ok_pod[jnp.maximum(members, 0)]          # [G, M]
+    return (ok_m | ~valid).all(axis=1)
+
+
+@jax.jit
+def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
+                        gang_tab: dict, nom: dict = None
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Serial-semantics greedy assignment with per-gang atomicity.
+
+    Same signature/returns as batch.schedule_batch — (assign [P] int32,
+    chosen_score [P] f32, new_usage) — so core.BatchScheduler's
+    launch/finish plumbing (pack_results, usage adoption) is shared.
+    new_usage reflects only COMMITTED gangs. Gang batches never carry the
+    in-scan spread/topology tables (the core refuses those combinations
+    before routing here); `nom` is the same phantom nominated-reservation
+    overlay schedule_batch takes — a mixed batch's singletons must not
+    steal a preemptor's freed space just because a gang member rode along.
+    """
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
+    N = node_cfg["alloc"].shape[0]
+    P = per_pod["seq"].shape[0]
+    dom_tab = gang_tab["dom_tab"]
+    rows = jnp.arange(N, dtype=jnp.int32)
+    if nom is None:
+        nom = {"used": jnp.zeros_like(usage["used"]),
+               "count": jnp.zeros_like(usage["pod_count"])}
+
+    def one_entry(carry, e):
+        committed, trial, gang_dom, gang_ok = carry
+        # gang boundary: open a fresh trial window over committed state
+        fresh = e["start"]
+        trial = {k: jnp.where(fresh, committed[k], trial[k])
+                 for k in trial}
+        gang_dom = jnp.where(fresh, e["pin_dom"], gang_dom)
+        gang_ok = jnp.where(fresh, True, gang_ok)
+
+        valid = e["pod_idx"] >= 0
+        i = jnp.maximum(e["pod_idx"], 0)
+        pod = {k: v[i] for k, v in per_pod.items()}
+        mask = unique_masks[pod["mask_idx"]]
+        static = unique_scores[pod["score_idx"]]
+        # ICI-domain restriction: members of a constrained gang must land
+        # where the topology label EXISTS, and — once the first member
+        # pinned a domain — inside that domain
+        constrained = e["dom_idx"] >= 0
+        dom_row = dom_tab[jnp.maximum(e["dom_idx"], 0)]
+        dmask = jnp.where(constrained,
+                          (dom_row >= 0) & ((gang_dom < 0)
+                                            | (dom_row == gang_dom)),
+                          True)
+        # phantom nominated usage shields preemption's freed space, minus
+        # the pod's own reservation at its nominated row (batch.py's
+        # schedule_batch semantics)
+        self_oh = rows == pod.get("nom_row", jnp.int32(-1))
+        eff_used = trial["used"] + nom["used"] - \
+            jnp.where(self_oh[:, None], pod["req"][None, :], 0.0)
+        eff_count = trial["pod_count"] + nom["count"] \
+            - self_oh.astype(jnp.float32)
+        fits = _pod_feasible(node_cfg, eff_used, eff_count,
+                             pod, mask & dmask)
+        score = _pod_score(node_cfg, trial["nonzero_used"], pod, static, rw)
+        masked = jnp.where(fits, score, NEG)
+        # identical tie-break to schedule_batch (selectHost rotation)
+        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
+                            pod["seq"] * jnp.int32(40503), 0xFFFF)
+        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
+        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        ok = fits[best] & pod["active"] & valid
+        oh_f = ((rows == best) & ok).astype(jnp.float32)
+        trial = {
+            "used": trial["used"] + oh_f[:, None] * pod["req"][None, :],
+            "nonzero_used": trial["nonzero_used"]
+            + oh_f[:, None] * pod["nonzero_req"][None, :],
+            "pod_count": trial["pod_count"] + oh_f,
+        }
+        gang_dom = jnp.where(valid & ok & constrained & (gang_dom < 0),
+                             dom_row[best], gang_dom)
+        # a padding entry never vetoes its (padding) gang
+        gang_ok = gang_ok & (ok | ~valid)
+        # gang end: fold the trial into committed state, or drop it whole
+        closing = e["end"]
+        commit = closing & gang_ok
+        committed = {k: jnp.where(commit, trial[k], committed[k])
+                     for k in committed}
+        assign = jnp.where(ok, best, jnp.int32(-1))
+        return ((committed, trial, gang_dom, gang_ok),
+                (assign, masked[best], gang_ok))
+
+    usage0 = {"used": usage["used"], "nonzero_used": usage["nonzero_used"],
+              "pod_count": usage["pod_count"]}
+    carry0 = (usage0, usage0, jnp.int32(-1), jnp.bool_(True))
+    entries = {"pod_idx": gang_tab["pod_idx"], "start": gang_tab["start"],
+               "end": gang_tab["end"], "dom_idx": gang_tab["entry_dom_idx"],
+               "pin_dom": gang_tab["pin_dom"]}
+    T = entries["pod_idx"].shape[0]
+    G = min(1 << (max(1, _STEP_GROUP_GANG).bit_length() - 1), T)
+
+    def step(carry, eg):
+        outs = []
+        for g in range(G):
+            e = {k: v[g] for k, v in eg.items()}
+            carry, out = one_entry(carry, e)
+            outs.append(out)
+        return carry, tuple(jnp.stack([o[j] for o in outs])
+                            for j in range(3))
+
+    entries_g = {k: v.reshape((T // G, G) + v.shape[1:])
+                 for k, v in entries.items()}
+    (committed, _, _, _), (assign_e, score_e, ok_e) = lax.scan(
+        step, carry0, entries_g)
+    assign_e = assign_e.reshape(T)
+    score_e = score_e.reshape(T)
+    ok_e = ok_e.reshape(T)
+
+    # all-or-nothing mask: each gang's verdict is the carry's gang_ok AT
+    # ITS END ENTRY; scatter it over the gang's ids, gather per entry
+    # (unit ids are entry-stream positions, so T bounds them statically)
+    gang_id = gang_tab["gang_id"]
+    n_units = T
+    end = gang_tab["end"]
+    ok_units = jnp.zeros((n_units,), bool).at[
+        jnp.where(end, gang_id, n_units)].set(ok_e, mode="drop")
+    entry_ok = ok_units[jnp.minimum(gang_id, n_units - 1)]
+    assign_e = jnp.where(entry_ok, assign_e, jnp.int32(-1))
+
+    # entry axis -> pod axis
+    pod_idx = gang_tab["pod_idx"]
+    tgt = jnp.where(pod_idx >= 0, pod_idx, P)
+    assign = jnp.full((P,), -1, jnp.int32).at[tgt].set(
+        assign_e, mode="drop")
+    scores = jnp.full((P,), NEG, jnp.float32).at[tgt].set(
+        score_e, mode="drop")
+    return assign, scores, committed
+
+
+# ----------------------------------------------------------------- oracle
+
+def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
+                            usage: Dict[str, np.ndarray],
+                            pod_batch: Dict[str, np.ndarray],
+                            gang_tab: Dict[str, np.ndarray],
+                            nom: Dict[str, np.ndarray] = None
+                            ) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Host numpy mirror of gang_schedule_batch — same greedy order, same
+    f32 arithmetic, same tie-break — the parity oracle. Deliberately
+    written as the obvious nested loop over gangs and members."""
+    alloc = np.asarray(node_cfg["alloc"], np.float32)
+    max_pods = np.asarray(node_cfg["max_pods"], np.float32)
+    node_ok = np.asarray(node_cfg["node_ok"], bool)
+    node_valid = np.asarray(node_cfg["valid"], bool)
+    mem_pressure = np.asarray(node_cfg["mem_pressure"], bool)
+    N = alloc.shape[0]
+    P = np.asarray(pod_batch["req"]).shape[0]
+    used = np.asarray(usage["used"], np.float32).copy()
+    nz = np.asarray(usage["nonzero_used"], np.float32).copy()
+    cnt = np.asarray(usage["pod_count"], np.float32).copy()
+    reqs = np.asarray(pod_batch["req"], np.float32)
+    nzreqs = np.asarray(pod_batch["nonzero_req"], np.float32)
+    blocked = np.asarray(pod_batch["mem_pressure_blocked"], bool)
+    active = np.asarray(pod_batch["active"], bool)
+    seq = np.asarray(pod_batch["seq"], np.int64)
+    mask_idx = np.asarray(pod_batch["mask_idx"], np.int64)
+    score_idx = np.asarray(pod_batch["score_idx"], np.int64)
+    unique_masks = np.asarray(pod_batch["unique_masks"], bool)
+    unique_scores = np.asarray(pod_batch["unique_scores"], np.float32)
+    rw = np.asarray(pod_batch["resource_weights"], np.float32)
+    dom_tab = np.asarray(gang_tab["dom_tab"], np.int32)
+    cap_cpu = alloc[:, COL_CPU]
+    cap_mem = alloc[:, COL_MEM]
+    safe_cpu = np.maximum(cap_cpu, np.float32(1.0))
+    safe_mem = np.maximum(cap_mem, np.float32(1.0))
+    rows64 = np.arange(N, dtype=np.int64)
+    NEG32 = np.float32(NEG)
+    if nom is None:
+        nom_used = np.zeros_like(used)
+        nom_cnt = np.zeros_like(cnt)
+    else:
+        nom_used = np.asarray(nom["used"], np.float32)
+        nom_cnt = np.asarray(nom["count"], np.float32)
+    nom_row = np.asarray(pod_batch["nom_row"], np.int64)
+
+    assign = np.full((P,), -1, np.int32)
+    scores = np.full((P,), NEG32, np.float32)
+
+    # regroup the flattened entry stream back into units
+    units: list = []
+    gid = np.asarray(gang_tab["gang_id"])
+    pod_idx = np.asarray(gang_tab["pod_idx"])
+    entry_dom = np.asarray(gang_tab["entry_dom_idx"])
+    pin_dom = np.asarray(gang_tab["pin_dom"])
+    for t in range(len(pod_idx)):
+        if gang_tab["start"][t]:
+            units.append(([], int(entry_dom[t]), int(pin_dom[t]),
+                          int(gid[t])))
+        units[-1][0].append(int(pod_idx[t]))
+
+    for members, dom_idx, pin, _ in units:
+        trial_used = used.copy()
+        trial_nz = nz.copy()
+        trial_cnt = cnt.copy()
+        gang_dom = pin
+        gang_ok = True
+        placed: list = []
+        dom_row = dom_tab[max(dom_idx, 0)]
+        for i in members:
+            if i < 0:
+                continue
+            if dom_idx >= 0:
+                dmask = (dom_row >= 0) & ((gang_dom < 0)
+                                          | (dom_row == gang_dom))
+            else:
+                dmask = np.ones((N,), bool)
+            eff_used = trial_used + nom_used
+            eff_cnt = trial_cnt + nom_cnt
+            if nom_row[i] >= 0:
+                eff_used = eff_used.copy()
+                eff_cnt = eff_cnt.copy()
+                eff_used[nom_row[i]] -= reqs[i]
+                eff_cnt[nom_row[i]] -= np.float32(1.0)
+            fits = unique_masks[mask_idx[i]] & dmask & node_ok & node_valid
+            fits &= (reqs[i][None, :] + eff_used <= alloc).all(axis=1)
+            fits &= eff_cnt + np.float32(1.0) <= max_pods
+            if blocked[i]:
+                fits &= ~mem_pressure
+            # resource priorities, f32 like the kernel
+            req_cpu = trial_nz[:, 0] + nzreqs[i, 0]
+            req_mem = trial_nz[:, 1] + nzreqs[i, 1]
+            lr_c = np.where((cap_cpu > 0) & (req_cpu <= cap_cpu),
+                            np.floor((cap_cpu - req_cpu) * np.float32(10.0)
+                                     / safe_cpu), np.float32(0.0))
+            lr_m = np.where((cap_mem > 0) & (req_mem <= cap_mem),
+                            np.floor((cap_mem - req_mem) * np.float32(10.0)
+                                     / safe_mem), np.float32(0.0))
+            lr = np.floor((lr_c + lr_m) / np.float32(2.0))
+            cpu_frac = np.where(cap_cpu > 0, req_cpu / safe_cpu,
+                                np.float32(1.0))
+            mem_frac = np.where(cap_mem > 0, req_mem / safe_mem,
+                                np.float32(1.0))
+            ba = np.floor((np.float32(1.0) - np.abs(cpu_frac - mem_frac))
+                          * np.float32(10.0) + np.float32(4e-6))
+            ba = np.where((cpu_frac >= 1.0) | (mem_frac >= 1.0),
+                          np.float32(0.0), ba)
+            score = rw[0] * lr + rw[1] * ba + unique_scores[score_idx[i]]
+            masked = np.where(fits, score, NEG32)
+            h = ((rows64 * -1640531527 + int(seq[i]) * 40503)
+                 & 0xFFFF).astype(np.float32)
+            best = int(np.argmax(masked - h * np.float32(0.5 / 65536.0)))
+            ok = bool(fits[best]) and bool(active[i])
+            scores[i] = masked[best]
+            if ok:
+                placed.append((i, best))
+                trial_used[best] += reqs[i]
+                trial_nz[best] += nzreqs[i]
+                trial_cnt[best] += np.float32(1.0)
+                if dom_idx >= 0 and gang_dom < 0:
+                    gang_dom = int(dom_row[best])
+            else:
+                gang_ok = False
+        if gang_ok:
+            used, nz, cnt = trial_used, trial_nz, trial_cnt
+            for i, best in placed:
+                assign[i] = best
+    return assign, scores, {"used": used, "nonzero_used": nz,
+                            "pod_count": cnt}

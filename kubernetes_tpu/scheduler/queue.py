@@ -17,6 +17,15 @@ made it schedulable.
 The TPU extension over the reference is `pop_batch`: the batch collector
 drains up to B pods in one call instead of Pop()ing one, preserving the heap's
 priority-then-FIFO order — this is what feeds the pods-axis of the kernels.
+
+Gang awareness (`self.gang`, a scheduler.gang.GangManager): a popped pod
+whose PodGroup is below minMember is PARKED — it stays pending but leaves
+the active heap, so a starved gang cannot head-of-line-block the singleton
+pods behind it. The member arrival that completes the gang releases every
+parked member inside the same add() critical section, so the next
+pop_batch drains the whole gang as one batch. Parked members older than
+the park timeout cycle through the unschedulable/backoff machinery (the
+slow-path re-evaluation for PodGroups whose spec changed).
 """
 
 from __future__ import annotations
@@ -28,7 +37,9 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import helpers
 from ..api.core import Pod
+from ..api.scheduling import pod_group_key
 from ..utils.clock import Clock, REAL_CLOCK
+from .gang import PARK
 
 DEFAULT_UNSCHEDULABLE_DURATION = 60.0  # unschedulableQTimeInterval (:49-51)
 INITIAL_BACKOFF = 1.0                  # pod_backoff.go initialDuration
@@ -137,6 +148,11 @@ class SchedulingQueue:
         # (ref: activeQ.Update reorders the heap, scheduling_queue.go:268)
         self._active_entry: Dict[str, Tuple[int, float, int, str]] = {}
         self._in_backoff: set = set()
+        #: gang-parked pods: pending (in _pod_info) but held off the active
+        #: heap until their PodGroup reaches minMember (scheduler/gang.py)
+        self._parked: Dict[str, _PodInfo] = {}
+        #: GangManager, installed by the scheduler shell; None = no gating
+        self.gang = None
         self.backoff_map = PodBackoffMap(clock)
         self.nominated = NominatedPodMap()
         self._scheduling_cycle = 0
@@ -152,9 +168,36 @@ class SchedulingQueue:
             self._pod_info[key] = info
             self._unschedulable.pop(key, None)
             self._in_backoff.discard(key)
+            self._parked.pop(key, None)
             self._push_active(key, info)
             self.nominated.add(pod)
+            self._gang_notify_locked(pod)
             self._cond.notify_all()
+
+    def _gang_notify_locked(self, pod: Pod) -> None:
+        """Register a (re)pending pod with the gang manager; an arrival
+        that completes its gang releases the parked members right here, so
+        the whole gang is poppable before the lock drops."""
+        if self.gang is None:
+            return
+        for rkey in self.gang.pod_pending(pod):
+            parked = self._parked.pop(rkey, None)
+            if parked is not None:
+                self._push_active(rkey, parked)
+
+    def gang_group_changed(self, group_key: str) -> None:
+        """A PodGroup appeared or its spec changed: reactivate any parked
+        members its (new) minMember now admits."""
+        with self._cond:
+            if self.gang is None:
+                return
+            released = self.gang.group_changed(group_key)
+            for rkey in released:
+                parked = self._parked.pop(rkey, None)
+                if parked is not None:
+                    self._push_active(rkey, parked)
+            if released:
+                self._cond.notify_all()
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         with self._cond:
@@ -162,8 +205,21 @@ class SchedulingQueue:
             info = self._pod_info.get(key)
             if info is not None:
                 old_prio = helpers.pod_priority(info.pod)
+                prev_pod = info.pod
                 info.pod = new
                 self.nominated.add(new)
+                if self.gang is not None and \
+                        pod_group_key(prev_pod) != pod_group_key(new):
+                    # re-labeled into a different (or no) gang: purge the
+                    # old membership — its key would otherwise inflate the
+                    # old gang's member count forever — and reactivate a
+                    # parked pod so the pop gate re-evaluates it fresh
+                    self.gang.pod_gone(prev_pod)
+                    parked = self._parked.pop(key, None)
+                    if parked is not None:
+                        self._push_active(key, parked)
+                    self._gang_notify_locked(new)
+                    self._cond.notify_all()
                 if key in self._unschedulable and _spec_changed(old, new):
                     # updated pods get another chance immediately (:268-292)
                     del self._unschedulable[key]
@@ -187,6 +243,9 @@ class SchedulingQueue:
             self._in_active.discard(key)
             self._active_entry.pop(key, None)
             self._in_backoff.discard(key)
+            self._parked.pop(key, None)
+            if self.gang is not None:
+                self.gang.pod_gone(pod)
             self.nominated.delete(pod)
             self.backoff_map.clear(key)
 
@@ -245,18 +304,28 @@ class SchedulingQueue:
                     continue  # stale entry (pod deleted or re-prioritized)
                 self._in_active.discard(key)
                 del self._active_entry[key]
-                # popped pods leave the pending set; a failed attempt re-adds
-                # them via add_unschedulable_if_not_present (ref: Pop removes
-                # from activeQ; in-flight pods live only in the cycle)
-                info = self._pod_info.pop(key, None)
+                info = self._pod_info.get(key)
                 if info is None:
                     continue
                 if info.pod.metadata.deletion_timestamp is not None:
                     # deleting pods never schedule (ref: scheduleOne skips
                     # pods with a DeletionTimestamp, scheduler.go:445-455)
+                    del self._pod_info[key]
                     self.backoff_map.clear(key)
                     self.nominated.delete(info.pod)
                     continue
+                if self.gang is not None and \
+                        self.gang.pop_gate(info.pod) == PARK:
+                    # below-minMember gang member: hold it OUT of the heap
+                    # but keep it pending; the completing arrival (or a
+                    # PodGroup change) reactivates it. The pods behind it
+                    # keep popping — no head-of-line blocking.
+                    self._parked[key] = info
+                    continue
+                # popped pods leave the pending set; a failed attempt re-adds
+                # them via add_unschedulable_if_not_present (ref: Pop removes
+                # from activeQ; in-flight pods live only in the cycle)
+                del self._pod_info[key]
                 out.append(info.pod)
             if on_pop is not None and out:
                 on_pop(len(out))
@@ -284,6 +353,7 @@ class SchedulingQueue:
                 self._push_backoff(key)
             else:
                 self._unschedulable[key] = info
+            self._gang_notify_locked(pod)
             self._cond.notify_all()
 
     def _push_backoff(self, key: str) -> None:
@@ -328,6 +398,15 @@ class SchedulingQueue:
                     self._push_backoff(key)
                 else:
                     self._push_active(key, info)
+        if self.gang is not None and self._parked:
+            # starved gang slow path: long-parked members cycle through the
+            # standard backoff machinery (boosted, so repeats decay) and
+            # re-park on pop if their gang is still short
+            for key in self.gang.expired_parked(now):
+                info = self._parked.pop(key, None)
+                if info is not None:
+                    self.backoff_map.boost(key)
+                    self._push_backoff(key)
 
     # ----------------------------------------------------------- admin
 

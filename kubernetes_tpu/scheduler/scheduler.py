@@ -106,6 +106,35 @@ class Scheduler:
             # transaction order between them is immaterial)
             self._bind_pool = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="binder")
+        # gang scheduling: one GangManager drives the queue's admission
+        # gate, the all-or-nothing kernel routing, and the permit gate
+        # (scheduler/gang.py); PodGroup specs come straight off the informer
+        from ..api.scheduling import PodGroup
+        from ..utils.metrics import GangMetrics
+        from .gang import GangManager
+        pg_informer = self.informers.informer_for(PodGroup)
+        try:
+            self.gang_metrics = GangMetrics(self.metrics.registry)
+        except ValueError:
+            # a sibling scheduler shares this registry: keep our own
+            self.gang_metrics = GangMetrics()
+
+        def _node_label(node_name, label_key):
+            ni = self.algorithm.snapshot.node_infos.get(node_name)
+            if ni is None or ni.node is None:
+                return None
+            return ni.node.metadata.labels.get(label_key)
+        self.gang = GangManager(
+            lambda ns, name: pg_informer.indexer.get_by_key(f"{ns}/{name}"),
+            clock=clock, metrics=self.gang_metrics,
+            node_label=_node_label)
+        self.queue.gang = self.gang
+        self.algorithm.gang = self.gang
+        pg_informer.add_event_handlers(EventHandlers(
+            on_add=lambda pg: self.queue.gang_group_changed(
+                pg.metadata.key()),
+            on_update=lambda old, new: self.queue.gang_group_changed(
+                new.metadata.key())))
         from ..state.record import EventRecorder
         from .debugger import CacheDebugger
         #: correlating recorder (ref: client-go tools/record): dedup by
@@ -205,6 +234,9 @@ class Scheduler:
         if new.spec.node_name:
             if helpers.pod_is_terminal(new):
                 self.cache.remove_pod(new)
+                if self.gang is not None:
+                    # a terminal worker no longer completes its gang
+                    self.gang.pod_dropped(new)
             elif old.spec.node_name:
                 self.cache.update_pod(old, new)
             else:
@@ -221,6 +253,10 @@ class Scheduler:
     def _on_pod_delete(self, pod: Pod) -> None:
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
+            if self.gang is not None:
+                # prune the bound member: stale bound keys would let a
+                # re-created gang release partially against old counts
+                self.gang.pod_dropped(pod)
             self.queue.move_all_to_active_queue()
         else:
             self.queue.delete(pod)
@@ -231,6 +267,7 @@ class Scheduler:
                          timeout: float = 0.0) -> List[ScheduleResult]:
         """One scheduling cycle: drain a batch and decide it. Returns the
         results (callers: run loop, tests, benchmarks)."""
+        self._gang_housekeeping()
         cycle = self.queue.scheduling_cycle
         def _mark_in_flight(n: int) -> None:
             self._in_flight = n
@@ -300,6 +337,7 @@ class Scheduler:
         drain's own assumes (cache.mutation_seq bookkeeping), the previous
         batch could be repaired on host, static scores are in play, or
         device state was resized. Returns the number of pods bound."""
+        self._gang_housekeeping()
         start = self.scheduled_count
         prev: Optional[tuple] = None        # (PendingBatch, cycle)
         expected_seq: Optional[int] = None
@@ -418,7 +456,7 @@ class Scheduler:
         scheduleOne sees the pod; here bind is synchronous within the same
         cycle, so assume-after-bind exposes the same states to observers."""
         from ..state.store import ConflictError, NotFoundError
-        from .framework import PluginContext
+        from .framework import PluginContext, Status
         fresh: List[ScheduleResult] = []
         for res in bound:
             if self.cache.assigned_node(res.pod.metadata.key()) is not None:
@@ -427,16 +465,23 @@ class Scheduler:
                 # kernel double-counted it and no forget will repair that
                 self.algorithm.mirror.invalidate_usage()
                 continue
-            if any(v.persistent_volume_claim for v in res.pod.spec.volumes):
+            if self._pod_wants_volumes(res.pod):
                 # reserve PVs for unbound WaitForFirstConsumer claims before
                 # the pod is committed anywhere (ref: scheduler.go:499
-                # assumeVolumes before assume; bindVolumes :524 before bind)
+                # assumeVolumes before assume; bindVolumes :524 before bind).
+                # Gang members only ASSUME here (reversible): the PV API
+                # write is deferred past the permit gate — a timed-out
+                # gang's rollback could not undo it, and a PV pinned to the
+                # wrong ICI domain would wedge the gang's retry.
+                gang_member = self.gang is not None \
+                    and self.gang.is_member(res.pod)
                 ni = self.algorithm.snapshot.node_infos.get(res.node_name)
                 try:
                     if ni is None or ni.node is None:
                         raise ValueError(f"node {res.node_name} vanished")
                     self.volume_binder.assume_pod_volumes(res.pod, ni.node)
-                    self.volume_binder.bind_pod_volumes(res.pod)
+                    if not gang_member:
+                        self.volume_binder.bind_pod_volumes(res.pod)
                 except Exception:
                     # the kernel counted this pod as a winner; it will never
                     # be assumed — adopted device usage is unrepairable
@@ -445,15 +490,70 @@ class Scheduler:
                     self.queue.add_unschedulable_if_not_present(
                         res.pod, self.queue.scheduling_cycle)
                     continue
-            # Reserve then Prebind plugin points (ref: scheduler.go:507,:533
-            # — between host selection and assume/bind); a failure rejects
-            # the pod for this cycle. One context PER POD, matching the
-            # reference's per-scheduleOne pluginContext — plugins key their
-            # scratch by fixed names, so sharing across pods would leak
-            # one pod's reserve state into another's prebind
+            # Reserve -> Permit -> Prebind plugin points (ref:
+            # scheduler.go:507,:533 plus the later framework's Permit); a
+            # failure rejects the pod for this cycle. One context PER POD,
+            # matching the reference's per-scheduleOne pluginContext —
+            # plugins key their scratch by fixed names, so sharing across
+            # pods would leak one pod's reserve state into another's prebind
             ctx = PluginContext()
             st = self.framework.run_reserve_plugins(ctx, res.pod,
                                                     res.node_name)
+            if st.success:
+                st = self.framework.run_permit_plugins(ctx, res.pod,
+                                                       res.node_name)
+            if st.success and not st.is_wait:
+                gang_out = self._gang_permit(res)
+                if gang_out is not None:
+                    # the gang gate decided: [] = reserved & waiting for the
+                    # rest of the gang; otherwise the whole released gang
+                    # joins this bind transaction — ALL of it or NONE of it
+                    # (one failed member must not leave a 3-of-4 slice).
+                    # Reversible prebind plugins run first — the triggering
+                    # pod with its own cycle context, earlier-cycle members
+                    # with a fresh one (their reserve contexts are gone;
+                    # never leak this pod's scratch into theirs) — and only
+                    # then the deferred PV writes, so a plugin veto costs
+                    # nothing irreversible.
+                    fail_msg = None
+                    for r, clone in gang_out:
+                        rctx = ctx if r is res else PluginContext()
+                        st2 = self.framework.run_prebind_plugins(
+                            rctx, r.pod, r.node_name)
+                        if not st2.success:
+                            fail_msg = st2.message
+                            break
+                    if fail_msg is None:
+                        # RESIDUAL: PV writes commit member-by-member; a
+                        # mid-loop store failure (deleted-PV race) leaves
+                        # the earlier members' claims bound while the gang
+                        # rolls back — those members' retries are then
+                        # volume-pinned to the old slice. Rare enough that
+                        # a store-side multi-claim bind txn is left as
+                        # future work; the common veto (plugins) runs
+                        # before any write.
+                        for r, clone in gang_out:
+                            if not self._pod_wants_volumes(r.pod):
+                                continue
+                            try:
+                                self.volume_binder.bind_pod_volumes(r.pod)
+                            except Exception as e:
+                                fail_msg = str(e)
+                                break
+                    if fail_msg is None:
+                        fresh.extend(r for r, _ in gang_out)
+                    else:
+                        for r, clone in gang_out:
+                            self._gang_rollback_one(
+                                r.pod, clone,
+                                f"gang member rejected before bind: "
+                                f"{fail_msg}")
+                    continue
+            if st.is_wait:
+                # a generic permit plugin asked to wait: only the gang gate
+                # has release machinery — park the pod for this cycle
+                st = Status.error(st.message or "permit plugin asked to "
+                                  "wait without a gang release path")
             if st.success:
                 st = self.framework.run_prebind_plugins(ctx, res.pod,
                                                         res.node_name)
@@ -514,9 +614,11 @@ class Scheduler:
                     if self.cache.assigned_node(
                             out.metadata.key()) == res.node_name:
                         # our own bind's MODIFIED event raced ahead through
-                        # the informer thread: the cache already counts this
-                        # pod exactly once on the right node — nothing to fix
-                        pass
+                        # the informer thread, or this is a gang member's
+                        # permit-gate reservation: the cache already counts
+                        # the pod exactly once on the right node — just arm
+                        # the lost-confirmation TTL (no-op once confirmed)
+                        self.cache.finish_binding(out)
                     else:
                         # a true duplicate: the kernel counted this pod once
                         # more than assume/forget ever will — adopted device
@@ -524,6 +626,8 @@ class Scheduler:
                         self.algorithm.mirror.invalidate_usage()
                 else:
                     self.cache.finish_binding(out)
+                if self.gang is not None:
+                    self.gang.pod_bound(out)
                 self.scheduled_count += 1
                 self.metrics.schedule_attempts.inc(result="scheduled")
                 continue
@@ -531,9 +635,19 @@ class Scheduler:
             # no dirty row can repair its phantom usage on device
             # (tensorize.adopt_usage contract) — drop the adopted tensors
             self.algorithm.mirror.invalidate_usage()
+            if self.gang is not None and self.gang.is_member(res.pod):
+                # a released gang member's reservation is still assumed;
+                # drop it (dirty rows repair the mirror) before requeueing
+                self.gang.bind_failed(res.pod)
+                try:
+                    self.cache.forget_pod(res.pod)
+                except ValueError:
+                    pass
             if isinstance(out, (NotFoundError, ConflictError)):
                 # deleted while in flight, or a racing duplicate already
                 # bound it elsewhere: drop, don't requeue forever
+                if self.gang is not None:
+                    self.gang.pod_dropped(res.pod)
                 continue
             pod = res.pod
             self.metrics.schedule_attempts.inc(result="error")
@@ -602,11 +716,15 @@ class Scheduler:
         for (res, clone), out in zip(pairs, outs):
             if not isinstance(out, Exception):
                 self.cache.finish_binding(clone)
+                if self.gang is not None:
+                    self.gang.pod_bound(clone)
                 continue
             try:
                 self.cache.forget_pod(clone)
             except Exception:
                 pass
+            if self.gang is not None:
+                self.gang.bind_failed(res.pod)
             self.algorithm.mirror.invalidate_usage()
             with self._count_lock:
                 self.scheduled_count -= 1
@@ -633,6 +751,105 @@ class Scheduler:
                 pass
         return self.metrics.pod_scheduling_errors.value() > before
 
+    # ------------------------------------------------------------ gang
+
+    @staticmethod
+    def _pod_wants_volumes(pod: Pod) -> bool:
+        return any(v.persistent_volume_claim for v in pod.spec.volumes)
+
+    def _gang_permit(self, res: ScheduleResult):
+        """The gang permit gate for one winner. Returns None for non-gang
+        pods (normal flow), [] when the pod RESERVED its node (assumed in
+        the cache, bind deferred until the gang completes), or the list of
+        (ScheduleResult, reservation clone) for every released member —
+        the whole gang, ready to join this cycle's bind transaction."""
+        if self.gang is None or not self.gang.is_member(res.pod):
+            return None
+        from ..utils.trace import Trace
+        trace = Trace("gang_permit", pod=res.pod.metadata.name,
+                      node=res.node_name)
+        clone = serde.shallow_bind_clone(res.pod)
+        clone.spec.node_name = res.node_name
+        try:
+            # the RESERVATION: the gang member's space is held on its node
+            # so later batches cannot steal it while the rest of the gang
+            # is still scheduling (rolled back by expire on timeout)
+            self.cache.assume_pod(clone)
+        except ValueError:
+            if self.cache.assigned_node(
+                    clone.metadata.key()) != res.node_name:
+                # duplicate on another node: kernel double-counted
+                self.algorithm.mirror.invalidate_usage()
+                self.gang.pod_dropped(res.pod)
+                return []
+            # already reserved here (re-permit after a requeue race): fall
+            # through and let the gate recount it
+        trace.step("reservation assumed into cache")
+        decision, released = self.gang.permit(res.pod, clone, res.node_name)
+        trace.step(f"permit: {decision}, {len(released)} member(s) released")
+        trace.log_if_long(100.0)
+        if decision == "reject":
+            # the node breaks the gang's cross-batch ICI-domain pin: drop
+            # the reservation — cache clone AND the cycle's PV assumption,
+            # which would otherwise pin a PV outside the gang's slice —
+            # and retry; the next launch seeds the kernel with the pin
+            try:
+                self.cache.forget_pod(clone)
+            except ValueError:
+                pass
+            self.volume_binder.forget_pod_volumes(res.pod)
+            self.queue.add(res.pod)
+            return []
+        if decision == "wait":
+            return []
+        out = []
+        for rpod, rclone, rnode in released:
+            if rpod.metadata.key() == res.pod.metadata.key():
+                out.append((res, rclone))
+            else:
+                out.append((ScheduleResult(rpod, rnode), rclone))
+        return out
+
+    def _gang_rollback_one(self, pod: Pod, clone: Pod, message: str) -> None:
+        """A released member failed prebind: drop its reservation and park
+        it; assume/forget dirty rows repair the device mirror."""
+        try:
+            self.cache.forget_pod(clone)
+        except ValueError:
+            pass
+        if self.gang is not None:
+            self.gang.bind_failed(pod)
+        self.volume_binder.forget_pod_volumes(pod)
+        self._record_event(pod, "FailedScheduling", message)
+        self.queue.add_unschedulable_if_not_present(
+            pod, self.queue.scheduling_cycle)
+
+    def _gang_housekeeping(self) -> None:
+        """Roll back permit-gate reservations whose gang missed its
+        scheduleTimeoutSeconds: the WHOLE gang's assumed pods leave the
+        cache in one sweep (forget bumps node generations, so the next
+        dirty scatter repairs device usage) and the members requeue."""
+        if self.gang is None:
+            return
+        rollbacks, requeue = self.gang.expire(self.clock.now())
+        if not rollbacks and not requeue:
+            return
+        from ..utils.trace import Trace
+        trace = Trace("gang_rollback", reservations=len(rollbacks))
+        self.cache.forget_pods([clone for _, clone in rollbacks])
+        trace.step("gang reservations rolled back from the cache")
+        cycle = self.queue.scheduling_cycle
+        for pod in requeue:
+            # assumed volume state is reversible — the PV API write was
+            # deferred past the permit gate, so this undoes everything
+            self.volume_binder.forget_pod_volumes(pod)
+            self._record_event(
+                pod, "FailedScheduling",
+                "gang permit wait timed out; reservations rolled back")
+            self.queue.add_unschedulable_if_not_present(pod, cycle)
+        trace.step("members requeued")
+        trace.log_if_long(100.0)
+
     def _handle_unschedulable(self, pod: Pod, cycle: int) -> None:
         self.unschedulable_count += 1
         self.metrics.schedule_attempts.inc(result="unschedulable")
@@ -652,6 +869,11 @@ class Scheduler:
         tensors (BatchScheduler._nominated_device) shield the freed space
         until it lands."""
         if self.disable_preemption:
+            return
+        if self.gang is not None and self.gang.is_member(pod):
+            # single-member preemption cannot help a gang (evicting for one
+            # worker leaves the gang short anyway) — whole-gang preemption
+            # is an open roadmap item
             return
         try:
             plan = self.algorithm.preempt(pod)

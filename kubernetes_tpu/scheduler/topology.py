@@ -458,6 +458,17 @@ class TopologyIndex:
     def has_dom_vec(self, tk: str) -> np.ndarray:
         return self._node_dom_vec(tk) >= 0
 
+    def node_domain_vector(self, tk: str) -> np.ndarray:
+        """[capacity] int32 node-row -> topology-domain id for `tk` (-1
+        where the node lacks the label). The gang scheduler's ICI-domain
+        constraint (kernels/gang.py) rides the same incrementally-
+        maintained node→domain arrays the (anti-)affinity masks gather
+        over. Forces activation: domain interning needs per-node records
+        even in an affinity-free cluster."""
+        self._activate()
+        self._doms.setdefault(tk, {})
+        return self._node_dom_vec(tk)
+
     def required_masks(self, profiles: List[AffinityProfile]) -> np.ndarray:
         """[U, capacity] bool — each profile's feasible-node mask. Routes
         through the device matmul kernel (kernels/affinity.py) when

@@ -484,6 +484,10 @@ class Client:
         from ..api.core import ServiceAccount
         return self.resource(ServiceAccount, namespace)
 
+    def pod_groups(self, namespace: Optional[str] = None) -> ResourceClient:
+        from ..api.scheduling import PodGroup
+        return self.resource(PodGroup, namespace)
+
     def roles(self, namespace: Optional[str] = None) -> ResourceClient:
         from ..api.rbac import Role
         return self.resource(Role, namespace)

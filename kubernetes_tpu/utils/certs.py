@@ -4,6 +4,12 @@ Ref: the reference's cert machinery spread over cmd/kubeadm/app/phases/
 certs, staging/src/k8s.io/client-go/util/cert and
 pkg/controller/certificates/signer. Backed by the `cryptography` package;
 PEM in, PEM out so the artifacts interoperate with openssl.
+
+`cryptography` is an OPTIONAL dependency: this module (and everything
+that imports it — the CSR controllers, kubeadm, the x509 authenticator)
+must stay importable without it, so the import is deferred to first use
+and every entry point raises a clear ImportError via require() instead of
+failing at import time. Tests skip on HAVE_CRYPTOGRAPHY.
 """
 
 from __future__ import annotations
@@ -11,12 +17,25 @@ from __future__ import annotations
 import datetime
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - exercised in slim containers
+    HAVE_CRYPTOGRAPHY = False
 
 _ONE_DAY = datetime.timedelta(days=1)
+
+
+def require() -> None:
+    """Raise a clear error where a PKI operation actually needs the
+    optional dependency (import keeps working without it)."""
+    if not HAVE_CRYPTOGRAPHY:
+        raise ImportError(
+            "the 'cryptography' package is required for certificate "
+            "operations but is not installed")
 
 
 def _key() -> rsa.RSAPrivateKey:
@@ -37,6 +56,7 @@ def _pem_cert(cert) -> bytes:
 def new_ca(common_name: str = "kubernetes-ca",
            days: int = 3650) -> Tuple[bytes, bytes]:
     """(cert_pem, key_pem) for a self-signed CA."""
+    require()
     key = _key()
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
     now = datetime.datetime.now(datetime.timezone.utc)
@@ -59,6 +79,7 @@ def issue_cert(ca_cert_pem: bytes, ca_key_pem: bytes, common_name: str,
                ) -> Tuple[bytes, bytes]:
     """(cert_pem, key_pem) signed by the CA. CN -> user name, O -> groups
     (the reference's x509 authenticator mapping)."""
+    require()
     ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
     ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
     key = _key()
@@ -111,6 +132,7 @@ def new_csr(common_name: str,
     CertificateSigningRequest. Serving CSRs carry the node's
     hostnames/IPs as SubjectAlternativeNames (ref: the kubelet's
     certificate.Manager requests SANs for kubelet-serving)."""
+    require()
     key = _key()
     name = x509.Name(
         [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
@@ -128,6 +150,7 @@ def sign_csr(ca_cert_pem: bytes, ca_key_pem: bytes, csr_pem: bytes,
              days: int = 365, server: bool = False) -> bytes:
     """cert_pem for a CSR, preserving its subject (the csrsigning
     controller's core)."""
+    require()
     ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
     ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
     csr = x509.load_pem_x509_csr(csr_pem)
@@ -173,16 +196,19 @@ def _subject(name: x509.Name) -> Tuple[str, Tuple[str, ...]]:
 def subject_of(cert_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
     """(common_name, organizations) — the x509 authenticator's user
     mapping (ref: authentication/request/x509: CommonNameUserConversion)."""
+    require()
     return _subject(x509.load_pem_x509_certificate(cert_pem).subject)
 
 
 def csr_subject_of(csr_pem: bytes) -> Tuple[str, Tuple[str, ...]]:
+    require()
     return _subject(x509.load_pem_x509_csr(csr_pem).subject)
 
 
 def ca_cert_hash(ca_cert_pem: bytes) -> str:
     """kubeadm's discovery-token-ca-cert-hash: sha256 over the CA's
     SubjectPublicKeyInfo DER (ref: kubeadm pubkeypin)."""
+    require()
     import hashlib
     cert = x509.load_pem_x509_certificate(ca_cert_pem)
     spki = cert.public_key().public_bytes(
@@ -193,6 +219,7 @@ def ca_cert_hash(ca_cert_pem: bytes) -> str:
 
 def csr_sans_of(csr_pem: bytes) -> Tuple[str, ...]:
     """Requested SubjectAlternativeNames (DNS names + IPs as strings)."""
+    require()
     csr = x509.load_pem_x509_csr(csr_pem)
     try:
         san = csr.extensions.get_extension_for_class(

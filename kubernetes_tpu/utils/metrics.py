@@ -192,6 +192,34 @@ class Histogram(_Metric):
         return out
 
 
+class GangMetrics:
+    """Gang-scheduling metric families (PodGroup coscheduling). Kept here
+    with the metric core — the gang gate lives below the scheduler package
+    and the controller manager samples the same families — registered into
+    the caller's registry so they ride the same /metrics exposition."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: gangs currently held below minMember (queue gate) or waiting at
+        #: the permit gate, respectively
+        self.gangs_pending = r.gauge(
+            "scheduler_gangs_pending",
+            "PodGroups with members held back by the gang gate, by stage")
+        self.gangs_admitted = r.counter(
+            "scheduler_gangs_admitted_total",
+            "PodGroups whose full gang passed the permit gate and bound")
+        self.gangs_timed_out = r.counter(
+            "scheduler_gangs_timed_out_total",
+            "PodGroups whose permit wait expired; reservations rolled back")
+        self.gangs_rejected = r.counter(
+            "scheduler_gangs_rejected_total",
+            "Gangs the all-or-nothing kernel could not place atomically")
+        self.gang_permit_wait = r.histogram(
+            "scheduler_gang_permit_wait_seconds",
+            "Seconds a gang member held a reservation at the permit gate")
+
+
 class Registry:
     """Metric family registry with /metrics text exposition."""
 

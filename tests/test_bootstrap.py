@@ -13,6 +13,12 @@ import pytest
 from kubernetes_tpu import api
 from kubernetes_tpu.utils import certs as certutil
 
+# every flow here mints or verifies certificates; without the optional
+# `cryptography` package they can only fail at the PKI call site
+pytestmark = pytest.mark.skipif(
+    not certutil.HAVE_CRYPTOGRAPHY,
+    reason="optional dependency 'cryptography' is not installed")
+
 
 class TestCertHelpers:
     def test_ca_issue_subject_roundtrip(self):

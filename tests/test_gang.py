@@ -1,0 +1,539 @@
+"""Gang scheduling tests: PodGroup API, queue admission gate, all-or-nothing
+kernel parity against the scalar reference, permit-gate reservations with
+timeout rollback, and the PodGroupController phase machine.
+
+The acceptance invariants:
+  - a gang whose members cannot all place simultaneously binds ZERO pods
+  - the batched all-or-nothing kernel matches the scalar reference on
+    randomized pods x nodes x gangs instances
+  - a starved gang never head-of-line-blocks singleton pods
+  - permit-timeout rolls every reservation back out of the scheduler cache
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.api.scheduling import (PHASE_FAILED, PHASE_PENDING,
+                                           PHASE_RUNNING, PHASE_SCHEDULING,
+                                           PodGroup, PodGroupSpec,
+                                           pod_group_key)
+from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.scheduler.gang import ADMIT, PARK, GangManager
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.state import Client, SharedInformerFactory
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def make_pod(name, cpu="100m", mem="200Mi", ns="default", group=None,
+             phase=None, node=""):
+    labels = {LABEL_POD_GROUP: group} if group else {}
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(
+            node_name=node,
+            containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity(cpu),
+                              "memory": Quantity(mem)}))]))
+    if phase:
+        pod.status.phase = phase
+    return pod
+
+
+def make_node(name, cpu="4", mem="32Gi", pods=110, labels=None):
+    alloc = {"cpu": Quantity(cpu), "memory": Quantity(mem),
+             "pods": Quantity(pods)}
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        spec=api.NodeSpec(),
+        status=api.NodeStatus(
+            capacity=dict(alloc), allocatable=dict(alloc),
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def make_group(name, min_member, topology_key="", timeout=60):
+    return PodGroup(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=PodGroupSpec(min_member=min_member, topology_key=topology_key,
+                          schedule_timeout_seconds=timeout))
+
+
+def wait_until(fn, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return fn()
+
+
+# ----------------------------------------------------------------- API
+
+
+class TestPodGroupAPI:
+    def test_roundtrip_and_validation(self):
+        from kubernetes_tpu.api import serde, validation
+        pg = make_group("g", 4, topology_key="cloud.google.com/tpu-slice")
+        assert serde.decode(PodGroup, serde.encode(pg)) == pg
+        validation.validate(pg)
+        bad = serde.deepcopy_obj(pg)
+        bad.spec.min_member = 0
+        with pytest.raises(validation.ValidationError):
+            validation.validate(bad)
+        bad2 = serde.deepcopy_obj(pg)
+        bad2.status.phase = "Bogus"
+        with pytest.raises(validation.ValidationError):
+            validation.validate(bad2)
+
+    def test_client_and_scheme(self):
+        client = Client()
+        client.pod_groups("default").create(make_group("g1", 3))
+        assert client.pod_groups("default").get("g1").spec.min_member == 3
+
+    def test_pod_group_key(self):
+        assert pod_group_key(make_pod("p", group="g1")) == "default/g1"
+        assert pod_group_key(make_pod("p")) is None
+
+
+# ------------------------------------------------------- queue admission
+
+
+class TestGangQueueGate:
+    def _queue(self, groups, clock=None):
+        clock = clock or FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.gang = GangManager(
+            lambda ns, name: groups.get((ns, name)), clock=clock)
+        return q, clock
+
+    def test_parked_until_min_member_and_no_hol_blocking(self):
+        groups = {("default", "g1"): make_group("g1", 3)}
+        q, _ = self._queue(groups)
+        q.add(make_pod("m1", group="g1"))
+        q.add(make_pod("m2", group="g1"))
+        q.add(make_pod("solo"))
+        # the two gang members are ahead of 'solo' in FIFO order but must
+        # not block it; they park and stay pending
+        out = q.pop_batch(10, timeout=0)
+        assert [p.metadata.name for p in out] == ["solo"]
+        assert q.num_pending() == 2
+        # the completing member releases the whole gang into one batch
+        q.add(make_pod("m3", group="g1"))
+        out = q.pop_batch(10, timeout=0)
+        assert sorted(p.metadata.name for p in out) == ["m1", "m2", "m3"]
+        assert q.num_pending() == 0
+
+    def test_missing_pod_group_parks(self):
+        q, _ = self._queue({})
+        q.add(make_pod("m1", group="ghost"))
+        assert q.pop_batch(10, timeout=0) == []
+        assert q.num_pending() == 1
+
+    def test_group_changed_releases(self):
+        groups = {("default", "g1"): make_group("g1", 5)}
+        q, _ = self._queue(groups)
+        q.add(make_pod("m1", group="g1"))
+        q.add(make_pod("m2", group="g1"))
+        assert q.pop_batch(10, timeout=0) == []
+        groups[("default", "g1")].spec.min_member = 2
+        q.gang_group_changed("default/g1")
+        out = q.pop_batch(10, timeout=0)
+        assert sorted(p.metadata.name for p in out) == ["m1", "m2"]
+
+    def test_starved_gang_cycles_through_backoff(self):
+        groups = {("default", "g1"): make_group("g1", 2)}
+        q, clock = self._queue(groups)
+        q.add(make_pod("m1", group="g1"))
+        assert q.pop_batch(10, timeout=0) == []
+        # long-parked members move to the backoff machinery but stay
+        # pending, and still schedule once the gang completes
+        clock.step(61)
+        assert q.pop_batch(10, timeout=0) == []
+        assert q.num_pending() == 1
+        clock.step(61)
+        q.add(make_pod("m2", group="g1"))
+        popped = []
+        for _ in range(10):
+            popped += q.pop_batch(10, timeout=0)
+            if len(popped) == 2:
+                break
+            clock.step(11)
+        assert sorted(p.metadata.name for p in popped) == ["m1", "m2"]
+
+
+# ------------------------------------------------------ permit gate unit
+
+
+class TestPermitGate:
+    def test_wait_then_allow_then_expire(self):
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 2, timeout=30)}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        decision, released = gm.permit(m1, m1, "n1")
+        assert decision == "wait" and released == []
+        decision, released = gm.permit(m2, m2, "n2")
+        assert decision == "allow"
+        assert sorted(p.metadata.name for p, _, _ in released) == ["m1", "m2"]
+        # nothing left waiting -> expire is a no-op
+        clock.step(1000)
+        assert gm.expire(clock.now()) == ([], [])
+
+    def test_deleted_bound_members_do_not_satisfy_a_recreated_gang(self):
+        """Regression: bound keys must be pruned when their pods leave the
+        cluster, or a re-created gang's first winner would be released
+        alone against stale reserved counts."""
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 2)}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        gm.permit(m1, m1, "n1")
+        decision, _ = gm.permit(m2, m2, "n2")
+        assert decision == "allow"
+        # the first generation binds, then its pods are deleted
+        gm.pod_bound(m1)
+        gm.pod_bound(m2)
+        gm.pod_dropped(m1)
+        gm.pod_dropped(m2)
+        assert not gm._gangs  # state fully collected
+        # generation two: one winner must WAIT, not release alone
+        m1b = make_pod("m1", group="g1")
+        decision, released = gm.permit(m1b, m1b, "n1")
+        assert decision == "wait" and released == []
+
+    def test_cross_batch_reservations_agree_on_one_domain(self):
+        """Regression: the kernel pins an ICI domain only within one
+        batch; the permit gate must refuse a straggler reserving on a
+        different slice, and batch_groups must expose the pin so the next
+        kernel launch converges into the reserved domain."""
+        clock = FakeClock()
+        groups = {("default", "g1"):
+                  make_group("g1", 2, topology_key="tpu/slice")}
+        slice_of = {"n1": "a", "n2": "b", "n3": "a"}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock,
+                         node_label=lambda node, key: slice_of.get(node))
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        assert gm.permit(m1, m1, "n1")[0] == "wait"   # pins slice "a"
+        # the next batch sees the pin
+        units = gm.batch_groups([m2])
+        assert units is not None and units[0][3] == "a"
+        # a reservation on slice "b" is refused outright
+        assert gm.permit(m2, m2, "n2")[0] == "reject"
+        # ... and one on slice "a" completes the gang
+        decision, released = gm.permit(m2, m2, "n3")
+        assert decision == "allow" and len(released) == 2
+
+    def test_label_change_purges_old_gang_membership(self):
+        """Regression: re-labeling a pending pod out of its gang must not
+        leave a phantom member inflating the old gang's count."""
+        groups = {("default", "g1"): make_group("g1", 2)}
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.gang = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        pod = make_pod("m1", group="g1")
+        q.add(pod)
+        assert q.pop_batch(10, timeout=0) == []   # parked below minMember
+        relabeled = make_pod("m1")                # label removed
+        q.update(pod, relabeled)
+        # now a singleton: reactivated and poppable
+        out = q.pop_batch(10, timeout=0)
+        assert [p.metadata.name for p in out] == ["m1"]
+        # the old gang must not count the phantom: one real member is
+        # still below minMember and parks
+        q.add(make_pod("m2", group="g1"))
+        assert q.pop_batch(10, timeout=0) == []
+        assert q.num_pending() == 1
+
+    def test_expire_rolls_back_whole_gang(self):
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 3, timeout=30)}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        assert gm.permit(m1, m1, "n1")[0] == "wait"
+        clock.step(10)
+        assert gm.permit(m2, m2, "n2")[0] == "wait"
+        # timeout counts from the FIRST reservation
+        clock.step(25)
+        rollbacks, requeue = gm.expire(clock.now())
+        assert sorted(p.metadata.name for p, _ in rollbacks) == ["m1", "m2"]
+        assert sorted(p.metadata.name for p in requeue) == ["m1", "m2"]
+
+
+# -------------------------------------------------------- kernel parity
+
+
+def _random_instance(rng, N, P, gang_sizes, constrained, n_domains=3):
+    R = 3
+    node_cfg = {
+        "alloc": rng.uniform(1000, 8000, (N, R)).astype(np.float32),
+        "max_pods": np.full((N,), 10, np.float32),
+        "node_ok": rng.random(N) > 0.05,
+        "mem_pressure": rng.random(N) > 0.9,
+        "valid": np.ones((N,), bool),
+    }
+    usage = {
+        "used": rng.uniform(0, 2000, (N, R)).astype(np.float32),
+        "nonzero_used": rng.uniform(0, 2000, (N, 2)).astype(np.float32),
+        "pod_count": rng.integers(0, 5, (N,)).astype(np.float32),
+    }
+    U = 3
+    pod_batch = {
+        "req": rng.uniform(100, 2500, (P, R)).astype(np.float32),
+        "nonzero_req": rng.uniform(100, 2500, (P, 2)).astype(np.float32),
+        "mem_pressure_blocked": rng.random(P) > 0.8,
+        "active": np.ones((P,), bool),
+        "seq": np.arange(P, dtype=np.int32),
+        "mask_idx": rng.integers(0, U, (P,)).astype(np.int32),
+        "score_idx": np.zeros((P,), np.int32),
+        "nom_row": np.full((P,), -1, np.int32),
+        "unique_masks": rng.random((U, N)) > 0.2,
+        "unique_scores": np.zeros((1, N), np.float32),
+        "resource_weights": np.ones((2,), np.float32),
+    }
+    dom_tab = rng.integers(-1, n_domains, (1, N)).astype(np.int32)
+    pod_idx = np.full((P,), -1, np.int32)
+    start = np.zeros((P,), bool)
+    end = np.zeros((P,), bool)
+    gang_id = np.arange(P, dtype=np.int32)
+    entry_dom = np.full((P,), -1, np.int32)
+    t = u = 0
+    order = list(rng.permutation(P))
+    for gi, sz in enumerate(gang_sizes):
+        members = [order.pop() for _ in range(sz)]
+        d = 0 if gi in constrained else -1
+        for j, i in enumerate(members):
+            pod_idx[t] = i
+            start[t] = j == 0
+            end[t] = j == sz - 1
+            gang_id[t] = u
+            entry_dom[t] = d
+            t += 1
+        u += 1
+    for i in order:
+        pod_idx[t] = i
+        start[t] = end[t] = True
+        gang_id[t] = u
+        t += 1
+        u += 1
+    start[t:] = True
+    end[t:] = True
+    gang_tab = {"pod_idx": pod_idx, "start": start, "end": end,
+                "gang_id": gang_id, "entry_dom_idx": entry_dom,
+                "pin_dom": np.full((P,), -1, np.int32),
+                "dom_tab": dom_tab}
+    return node_cfg, usage, pod_batch, gang_tab
+
+
+class TestKernelParity:
+    def test_randomized_gangs_match_scalar_reference(self):
+        import jax.numpy as jnp
+        from kubernetes_tpu.scheduler.kernels.gang import (
+            gang_schedule_batch, gang_schedule_reference)
+        dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+        for seed in range(10):
+            rng = np.random.default_rng(seed)
+            inst = _random_instance(rng, N=16, P=16,
+                                    gang_sizes=(4, 3, 2, 1),
+                                    constrained=(0, 2))
+            nc, us, pb, gt = inst
+            if seed % 2:
+                # pre-pinned domain (a split gang's earlier reservations)
+                gt["pin_dom"] = np.where(gt["entry_dom_idx"] >= 0, 1,
+                                         -1).astype(np.int32)
+            nom = None
+            if seed % 3 == 0:
+                # phantom nominated reservations, with some pods holding
+                # their own nomination (self-subtraction path)
+                nom = {"used": rng.uniform(0, 800, (16, 3))
+                       .astype(np.float32),
+                       "count": rng.integers(0, 2, (16,))
+                       .astype(np.float32)}
+                pb["nom_row"][:4] = rng.integers(0, 16, (4,))
+            a_ref, s_ref, u_ref = gang_schedule_reference(nc, us, pb, gt,
+                                                          nom)
+            a_k, s_k, u_k = gang_schedule_batch(
+                dev(nc), dev(us), dev(pb), dev(gt),
+                None if nom is None else dev(nom))
+            a_k = np.asarray(a_k)
+            assert (a_k == a_ref).all(), f"seed {seed} assignment mismatch"
+            m = a_ref >= 0
+            assert np.allclose(np.asarray(s_k)[m], s_ref[m]), seed
+            for key in u_ref:
+                assert np.allclose(np.asarray(u_k[key]), u_ref[key]), \
+                    (seed, key)
+
+    def test_all_or_nothing_in_kernel(self):
+        """A gang with one impossible member places nobody, and the usage
+        tensors stay untouched by its trial placements."""
+        import jax.numpy as jnp
+        from kubernetes_tpu.scheduler.kernels.gang import (
+            gang_schedule_batch, gang_schedule_reference)
+        rng = np.random.default_rng(7)
+        nc, us, pb, gt = _random_instance(rng, N=16, P=16,
+                                          gang_sizes=(4,), constrained=())
+        # every node refuses the gang's LAST member via its mask row
+        last = gt["pod_idx"][3]
+        pb["mask_idx"][last] = 2
+        pb["unique_masks"][2] = False
+        a_ref, _, u_ref = gang_schedule_reference(nc, us, pb, gt)
+        members = gt["pod_idx"][:4]
+        assert (a_ref[members] == -1).all()
+        dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+        a_k, _, u_k = gang_schedule_batch(dev(nc), dev(us), dev(pb), dev(gt))
+        assert (np.asarray(a_k)[members] == -1).all()
+        for key in u_ref:
+            assert np.allclose(np.asarray(u_k[key]), u_ref[key])
+
+    def test_gang_feasible_reduction(self):
+        import jax.numpy as jnp
+        from kubernetes_tpu.scheduler.kernels.gang import gang_feasible
+        fits = np.zeros((4, 5), bool)
+        fits[0, 1] = fits[1, 2] = fits[3, 0] = True  # pod 2 fits nowhere
+        members = np.array([[0, 1, -1], [2, 3, -1], [0, -1, -1]], np.int32)
+        out = np.asarray(gang_feasible(jnp.asarray(fits),
+                                       jnp.asarray(members)))
+        assert out.tolist() == [True, False, True]
+
+
+# ----------------------------------------------------------- end to end
+
+
+class TestGangEndToEnd:
+    def test_partial_gang_binds_zero_pods(self):
+        """ACCEPTANCE: a gang whose members cannot all place binds NOTHING,
+        while a singleton on the same cluster still schedules."""
+        client = Client()
+        # two nodes, one 600m slot each: a 3-member gang of 600m pods can
+        # place at most 2 members -> must bind zero
+        client.nodes().create(make_node("n1", cpu="1", mem="2Gi"))
+        client.nodes().create(make_node("n2", cpu="1", mem="2Gi"))
+        client.pod_groups("default").create(make_group("g1", 3))
+        sched = Scheduler(client, batch_size=16)
+        sched.start()
+        try:
+            for i in range(3):
+                client.pods().create(
+                    make_pod(f"w{i}", cpu="600m", group="g1"))
+            client.pods().create(make_pod("solo", cpu="100m"))
+            assert wait_until(
+                lambda: client.pods().get("solo").spec.node_name)
+            time.sleep(0.5)  # give the gang every chance to (mis)bind
+            bound = [p.metadata.name for p in client.pods().list()
+                     if p.spec.node_name]
+            assert bound == ["solo"], bound
+            assert sched.gang_metrics.gangs_rejected.value() >= 1
+            # no leaked reservations: the cache holds only the singleton
+            confirmed, assumed = sched.cache.pod_keys_snapshot()
+            assert not assumed
+        finally:
+            sched.stop()
+
+    def test_full_gang_lands_in_one_topology_domain(self):
+        client = Client()
+        for i in range(4):
+            client.nodes().create(make_node(
+                f"n{i}", labels={"tpu/slice": "a" if i < 2 else "b"}))
+        # one node lacks the label entirely: never eligible for the gang
+        client.nodes().create(make_node("plain"))
+        client.pod_groups("default").create(
+            make_group("g1", 3, topology_key="tpu/slice"))
+        sched = Scheduler(client, batch_size=16)
+        sched.start()
+        try:
+            for i in range(3):
+                client.pods().create(make_pod(f"w{i}", group="g1"))
+            assert wait_until(lambda: all(
+                p.spec.node_name for p in client.pods().list()))
+            nodes = [client.pods().get(f"w{i}").spec.node_name
+                     for i in range(3)]
+            slices = {client.nodes().get(n).metadata.labels["tpu/slice"]
+                      for n in nodes}
+            assert len(slices) == 1, nodes
+            assert sched.gang_metrics.gangs_admitted.value() >= 1
+        finally:
+            sched.stop()
+
+    def test_permit_timeout_rolls_back_reservations(self):
+        """ACCEPTANCE: reservations roll back on permit timeout. With
+        batch_size=1 the gang straddles batches; the placeable member
+        reserves its node, the impossible member never arrives, and the
+        timeout frees the reservation (cache back to zero assumed pods)."""
+        client = Client()
+        client.nodes().create(make_node("n1", cpu="1", mem="2Gi"))
+        client.pod_groups("default").create(make_group("g1", 2, timeout=1))
+        sched = Scheduler(client, batch_size=1)
+        sched.start()
+        try:
+            client.pods().create(make_pod("fits", cpu="600m", group="g1"))
+            # admissible (2 pending) but this member can never place
+            client.pods().create(make_pod("never", cpu="30", group="g1"))
+            # the placeable member must reach the reserved state...
+            assert wait_until(
+                lambda: sched.cache.pod_keys_snapshot()[1], timeout=60)
+            # ...and the permit timeout must roll it back
+            assert wait_until(
+                lambda: not sched.cache.pod_keys_snapshot()[1], timeout=60)
+            assert not client.pods().get("fits").spec.node_name
+            assert not client.pods().get("never").spec.node_name
+            assert sched.gang_metrics.gangs_timed_out.value() >= 1
+        finally:
+            sched.stop()
+
+
+# ------------------------------------------------------------ controller
+
+
+class TestPodGroupController:
+    def _sync(self, client, key="default/g1"):
+        from kubernetes_tpu.controllers.podgroup import PodGroupController
+        informers = SharedInformerFactory(client)
+        ctl = PodGroupController(client, informers)
+        informers.start()
+        informers.wait_for_cache_sync()
+        try:
+            ctl.sync(key)
+        finally:
+            informers.stop()
+        return client.pod_groups("default").get("g1")
+
+    def test_phase_pending(self):
+        client = Client()
+        client.pod_groups("default").create(make_group("g1", 3))
+        client.pods().create(make_pod("w0", group="g1"))
+        pg = self._sync(client)
+        assert pg.status.phase == PHASE_PENDING
+
+    def test_phase_scheduling_then_running(self):
+        client = Client()
+        client.pod_groups("default").create(make_group("g1", 2))
+        client.pods().create(make_pod("w0", group="g1", node="n1"))
+        client.pods().create(make_pod("w1", group="g1"))
+        pg = self._sync(client)
+        assert pg.status.phase == PHASE_SCHEDULING
+        assert pg.status.scheduled == 1
+
+        def run(cur):
+            cur.status.phase = "Running"
+            return cur
+        client.pods().patch("w0", run)
+        client.pods().patch("w1", run)
+        pg = self._sync(client)
+        assert pg.status.phase == PHASE_RUNNING
+        assert pg.status.running == 2
+
+    def test_phase_failed_when_min_member_unreachable(self):
+        client = Client()
+        client.pod_groups("default").create(make_group("g1", 2))
+        client.pods().create(make_pod("w0", group="g1", node="n1",
+                                      phase="Failed"))
+        client.pods().create(make_pod("w1", group="g1", node="n1",
+                                      phase="Running"))
+        pg = self._sync(client)
+        assert pg.status.phase == PHASE_FAILED
+        assert pg.status.failed == 1

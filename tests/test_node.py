@@ -560,6 +560,8 @@ class TestMiscControllers:
                                                      RootCACertPublisher)
         from kubernetes_tpu.state import SharedInformerFactory
         from kubernetes_tpu.utils import certs
+        if not certs.HAVE_CRYPTOGRAPHY:
+            pytest.skip("optional dependency 'cryptography' not installed")
         client = Client()
         informers = SharedInformerFactory(client)
         ca_cert, _ = certs.new_ca()
