@@ -55,6 +55,10 @@ class PodGroupStatus:
     running: int = 0
     succeeded: int = 0
     failed: int = 0
+    #: times the controller rebuilt this gang from Failed back to Pending
+    #: (every member recreated as a unit after a node death or member
+    #: crash wedged the slice)
+    resubmissions: int = 0
 
 
 @dataclass

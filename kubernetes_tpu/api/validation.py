@@ -140,6 +140,8 @@ def validate_pod_group(pg) -> None:
     if pg.status.phase not in (PHASE_PENDING, PHASE_SCHEDULING,
                                PHASE_RUNNING, PHASE_FAILED):
         errs.append(f"status.phase: invalid phase {pg.status.phase!r}")
+    if pg.status.resubmissions < 0:
+        errs.append("status.resubmissions: must be non-negative")
     if errs:
         raise ValidationError(errs)
 

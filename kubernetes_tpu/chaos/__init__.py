@@ -1,0 +1,25 @@
+"""Chaos engineering for the control plane — deterministic fault injection.
+
+The subsystem has three layers:
+
+  - injector.py: `FaultInjector` (the seeded fault oracle + event log) and
+    `ChaosClient` (a state.client.Client whose mutating verbs consult the
+    injector before touching the store) — API errors, apiserver
+    partitions, node crashes, and heartbeat suppression, every decision a
+    pure function of `(seed, step, call signature)`.
+  - invariants.py: `InvariantChecker` — sweeps live cluster state for the
+    things failure handling must never leave behind: half-bound gangs,
+    scheduler-cache assumes or permit reservations referencing dead
+    nodes, and a WAL that no longer replays to the live store.
+  - harness.py: `ChaosHarness` — an in-process cluster (store + scheduler
+    + nodelifecycle + podgroup controller + virtual kubelets) on a
+    FakeClock, driven through a seed-derived schedule of chaos actions.
+    Two runs with the same seed produce identical event logs.
+"""
+
+from .injector import ChaosClient, ChaosError, FaultInjector
+from .invariants import InvariantChecker
+from .harness import ChaosHarness, ChaosReport
+
+__all__ = ["ChaosClient", "ChaosError", "FaultInjector",
+           "InvariantChecker", "ChaosHarness", "ChaosReport"]

@@ -1,0 +1,397 @@
+"""ChaosHarness — a seeded, virtual-clock chaos soak over an in-process cluster.
+
+The harness stands up the full control plane against one store — batch
+scheduler (gang gates included), NodeLifecycleController, PodGroup
+controller, pod GC — plus VIRTUAL kubelets (the harness itself heartbeats
+nodes and marks bound pods Running), all on a shared FakeClock. A run is
+driven by a schedule of chaos actions derived purely from the seed:
+workload creation (gangs + singletons), node crashes and restarts,
+heartbeat suppression, node deletion, apiserver write partitions, and a
+background injected API error rate on every control-plane write.
+
+Determinism contract: the schedule is pregenerated from `seed` before the
+run; every control loop is stepped SYNCHRONOUSLY from the single driver
+thread; after each step the harness settles (waits until each informer's
+indexer matches the store) so informer-thread timing cannot change which
+calls the next step issues. Two runs with the same seed therefore produce
+identical FaultInjector event logs — `report.events`.
+
+After the scheduled events, the run quiesces (faults off, dead nodes stay
+dead) long enough for eviction timeouts, permit timeouts, and gang
+resubmissions to converge, then sweeps the InvariantChecker. A green
+report means: no PodGroup partially bound, no cache assume or permit
+reservation on a dead node, and the WAL replays to the live store.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..api.core import Node, NodeCondition, Pod
+from ..api.meta import ObjectMeta
+from ..api.quantity import Quantity
+from ..api.scheduling import PodGroup, PodGroupSpec
+from ..controllers.nodelifecycle import NodeLifecycleController
+from ..controllers.podgc import PodGCController
+from ..controllers.podgroup import PodGroupController
+from ..scheduler.scheduler import Scheduler
+from ..state.client import Client
+from ..state.informer import SharedInformerFactory
+from ..state.store import NotFoundError, Store
+from ..utils.clock import FakeClock, now_iso
+from ..utils.metrics import RobustnessMetrics
+from .injector import ChaosClient, FaultInjector
+from .invariants import InvariantChecker
+
+SLICE_LABEL = "tpu/slice"
+
+#: (action, weight) — the seed-derived schedule draws from these
+_ACTIONS = (("create_gang", 0.26), ("create_singleton", 0.14),
+            ("kill_node", 0.12), ("restart_node", 0.10),
+            ("drop_heartbeat", 0.08), ("resume_heartbeat", 0.05),
+            ("delete_node", 0.06), ("partition", 0.04), ("heal", 0.05),
+            ("noop", 0.10))
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    steps: int
+    #: the injector's event log — identical across runs with one seed
+    events: List[Tuple] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+    pods_bound: int = 0
+    gangs_created: int = 0
+    resubmissions: int = 0
+    nodes_killed: int = 0
+    nodes_deleted: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class ChaosHarness:
+    def __init__(self, seed: int = 0, nodes: int = 8,
+                 nodes_per_slice: int = 4, error_rate: float = 0.05,
+                 wal_path: Optional[str] = None,
+                 clock_step: float = 5.0,
+                 grace_period: float = 12.0,
+                 eviction_timeout: float = 30.0,
+                 gang_timeout: int = 60):
+        self.seed = seed
+        self.n_nodes = nodes
+        self.nodes_per_slice = max(1, nodes_per_slice)
+        self.clock_step = clock_step
+        self.gang_timeout = gang_timeout
+        self.wal_path = wal_path
+        self.clock = FakeClock()
+        self.metrics = RobustnessMetrics()
+        self.injector = FaultInjector(seed=seed, error_rate=error_rate,
+                                      metrics=self.metrics)
+        self._base_error_rate = error_rate
+        store = Store(wal_path=wal_path)
+        #: the control plane's (faulted) client vs the harness's own
+        #: admin view of the same store — workload creation and virtual
+        #: kubelet writes stay fault-free so the run's INPUT is stable
+        #: and only the control plane's handling of faults is under test
+        self.client = ChaosClient(self.injector, store=store)
+        self.admin = Client(store)
+        self.factory = SharedInformerFactory(self.client)
+        self.scheduler = Scheduler(self.client, informer_factory=self.factory,
+                                   batch_size=64, clock=self.clock)
+        self.nodelifecycle = NodeLifecycleController(
+            self.client, self.factory, grace_period=grace_period,
+            eviction_timeout=eviction_timeout, clock=self.clock,
+            metrics=self.metrics)
+        self.podgroups = PodGroupController(
+            self.client, self.factory, metrics=self.metrics,
+            clock=self.clock)
+        self.podgc = PodGCController(self.client, self.factory,
+                                     clock=self.clock)
+        self._gang_counter = 0
+        self._pod_counter = 0
+        self._started = False
+
+    # ------------------------------------------------------------- setup
+
+    def _slice_of(self, i: int) -> str:
+        return f"s{i // self.nodes_per_slice}"
+
+    def start(self) -> None:
+        if self._started:
+            return
+        for i in range(self.n_nodes):
+            self._register_node(i)
+        self.factory.start()
+        self.factory.wait_for_cache_sync()
+        self._settle()
+        self._started = True
+
+    def _register_node(self, i: int) -> None:
+        alloc = {"cpu": Quantity("4"), "memory": Quantity("32Gi"),
+                 "pods": Quantity("110")}
+        node = Node(metadata=ObjectMeta(
+            name=f"node-{i}", labels={SLICE_LABEL: self._slice_of(i)}))
+        node.status.capacity = dict(alloc)
+        node.status.allocatable = dict(alloc)
+        node.status.conditions = [NodeCondition(
+            type="Ready", status="True", reason="KubeletReady",
+            last_heartbeat_time=now_iso(self.clock))]
+        self.admin.nodes().create(node)
+
+    def close(self) -> None:
+        self.factory.stop()
+        self.admin.store.close()
+
+    # ---------------------------------------------------------- schedule
+
+    def make_schedule(self, n_events: int) -> List[dict]:
+        """The run's chaos script: a pure function of (seed, n_events).
+        Every parameter an action needs is drawn here, so applying the
+        schedule consumes no randomness — cluster state can influence
+        WHAT an action amounts to (killing an already-dead node is a
+        no-op) but never the script itself."""
+        # string seeding is process-stable (sha512), tuple seeding is not
+        rng = random.Random(f"chaos-schedule:{self.seed}")
+        names = [a for a, _ in _ACTIONS]
+        weights = [w for _, w in _ACTIONS]
+        out = []
+        for _ in range(n_events):
+            action = rng.choices(names, weights=weights)[0]
+            ev = {"action": action,
+                  "node": rng.randrange(self.n_nodes),
+                  "size": rng.randint(2, self.nodes_per_slice),
+                  "cpu_m": rng.choice((250, 500, 750, 1000))}
+            out.append(ev)
+        return out
+
+    # -------------------------------------------------------------- run
+
+    def run(self, n_events: int = 100, quiesce_steps: int = 30
+            ) -> ChaosReport:
+        self.start()
+        report = ChaosReport(seed=self.seed, steps=n_events)
+        for step, ev in enumerate(self.make_schedule(n_events)):
+            self.injector.advance(step)
+            self._apply(ev, report)
+            self._tick()
+        # quiesce: faults stop, dead nodes STAY dead — eviction timeouts,
+        # permit rollbacks, and resubmissions must now converge on their
+        # own; the invariants are checked against this settled state
+        self.injector.error_rate = 0.0
+        if self.injector.partitioned:
+            self.injector.partition(False)
+        for step in range(n_events, n_events + quiesce_steps):
+            self.injector.advance(step)
+            self._tick()
+        # final housekeeping pass: the last tick's PodGroup syncs may have
+        # orphaned permit reservations (resubmission deleting a waiting
+        # member); one more scheduling cycle drains them before the sweep
+        self.scheduler.schedule_pending(timeout=0)
+        self.scheduler.cache.cleanup_expired_assumed_pods()
+        self._settle()
+        checker = InvariantChecker(self.admin, scheduler=self.scheduler,
+                                   wal_path=self.wal_path)
+        report.violations = checker.check()
+        report.events = list(self.injector.events)
+        report.pods_bound = sum(
+            1 for p in self.admin.pods().list(namespace=None)
+            if p.spec.node_name)
+        report.resubmissions = sum(
+            pg.status.resubmissions
+            for pg in self.admin.pod_groups().list(namespace=None))
+        return report
+
+    def _apply(self, ev: dict, report: ChaosReport) -> None:
+        action = ev["action"]
+        node = f"node-{ev['node']}"
+        if action == "create_gang":
+            self._create_gang(ev["size"], ev["cpu_m"])
+            report.gangs_created += 1
+        elif action == "create_singleton":
+            self._create_pod(self._next_pod_name("solo"), ev["cpu_m"])
+        elif action == "kill_node":
+            if self._node_exists(node) and self.injector.node_alive(node):
+                self.injector.kill_node(node)
+                report.nodes_killed += 1
+        elif action == "restart_node":
+            if self._node_exists(node):
+                self.injector.restart_node(node)
+        elif action == "drop_heartbeat":
+            if self._node_exists(node) and self.injector.node_alive(node):
+                self.injector.suppress_heartbeat(node)
+        elif action == "resume_heartbeat":
+            self.injector.resume_heartbeat(node)
+        elif action == "delete_node":
+            if self._node_exists(node):
+                self.injector.kill_node(node)
+                try:
+                    self.admin.nodes().delete(node)
+                except NotFoundError:
+                    pass
+                self.injector.record("delete_node", node)
+                report.nodes_deleted += 1
+        elif action == "partition":
+            if not self.injector.partitioned:
+                self.injector.partition(True)
+        elif action == "heal":
+            if self.injector.partitioned:
+                self.injector.partition(False)
+
+    def _node_exists(self, name: str) -> bool:
+        try:
+            self.admin.nodes().get(name)
+            return True
+        except NotFoundError:
+            return False
+
+    def _next_pod_name(self, prefix: str) -> str:
+        self._pod_counter += 1
+        return f"{prefix}-{self._pod_counter}"
+
+    def _create_gang(self, size: int, cpu_m: int) -> None:
+        self._gang_counter += 1
+        gname = f"gang-{self._gang_counter}"
+        self.admin.pod_groups("default").create(PodGroup(
+            metadata=ObjectMeta(name=gname, namespace="default"),
+            spec=PodGroupSpec(min_member=size, topology_key=SLICE_LABEL,
+                              schedule_timeout_seconds=self.gang_timeout)))
+        for i in range(size):
+            self._create_pod(f"{gname}-w{i}", cpu_m, group=gname)
+        self.injector.record("create_gang", gname, size)
+
+    def _create_pod(self, name: str, cpu_m: int,
+                    group: Optional[str] = None) -> None:
+        from ..api.core import (Container, PodSpec, ResourceRequirements)
+        labels = {}
+        if group is not None:
+            from ..api.wellknown import LABEL_POD_GROUP
+            labels[LABEL_POD_GROUP] = group
+        pod = Pod(
+            metadata=ObjectMeta(name=name, namespace="default",
+                                labels=labels),
+            spec=PodSpec(containers=[Container(
+                name="c", image="img",
+                resources=ResourceRequirements(
+                    requests={"cpu": Quantity(f"{cpu_m}m"),
+                              "memory": Quantity("256Mi")}))]))
+        self.admin.pods("default").create(pod)
+
+    # -------------------------------------------------------------- tick
+
+    def _tick(self) -> None:
+        """One control-plane step: virtual kubelets beat and report, each
+        control loop runs once, virtual time advances, informers settle."""
+        self._virtual_kubelets()
+        self._settle()
+        try:
+            self.nodelifecycle.monitor_once()
+        except Exception:
+            pass  # a partitioned monitor pass retries next tick
+        try:
+            self.podgc.gc_once()
+        except Exception:
+            pass
+        self._settle()
+        try:
+            self.scheduler.schedule_pending(timeout=0)
+        except Exception:
+            pass
+        self.scheduler.cache.cleanup_expired_assumed_pods()
+        self._settle()
+        for pg in self.admin.pod_groups().list(namespace=None):
+            try:
+                self.podgroups.sync(pg.metadata.key())
+            except Exception:
+                pass  # chaos mid-resubmit: the next tick re-syncs
+            self._settle()
+        self.clock.step(self.clock_step)
+
+    def _virtual_kubelets(self) -> None:
+        """The hollow node fleet: every live node heartbeats (unless the
+        injector silenced it) and reports its non-terminal bound pods
+        Running — through the ADMIN client, so kubelet-side writes are
+        not part of the injected fault surface."""
+        nodes = sorted(n.metadata.name for n in self.admin.nodes().list())
+        alive = {n for n in nodes if self.injector.node_alive(n)}
+        for name in nodes:
+            if not self.injector.allow_heartbeat(name):
+                continue
+
+            def beat(cur):
+                for cond in cur.status.conditions:
+                    if cond.type == "Ready":
+                        cond.status = "True"
+                        cond.reason = "KubeletReady"
+                        cond.last_heartbeat_time = now_iso(self.clock)
+                        return cur
+                cur.status.conditions.append(NodeCondition(
+                    type="Ready", status="True", reason="KubeletReady",
+                    last_heartbeat_time=now_iso(self.clock)))
+                return cur
+            try:
+                self.admin.nodes().patch(name, beat)
+            except NotFoundError:
+                pass
+        for pod in self.admin.pods().list(namespace=None):
+            if not pod.spec.node_name or pod.spec.node_name not in alive:
+                continue
+            if pod.status.phase in ("Running", "Succeeded", "Failed"):
+                continue
+
+            def run_status(cur):
+                if cur.status.phase in ("Succeeded", "Failed"):
+                    return cur  # never resurrect a terminal pod
+                cur.status.phase = "Running"
+                return cur
+            try:
+                self.admin.pods(pod.metadata.namespace).patch(
+                    pod.metadata.name, run_status)
+            except NotFoundError:
+                pass
+
+    # ------------------------------------------------------------ settle
+
+    def _informers_current(self) -> bool:
+        from ..api.core import Node as NodeCls, Pod as PodCls
+        for cls in (PodCls, NodeCls, PodGroup):
+            inf = self.factory.informer_for(cls)
+            resource = self.client.scheme.resource_for(cls)
+            items, _ = self.client.store.list(resource)
+            want = {o.metadata.key(): o.metadata.resource_version
+                    for o in items}
+            have = {o.metadata.key(): o.metadata.resource_version
+                    for o in inf.indexer.list()}
+            if want != have:
+                return False
+        return True
+
+    def _settle(self, timeout: float = 10.0) -> None:
+        """Wait (REAL time) until every informer indexer mirrors the
+        store, twice in a row — the second check lets the last event's
+        handler dispatch finish, so control-loop inputs are identical
+        across runs and the fault oracle sees identical call streams."""
+        deadline = time.time() + timeout
+        streak = 0
+        while time.time() < deadline:
+            if self._informers_current():
+                streak += 1
+                if streak >= 2:
+                    return
+                time.sleep(0.002)
+            else:
+                streak = 0
+                time.sleep(0.002)
+        # timed out: the next control loop runs on stale indexers, so
+        # this run's call stream — and event log — may diverge from a
+        # same-seed run. Stamp the log so a determinism failure points
+        # HERE (starved informer thread) and not at the harness logic.
+        import logging
+        logging.getLogger("chaos").warning(
+            "informers failed to settle within %.1fs at step %d",
+            timeout, self.injector.step)
+        self.injector.record("settle_timeout")

@@ -1,0 +1,214 @@
+"""FaultInjector + ChaosClient — seeded, reproducible fault injection.
+
+The injector is the single fault oracle for a chaos run. Determinism
+contract: every decision is a pure function of `(seed, step, call
+signature, attempt)` — NOT of wall clock, thread timing, or call count
+across signatures — so two runs that issue the same calls at the same
+steps inject the same faults and produce identical event logs. Hashing
+uses sha1, not `hash()` (which is salted per process).
+
+`ChaosClient` is a drop-in `state.client.Client`: reads pass straight
+through (informers stay healthy — a watch outage is a different fault
+class, modeled as a partition of WRITES), while every mutating verb
+consults the injector first and raises `ChaosError` when the oracle says
+so. Components under test see the same exception surface a flaky
+apiserver would give them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..state.client import Client
+
+#: ResourceClient/PodClient verbs that mutate cluster state; reads and
+#: watches bypass injection (see module docstring)
+MUTATING_VERBS = frozenset({
+    "create", "create_bulk", "update", "update_status", "patch",
+    "merge_patch", "delete", "evict", "bind", "bind_bulk",
+    "bind_bulk_pairs", "update_scale"})
+
+
+class ChaosError(Exception):
+    """An injected API failure (transient-server-error analog). Callers
+    are expected to treat it like any other transient store error —
+    retry with backoff or requeue."""
+
+
+class FaultInjector:
+    """Seeded fault oracle + chaos event log.
+
+    The harness calls `advance(step)` once per scheduled event, then
+    applies node-level actions (`kill_node`, `suppress_heartbeat`, ...);
+    the ChaosClient calls `before(op, resource, name)` on every mutating
+    API verb. Each (step, signature) retries independently: attempt 0
+    may fail while attempt 1 succeeds, so backoff-retried writes make
+    progress even at high error rates.
+    """
+
+    def __init__(self, seed: int = 0, error_rate: float = 0.0,
+                 metrics=None):
+        self.seed = seed
+        self.error_rate = error_rate
+        self.metrics = metrics
+        self.step = 0
+        self.partitioned = False
+        self._lock = threading.Lock()
+        #: nodes whose "kubelet process" is down (no heartbeats; cleared
+        #: by restart_node)
+        self._down: set = set()
+        #: nodes with heartbeats suppressed but the process alive (a
+        #: network blip, not a crash)
+        self._muted: set = set()
+        #: (step, op, resource, name) -> attempts seen this step
+        self._attempts: Dict[Tuple, int] = {}
+        #: the run's event log: (step, kind, *detail) tuples, identical
+        #: across runs with the same (seed, schedule)
+        self.events: List[Tuple] = []
+
+    # ------------------------------------------------------------ driver
+
+    def advance(self, step: int) -> None:
+        with self._lock:
+            self.step = step
+            self._attempts.clear()
+
+    def record(self, kind: str, *detail) -> None:
+        with self._lock:
+            self.events.append((self.step, kind) + tuple(detail))
+
+    # ------------------------------------------------------- node faults
+
+    def kill_node(self, name: str) -> None:
+        """Crash the node's virtual kubelet: heartbeats stop until
+        restart_node. The Node object stays — the control plane must
+        notice via staleness, exactly like a real dead host."""
+        with self._lock:
+            self._down.add(name)
+        self._count("kill_node")
+        self.record("kill_node", name)
+
+    def restart_node(self, name: str) -> None:
+        with self._lock:
+            self._down.discard(name)
+            self._muted.discard(name)
+        self.record("restart_node", name)
+
+    def suppress_heartbeat(self, name: str) -> None:
+        with self._lock:
+            self._muted.add(name)
+        self._count("suppress_heartbeat")
+        self.record("suppress_heartbeat", name)
+
+    def resume_heartbeat(self, name: str) -> None:
+        with self._lock:
+            self._muted.discard(name)
+        self.record("resume_heartbeat", name)
+
+    def partition(self, on: bool = True) -> None:
+        """Partition the apiserver for WRITES: every mutating verb fails
+        until healed."""
+        self.partitioned = on
+        if on:
+            self._count("partition")
+        self.record("partition" if on else "heal")
+
+    def node_alive(self, name: str) -> bool:
+        with self._lock:
+            return name not in self._down
+
+    def allow_heartbeat(self, name: str) -> bool:
+        with self._lock:
+            return name not in self._down and name not in self._muted
+
+    def down_nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._down)
+
+    # --------------------------------------------------------- API layer
+
+    def before(self, op: str, resource: str, name: str) -> None:
+        """Consulted by ChaosClient ahead of every mutating verb; raises
+        ChaosError when this (step, signature, attempt) draws a fault."""
+        if self.partitioned:
+            self.record("api_partition_drop", op, resource, name)
+            self._count("api_error")
+            raise ChaosError(
+                f"injected partition: {op} {resource}/{name}")
+        if self.error_rate <= 0.0:
+            return
+        with self._lock:
+            sig = (self.step, op, resource, name)
+            attempt = self._attempts.get(sig, 0)
+            self._attempts[sig] = attempt + 1
+        digest = hashlib.sha1(
+            f"{self.seed}:{self.step}:{op}:{resource}:{name}:{attempt}"
+            .encode()).digest()
+        draw = int.from_bytes(digest[:8], "big") / float(1 << 64)
+        if draw < self.error_rate:
+            self.record("api_error", op, resource, name, attempt)
+            self._count("api_error")
+            raise ChaosError(
+                f"injected API error: {op} {resource}/{name} "
+                f"(attempt {attempt})")
+
+    def _count(self, kind: str) -> None:
+        if self.metrics is not None:
+            self.metrics.faults_injected.inc(kind=kind)
+
+
+def _target_name(args, kwargs) -> str:
+    """Best-effort object name from a verb's arguments (for the fault
+    signature; collisions only blur per-object independence)."""
+    for v in list(args) + list(kwargs.values()):
+        if isinstance(v, str):
+            return v
+        meta = getattr(v, "metadata", None)
+        if meta is not None:
+            return meta.name or meta.generate_name or ""
+        if isinstance(v, (list, tuple)) and v:
+            return f"bulk[{len(v)}]"
+    return ""
+
+
+class _FaultyResourceClient:
+    """Proxy over a ResourceClient/PodClient: mutating verbs consult the
+    injector first; everything else (reads, watch, attributes) passes
+    through untouched."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self._injector = injector
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name not in MUTATING_VERBS or not callable(attr):
+            return attr
+        injector = self._injector
+        resource = self._inner._resource
+
+        def wrapped(*args, **kwargs):
+            injector.before(name, resource, _target_name(args, kwargs))
+            return attr(*args, **kwargs)
+        wrapped.__name__ = name
+        return wrapped
+
+
+class ChaosClient(Client):
+    """A Client whose resource accessors hand out fault-wrapped views.
+
+    Components built on this client (scheduler, controllers, virtual
+    kubelets) experience the injector's API faults on every write while
+    their informers keep watching the store directly — the fault surface
+    of a flaky apiserver, not a corrupted one.
+    """
+
+    def __init__(self, injector: FaultInjector, store=None, **kwargs):
+        super().__init__(store=store, **kwargs)
+        self.injector = injector
+
+    def resource(self, cls, namespace=None):
+        return _FaultyResourceClient(
+            super().resource(cls, namespace), self.injector)

@@ -1,0 +1,157 @@
+"""InvariantChecker — what failure handling must never leave behind.
+
+Swept after (and during) chaos runs over live cluster state:
+
+  1. Gang atomicity: no PodGroup is PARTIALLY bound — its non-terminal
+     members with a node number either zero or at least minMember. A
+     2-of-4 slice is wedged capacity; the whole point of gang-aware
+     failure propagation is that this state never survives quiescence.
+  2. No scheduler-cache assume references a deleted node, and every
+     assumed pod still exists in the store (an orphaned assume holds
+     phantom capacity until the TTL fires — at quiescence there must be
+     none).
+  3. No permit-gate reservation sits on a deleted or NoExecute-dead
+     node (GangManager.node_gone's contract).
+  4. The WAL replays to exactly the live store: reconstructing
+     {(resource, ns, name): rv} from the journal records matches
+     Store.contents() — a crash at this instant would lose nothing.
+
+Each violation is a human-readable string; an empty list is green.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import wellknown
+from ..api.scheduling import pod_group_name
+
+#: taints that mean "this node is dead to the scheduler" for invariant 3
+_DEAD_TAINTS = (wellknown.TAINT_NODE_NOT_READY,
+                wellknown.TAINT_NODE_UNREACHABLE)
+
+
+def wal_digest(path: str) -> dict:
+    """Reconstruct {(resource, namespace, name): rv} by replaying the
+    journal records WITHOUT opening a second writer on the live file
+    (Store(wal_path=...) would). Mirrors Store._replay_wal's effect on
+    the key space: PUT upserts, DELETE drops, BIND restamps, META is
+    clock-only."""
+    from ..state.wal import load_wal
+    records, _ = load_wal(path)
+    state: dict = {}
+    for rec in records:
+        op = rec.get("op")
+        if op == "META":
+            continue
+        resource = rec.get("resource", "")
+        obj = rec.get("object") or {}
+        if op == "BIND":
+            key = (resource, obj.get("namespace", ""), obj.get("name", ""))
+            if key in state:
+                state[key] = rec["rv"]
+            continue
+        md = obj.get("metadata") or {}
+        key = (resource, md.get("namespace", ""), md.get("name", ""))
+        if op == "DELETE":
+            state.pop(key, None)
+        else:
+            state[key] = rec["rv"]
+    return state
+
+
+class InvariantChecker:
+    def __init__(self, client, scheduler=None,
+                 wal_path: Optional[str] = None):
+        self.client = client
+        self.scheduler = scheduler
+        self.wal_path = wal_path
+
+    # ------------------------------------------------------------ sweeps
+
+    def check(self) -> List[str]:
+        out: List[str] = []
+        out += self.check_gang_atomicity()
+        if self.scheduler is not None:
+            out += self.check_cache_assumes()
+            out += self.check_gang_reservations()
+        if self.wal_path is not None:
+            out += self.check_wal_replay()
+        return out
+
+    def _live_nodes(self) -> dict:
+        return {n.metadata.name: n for n in self.client.nodes().list()}
+
+    def check_gang_atomicity(self) -> List[str]:
+        out: List[str] = []
+        pods = self.client.pods().list(namespace=None)
+        for pg in self.client.pod_groups().list(namespace=None):
+            ns, name = pg.metadata.namespace, pg.metadata.name
+            members = [p for p in pods
+                       if p.metadata.namespace == ns
+                       and pod_group_name(p) == name]
+            bound = [p for p in members
+                     if p.spec.node_name
+                     and p.status.phase not in ("Succeeded", "Failed")]
+            mm = max(1, pg.spec.min_member)
+            if 0 < len(bound) < mm:
+                out.append(
+                    f"gang-atomicity: PodGroup {ns}/{name} partially "
+                    f"bound ({len(bound)}/{mm}): "
+                    f"{sorted(p.metadata.name for p in bound)}")
+        return out
+
+    def check_cache_assumes(self) -> List[str]:
+        out: List[str] = []
+        nodes = self._live_nodes()
+        from ..state.store import NotFoundError
+        for pod in self.scheduler.cache.assumed_pods():
+            key = pod.metadata.key()
+            if pod.spec.node_name not in nodes:
+                out.append(f"cache-assume: pod {key} assumed on deleted "
+                           f"node {pod.spec.node_name}")
+            try:
+                self.client.pods(pod.metadata.namespace).get(
+                    pod.metadata.name)
+            except NotFoundError:
+                out.append(f"cache-assume: pod {key} assumed but no "
+                           f"longer exists in the store")
+        return out
+
+    def check_gang_reservations(self) -> List[str]:
+        gang = getattr(self.scheduler, "gang", None)
+        if gang is None:
+            return []
+        out: List[str] = []
+        nodes = self._live_nodes()
+        for gkey, pod_key, node_name in gang.reservations():
+            node = nodes.get(node_name)
+            if node is None:
+                out.append(f"gang-reservation: {gkey} member {pod_key} "
+                           f"reserved on deleted node {node_name}")
+                continue
+            dead = [t.key for t in node.spec.taints
+                    if t.key in _DEAD_TAINTS and t.effect == "NoExecute"]
+            if dead:
+                out.append(f"gang-reservation: {gkey} member {pod_key} "
+                           f"reserved on dead node {node_name} "
+                           f"(taints: {dead})")
+        return out
+
+    def check_wal_replay(self) -> List[str]:
+        store = self.client.store
+        store.flush_wal()  # deferred records must be on disk first
+        want = store.contents()
+        got = wal_digest(self.wal_path)
+        out: List[str] = []
+        for key in sorted(set(want) | set(got)):
+            if key not in got:
+                out.append(f"wal-replay: live object {key} missing from "
+                           f"the journal")
+            elif key not in want:
+                out.append(f"wal-replay: journal resurrects deleted "
+                           f"object {key} at rv {got[key]}")
+            elif want[key] != got[key]:
+                out.append(f"wal-replay: {key} at rv {got[key]} in the "
+                           f"journal vs {want[key]} live")
+        return out

@@ -50,6 +50,11 @@ class ControllerManager:
                  cluster_ca: Optional[tuple] = None):
         self.client = client
         self.informers = informers or SharedInformerFactory(client)
+        # one shared failure-handling metrics family set: nodelifecycle's
+        # retried writes + gang evictions and podgroup resubmissions land
+        # in the same registry/exposition
+        from ..utils.metrics import RobustnessMetrics
+        self.robustness = RobustnessMetrics()
         from ..api.core import ReplicationController
         self.replicaset = ReplicaSetController(client, self.informers)
         # the rc controller is the same logic over ReplicationControllers
@@ -69,7 +74,8 @@ class ControllerManager:
             client, self.informers,
             monitor_period=node_monitor_period,
             grace_period=node_grace_period,
-            eviction_timeout=pod_eviction_timeout)
+            eviction_timeout=pod_eviction_timeout,
+            metrics=self.robustness)
         self.garbagecollector = GarbageCollector(client, self.informers)
         self.disruption = DisruptionController(client, self.informers)
         self.resourcequota = ResourceQuotaController(client, self.informers)
@@ -93,7 +99,8 @@ class ControllerManager:
                 client, self.informers, cluster_ca[0], cluster_ca[1])
             self.root_ca_publisher = RootCACertPublisher(
                 client, self.informers, cluster_ca[0])
-        self.podgroup = PodGroupController(client, self.informers)
+        self.podgroup = PodGroupController(client, self.informers,
+                                           metrics=self.robustness)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,

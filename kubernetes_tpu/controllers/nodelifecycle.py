@@ -7,6 +7,15 @@ unreachable NoExecute+NoSchedule taints, and pod eviction after
 taint manager) from the classic eviction path; here one monitor loop does
 both: taint immediately on not-ready, evict the node's pods once the
 condition has persisted past the eviction timeout.
+
+Failure handling is GANG-AWARE: a dead node's singleton pods are deleted
+so their controllers replace them, but a gang member's death fails the
+WHOLE PodGroup — every bound member, survivors on healthy nodes included
+— because a 3-of-4 TPU slice is wedged capacity, not a degraded service.
+The PodGroupController then resubmits the failed gang as one unit
+(Failed -> Pending). Control-plane writes retry with backoff
+(utils/backoff.py) and are counted in RobustnessMetrics instead of being
+swallowed by bare excepts.
 """
 
 from __future__ import annotations
@@ -18,8 +27,12 @@ from typing import Dict, Optional
 from ..api import helpers, wellknown
 from ..api.core import Node, Pod, Taint
 from ..api.meta import controller_ref
+from ..api.scheduling import PodGroup, pod_group_key, pod_group_name
 from ..state.informer import SharedInformerFactory
+from ..state.store import NotFoundError
+from ..utils import backoff
 from ..utils.clock import Clock, REAL_CLOCK, now_iso, parse_iso
+from ..utils.metrics import RobustnessMetrics
 
 DEFAULT_MONITOR_PERIOD = 5.0      # --node-monitor-period
 DEFAULT_GRACE_PERIOD = 40.0       # --node-monitor-grace-period
@@ -33,14 +46,19 @@ class NodeLifecycleController:
                  monitor_period: float = DEFAULT_MONITOR_PERIOD,
                  grace_period: float = DEFAULT_GRACE_PERIOD,
                  eviction_timeout: float = DEFAULT_EVICTION_TIMEOUT,
-                 clock: Clock = REAL_CLOCK):
+                 clock: Clock = REAL_CLOCK,
+                 metrics: Optional[RobustnessMetrics] = None,
+                 backoff_policy: backoff.BackoffPolicy = backoff.DEFAULT_POLICY):
         self.client = client
         self.clock = clock
+        self.metrics = metrics if metrics is not None else RobustnessMetrics()
+        self.backoff_policy = backoff_policy
         self.monitor_period = monitor_period
         self.grace_period = grace_period
         self.eviction_timeout = eviction_timeout
         self.node_informer = informers.informer_for(Node)
         self.pod_informer = informers.informer_for(Pod)
+        self.pg_informer = informers.informer_for(PodGroup)
         #: node name -> monotonic time the node was first seen not-ready
         self._not_ready_since: Dict[str, float] = {}
         self.evicted_pod_count = 0
@@ -63,6 +81,24 @@ class NodeLifecycleController:
                 self.monitor_once()
             except Exception:
                 traceback.print_exc()
+
+    # ------------------------------------------------------------- writes
+
+    def _write(self, op: str, fn) -> bool:
+        """One control-plane write, retried with backoff and counted.
+        NotFound is terminal-but-fine (the object was deleted under us);
+        exhausted retries are logged + counted by backoff.retry, and the
+        NEXT monitor pass is the outer retry loop — one failed write must
+        not abort the sweep over the remaining nodes."""
+        try:
+            backoff.retry(fn, policy=self.backoff_policy, clock=self.clock,
+                          give_up_on=(NotFoundError,), metrics=self.metrics,
+                          component=self.name, op=op)
+            return True
+        except NotFoundError:
+            return False
+        except Exception:
+            return False  # logged + counted in api_give_ups by retry()
 
     # ------------------------------------------------------------ monitor
 
@@ -127,10 +163,9 @@ class NodeLifecycleController:
                 type="Ready", status="Unknown", reason="NodeStatusUnknown",
                 last_transition_time=now_iso()))
             return cur
-        try:
-            self.client.nodes().patch(node.metadata.name, mutate)
-        except Exception:
-            pass
+        self._write("set_ready_unknown",
+                    lambda: self.client.nodes().patch(node.metadata.name,
+                                                      mutate))
 
     _OUR_TAINTS = (wellknown.TAINT_NODE_NOT_READY,
                    wellknown.TAINT_NODE_UNREACHABLE)
@@ -153,10 +188,9 @@ class NodeLifecycleController:
                 if (t.key, t.effect) not in have_now:
                     cur.spec.taints.append(t)
             return cur
-        try:
-            self.client.nodes().patch(node.metadata.name, mutate)
-        except Exception:
-            pass
+        self._write("ensure_taints",
+                    lambda: self.client.nodes().patch(node.metadata.name,
+                                                      mutate))
 
     def _clear_taints(self, node: Node) -> None:
         if not any(t.key in self._OUR_TAINTS for t in node.spec.taints):
@@ -165,26 +199,73 @@ class NodeLifecycleController:
             cur.spec.taints = [t for t in cur.spec.taints
                                if t.key not in self._OUR_TAINTS]
             return cur
-        try:
-            self.client.nodes().patch(node.metadata.name, mutate)
-        except Exception:
-            pass
+        self._write("clear_taints",
+                    lambda: self.client.nodes().patch(node.metadata.name,
+                                                      mutate))
+
+    # ----------------------------------------------------------- eviction
 
     def _evict_pods(self, node_name: str) -> None:
-        """Delete the dead node's pods so their controllers replace them
-        (ref: the classic eviction path; DaemonSet pods are left — their
-        controller pins them to nodes)."""
+        """Evict the dead node's pods. Singletons are deleted so their
+        controllers replace them (ref: the classic eviction path;
+        DaemonSet pods are left — their controller pins them to nodes).
+        Gang members route through _evict_gang: the WHOLE PodGroup fails
+        as a unit, because replacing one worker of a slice buys nothing."""
         # O(pods-on-node): the factory registers the nodeName index on the
         # pod informer for exactly this lookup
+        groups = set()
         for pod in self.pod_informer.indexer.by_index("nodeName", node_name):
             if pod.metadata.deletion_timestamp is not None:
                 continue
             ref = controller_ref(pod.metadata)
             if ref is not None and ref.kind == "DaemonSet":
                 continue
-            try:
-                self.client.pods(pod.metadata.namespace).delete(
-                    pod.metadata.name)
+            gkey = pod_group_key(pod)
+            if gkey is not None and \
+                    self.pg_informer.indexer.get_by_key(gkey) is not None:
+                groups.add(gkey)
+                continue
+            # a gang LABEL without a live PodGroup has no resubmission
+            # owner: failing it would strand the pods forever — the
+            # singleton delete path lets owning controllers replace them
+            if self._write("evict_delete",
+                           lambda p=pod: self.client.pods(
+                               p.metadata.namespace).delete(p.metadata.name)):
                 self.evicted_pod_count += 1
-            except Exception:
-                pass
+                self.metrics.pods_evicted.inc(mode="delete")
+        for gkey in sorted(groups):
+            self._evict_gang(gkey, node_name)
+
+    def _evict_gang(self, gkey: str, node_name: str) -> None:
+        """Fail EVERY bound member of the gang — the ones on healthy
+        nodes included ("fail like a slice"): the survivors' ICI domain
+        is broken, and holding their nodes only starves other gangs. The
+        members are marked Failed (the kubelet eviction convention, see
+        node/agent._maybe_evict) rather than deleted, so the
+        PodGroupController can resubmit the gang as one unit; unbound
+        members are left pending — resubmission recycles them too."""
+        ns, _, name = gkey.partition("/")
+        failed_any = False
+        for pod in self.pod_informer.indexer.list(ns):
+            if pod_group_name(pod) != name:
+                continue
+            if not pod.spec.node_name or helpers.pod_is_terminal(pod):
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+
+            def mutate(cur):
+                if cur.status.phase in ("Succeeded", "Failed"):
+                    return cur
+                cur.status.phase = "Failed"
+                cur.status.reason = "NodeFailure"
+                return cur
+            if self._write("evict_gang_member",
+                           lambda p=pod: self.client.pods(
+                               p.metadata.namespace).patch(p.metadata.name,
+                                                           mutate)):
+                self.evicted_pod_count += 1
+                self.metrics.pods_evicted.inc(mode="gang_fail")
+                failed_any = True
+        if failed_any:
+            self.metrics.gang_evictions.inc()

@@ -84,7 +84,13 @@ class PodGCController:
         """Pods bound to nodes that no longer exist (gcOrphaned). The
         informer miss is only a HINT: node absence is confirmed against
         the store before deleting, exactly like the reference's apiserver
-        double-check — informer lag must never kill a healthy pod."""
+        double-check — informer lag must never kill a healthy pod.
+
+        Gang members are FAILED, not deleted: deleting one worker of a
+        PodGroup silently shrinks the gang below minMember forever,
+        while a Failed member routes the whole group through the
+        PodGroupController's Failed -> Pending resubmission."""
+        from ..api.scheduling import pod_group_key
         from ..state.store import NotFoundError
         live = {n.metadata.name for n in self.node_informer.indexer.list()}
         n = 0
@@ -101,9 +107,48 @@ class PodGCController:
                     confirmed_gone.add(node)
                 except Exception:
                     continue  # fail safe on lookup errors
+            gkey = pod_group_key(p)
+            if gkey is not None and self._group_exists(gkey):
+                if self._fail_pod(p):
+                    n += 1
+                continue
+            # no live PodGroup = no resubmission owner: delete like any
+            # orphan so an owning controller can replace the pod
             if self._delete_pod(p):
                 n += 1
         return n
+
+    def _group_exists(self, gkey: str) -> bool:
+        """Store-confirmed PodGroup existence; unknown lookup errors lean
+        FAIL-the-member (reversible) over delete (not)."""
+        from ..state.store import NotFoundError
+        ns, _, name = gkey.partition("/")
+        try:
+            self.client.pod_groups(ns).get(name)
+            return True
+        except NotFoundError:
+            return False
+        except Exception:
+            return True
+
+    def _fail_pod(self, pod: Pod) -> bool:
+        """Mark an orphaned gang member Failed (reason NodeFailure) so
+        the PodGroup's resubmission machinery rebuilds the gang."""
+        if pod.status.phase in ("Succeeded", "Failed"):
+            return False
+
+        def mutate(cur):
+            if cur.status.phase in ("Succeeded", "Failed"):
+                return cur
+            cur.status.phase = "Failed"
+            cur.status.reason = "NodeFailure"
+            return cur
+        try:
+            self.client.pods(pod.metadata.namespace).patch(
+                pod.metadata.name, mutate)
+            return True
+        except Exception:
+            return False
 
     def _gc_finished_jobs(self) -> int:
         """ttlSecondsAfterFinished (pkg/controller/ttlafterfinished):

@@ -8,25 +8,46 @@ run), Failed (enough members failed that minMember is out of reach).
 Phase is recomputed from the live member set on every relevant event, so
 a rescheduled gang (e.g. after a permit-timeout rollback plus node churn)
 walks back through Scheduling without controller-side state.
+
+Failed gangs RESUBMIT: once a Failed phase has been recorded, the next
+sync deletes every member and recreates it as a clean clone (node
+assignment and status stripped), so a gang killed by a node death
+reschedules as one unit instead of leaving survivors wedged on a broken
+slice. Two-pass by design — record Failed, then resubmit — so the Failed
+observation is never lost to the rebuild.
 """
 
 from __future__ import annotations
 
-from ..api.core import Pod
+from ..api import serde
+from ..api.core import Pod, PodStatus
 from ..api.scheduling import (PHASE_FAILED, PHASE_PENDING, PHASE_RUNNING,
                               PHASE_SCHEDULING, PodGroup, pod_group_key,
                               pod_group_name)
 from ..state.informer import EventHandlers, SharedInformerFactory
+from ..utils import backoff
+from ..utils.clock import Clock, REAL_CLOCK
+from ..utils.metrics import RobustnessMetrics
 from .base import Controller
+
+#: floor between two resubmissions of ONE group — a gang that keeps
+#: failing for reasons a rebuild cannot fix must not hot-loop
+#: delete/recreate at event speed
+RESUBMIT_MIN_INTERVAL = 30.0
 
 
 class PodGroupController(Controller):
     name = "podgroup"
 
     def __init__(self, client, informers: SharedInformerFactory,
-                 workers: int = 1):
+                 workers: int = 1, metrics: RobustnessMetrics = None,
+                 clock: Clock = REAL_CLOCK):
         super().__init__(workers)
         self.client = client
+        self.clock = clock
+        self.metrics = metrics if metrics is not None else RobustnessMetrics()
+        #: group key -> clock time of its last resubmission
+        self._last_resubmit: dict = {}
         self.pg_informer = informers.informer_for(PodGroup)
         self.pod_informer = informers.informer_for(Pod)
         self.pg_informer.add_event_handlers(EventHandlers(
@@ -64,6 +85,20 @@ class PodGroupController(Controller):
         else:
             phase = PHASE_PENDING
         st = pg.status
+        if phase == PHASE_FAILED and st.phase == PHASE_FAILED:
+            # second pass over a recorded failure: rebuild the gang as a
+            # unit and walk it back to Pending — rate-limited per group,
+            # or a gang that keeps dying for non-node reasons (pressure
+            # eviction, crashing members) would hot-loop delete/recreate
+            now = self.clock.now()
+            last = self._last_resubmit.get(key)
+            if last is not None and now - last < RESUBMIT_MIN_INTERVAL:
+                self.enqueue_after(key,
+                                   RESUBMIT_MIN_INTERVAL - (now - last))
+                return
+            self._last_resubmit[key] = now
+            self._resubmit(ns, name, members)
+            return
         if (st.phase == phase and st.scheduled == scheduled
                 and st.running == running and st.succeeded == succeeded
                 and st.failed == failed):
@@ -84,3 +119,77 @@ class PodGroupController(Controller):
         # other failures (conflicts, transient store errors) propagate so
         # the base Controller re-enqueues the key rate-limited — swallowing
         # them would leave the phase stale until an unrelated member event
+
+    # ------------------------------------------------------- resubmission
+
+    @staticmethod
+    def _clean_clone(pod: Pod) -> Pod:
+        """A fresh Pending copy of a member: same spec, no node, no
+        status, no server-stamped metadata — what the user originally
+        submitted."""
+        clone = serde.deepcopy_obj(pod)
+        clone.metadata.uid = ""
+        clone.metadata.resource_version = ""
+        clone.metadata.creation_timestamp = None
+        clone.metadata.deletion_timestamp = None
+        clone.metadata.generation = 0
+        clone.spec.node_name = ""
+        clone.status = PodStatus()
+        return clone
+
+    def _resubmit(self, ns: str, name: str, members) -> None:
+        """Failed -> Pending: delete EVERY member (failed ones and
+        survivors alike — the slice fails as a unit) and recreate each as
+        a clean clone, then reset the group's status. Clones are captured
+        up front and deletes run BEFORE any create, so a delete failure
+        aborts with every not-yet-deleted member intact (the re-synced
+        rebuild still has their specs). Creates retry with backoff and
+        are all attempted even when one exhausts its policy; a member
+        whose create still fails is LOST — its spec lived only in the
+        deleted pod — so the loss is raised loudly rather than absorbed
+        (ROADMAP: spec snapshots on the PodGroup would close this)."""
+        from ..state.store import AlreadyExistsError, NotFoundError
+        clones = [self._clean_clone(pod) for pod in members]
+        for pod in members:
+            try:
+                backoff.retry(
+                    lambda p=pod: self.client.pods(ns).delete(
+                        p.metadata.name),
+                    clock=self.clock, give_up_on=(NotFoundError,),
+                    metrics=self.metrics, component=self.name,
+                    op="resubmit_delete")
+            except NotFoundError:
+                pass  # already gone; recreate below regardless
+        lost = []
+        for clone in clones:
+            try:
+                backoff.retry(
+                    lambda c=clone: self.client.pods(ns).create(c),
+                    clock=self.clock, give_up_on=(AlreadyExistsError,),
+                    metrics=self.metrics, component=self.name,
+                    op="resubmit_create")
+            except AlreadyExistsError:
+                pass  # a retried sync re-creating an existing member
+            except Exception:
+                lost.append(clone.metadata.name)
+        if lost:
+            raise RuntimeError(
+                f"PodGroup {ns}/{name} resubmission lost member(s) "
+                f"{lost}: deleted but could not be recreated — the gang "
+                f"cannot reach minMember until they are resubmitted "
+                f"out of band")
+        self.metrics.gang_resubmissions.inc()
+
+        def reset(cur):
+            cur.status.phase = PHASE_PENDING
+            cur.status.scheduled = 0
+            cur.status.running = 0
+            cur.status.succeeded = 0
+            cur.status.failed = 0
+            cur.status.resubmissions += 1
+            return cur
+        from ..state.store import NotFoundError as _NF
+        try:
+            self.client.pod_groups(ns).patch(name, reset)
+        except _NF:
+            pass  # group deleted mid-rebuild; the pods' GC is the owner's

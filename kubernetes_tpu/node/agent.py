@@ -96,6 +96,11 @@ class NodeAgent:
         #: — PVC-backed pods wait for the attach-detach controller's
         #: attachment before containers start
         self.volume_manager = volume_manager
+        #: chaos hook (chaos.FaultInjector or None): when the injector
+        #: says this node is crashed/muted, the heartbeat loop goes
+        #: silent — the control plane must notice via staleness, exactly
+        #: like a dead host
+        self.fault_injector = None
 
     def _on_pod_event(self, pod: Pod) -> None:
         if pod.spec.node_name == self.node_name:
@@ -181,6 +186,9 @@ class NodeAgent:
     def heartbeat(self) -> None:
         """Refresh the Ready condition's heartbeat (monitorNodeHealth's
         staleness input) + the node lease."""
+        if self.fault_injector is not None and \
+                not self.fault_injector.allow_heartbeat(self.node_name):
+            return  # injected crash/partition: the kubelet goes silent
         pressure = self.eviction.under_pressure()
 
         def beat(cur):

@@ -198,6 +198,12 @@ class Cache:
         with self._lock:
             return pod.metadata.key() in self._assumed
 
+    def assumed_pods(self) -> List[Pod]:
+        """The in-flight (assumed, unconfirmed) pods — the set the chaos
+        invariant checker sweeps for reservations pinned to dead nodes."""
+        with self._lock:
+            return [self._pod_states[k] for k in self._assumed]
+
     def get_pod(self, pod: Pod) -> Optional[Pod]:
         with self._lock:
             return self._pod_states.get(pod.metadata.key())

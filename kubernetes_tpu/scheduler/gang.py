@@ -366,6 +366,49 @@ class GangManager:
             g.bound.discard(key)
             self._gc(g)
 
+    def node_gone(self, node_name: str
+                  ) -> Tuple[List[Tuple[Pod, Pod]], List[Pod]]:
+        """A node vanished (deleted, or NoExecute-tainted dead): every
+        permit-gate reservation on it is pinned to a broken slice.
+        Unlike pod_gone — where only the deleted pod's reservation is
+        orphaned — the WHOLE affected gang rolls back NOW: the surviving
+        members' reservations hold space the gang can no longer use
+        (the dom_pin may point at the dead slice), and waiting out
+        scheduleTimeoutSeconds just delays the retry. Returns
+        (rollbacks, requeue) in expire()'s shape: (pod, assumed clone)
+        pairs to forget from the cache, and the surviving member pods to
+        requeue — all of them still exist (the node died, not the pods),
+        so all of them go back to the queue."""
+        with self._lock:
+            rollbacks: List[Tuple[Pod, Pod]] = []
+            requeue: List[Pod] = []
+            for g in list(self._gangs.values()):
+                if not any(n == node_name
+                           for _, _, n, _ in g.waiting.values()):
+                    continue
+                now = self._clock.now()
+                for pod, clone, _, since in g.waiting.values():
+                    rollbacks.append((pod, clone))
+                    requeue.append(pod)
+                    if self.metrics is not None:
+                        self.metrics.gang_permit_wait.observe(now - since)
+                g.waiting.clear()
+                g.first_wait = None
+                if self.metrics is not None:
+                    self.metrics.gangs_node_lost.inc()
+                self._gc(g)  # clears dom_pin with the last reservation
+            self._observe_pending()
+            return rollbacks, requeue
+
+    def reservations(self) -> List[Tuple[str, str, str]]:
+        """(gang key, pod key, node name) for every live permit-gate
+        reservation — the invariant checker sweeps these against the set
+        of live, untainted nodes."""
+        with self._lock:
+            return [(g.key, key, node)
+                    for g in self._gangs.values()
+                    for key, (_p, _c, node, _t) in g.waiting.items()]
+
     def expire(self, now: float
                ) -> Tuple[List[Tuple[Pod, Pod]], List[Pod]]:
         """The permit-timeout sweep. Returns (rollbacks, requeue):

@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
-from ..api import helpers, serde
+from ..api import helpers, serde, wellknown
 from ..api.core import Binding, ObjectReference, Pod
 from ..api.meta import ObjectMeta
 from ..state.client import Client
@@ -118,6 +118,11 @@ class Scheduler:
         except ValueError:
             # a sibling scheduler shares this registry: keep our own
             self.gang_metrics = GangMetrics()
+        from ..utils.metrics import RobustnessMetrics
+        try:
+            self.robustness = RobustnessMetrics(self.metrics.registry)
+        except ValueError:
+            self.robustness = RobustnessMetrics()
 
         def _node_label(node_name, label_key):
             ni = self.algorithm.snapshot.node_infos.get(node_name)
@@ -200,9 +205,8 @@ class Scheduler:
         node_inf.add_event_handlers(EventHandlers(
             on_add=lambda n: (self.cache.add_node(n),
                               self.queue.move_all_to_active_queue()),
-            on_update=lambda o, n: (self.cache.update_node(o, n),
-                                    self.queue.move_all_to_active_queue()),
-            on_delete=lambda n: self.cache.remove_node(n)))
+            on_update=self._on_node_update,
+            on_delete=self._on_node_delete))
         # services/controllers affect SelectorSpread; their events may make
         # parked pods schedulable-where-preferred (ref: eventhandlers.go
         # onServiceAdd -> MoveAllToActiveQueue)
@@ -212,6 +216,48 @@ class Scheduler:
         for cls in (Service, ReplicationController, ReplicaSet, StatefulSet):
             self.informers.informer_for(cls).add_event_handlers(
                 EventHandlers(on_add=move, on_update=move, on_delete=move))
+
+    _DEAD_NODE_TAINTS = (wellknown.TAINT_NODE_NOT_READY,
+                         wellknown.TAINT_NODE_UNREACHABLE)
+
+    def _on_node_update(self, old, new) -> None:
+        self.cache.update_node(old, new)
+        if any(t.key in self._DEAD_NODE_TAINTS and t.effect == "NoExecute"
+               for t in new.spec.taints):
+            # the node-lifecycle controller declared the node dead:
+            # reservations there are pinned to a broken slice NOW, not in
+            # scheduleTimeoutSeconds
+            self._gang_node_gone(new.metadata.name)
+        self.queue.move_all_to_active_queue()
+
+    def _on_node_delete(self, node) -> None:
+        self.cache.remove_node(node)
+        self._gang_node_gone(node.metadata.name)
+
+    def _gang_node_gone(self, node_name: str) -> None:
+        """Immediate gang-aware node-failure propagation: every permit
+        reservation on the dead node — and its whole gang's — rolls off
+        the cache, and the members requeue for a fresh placement (same
+        mechanics as the permit-timeout sweep, without the wait)."""
+        if self.gang is None:
+            return
+        rollbacks, requeue = self.gang.node_gone(node_name)
+        if not rollbacks:
+            return
+        from ..utils.trace import Trace
+        trace = Trace("gang_node_gone", node=node_name,
+                      reservations=len(rollbacks))
+        self.cache.forget_pods([clone for _, clone in rollbacks])
+        trace.step("reservations rolled back from the cache")
+        for pod in requeue:
+            self.volume_binder.forget_pod_volumes(pod)
+            self._record_event(
+                pod, "FailedScheduling",
+                f"gang reservation lost: node {node_name} died; "
+                f"rescheduling the whole gang")
+            self.queue.add(pod)
+        trace.step("members requeued")
+        trace.log_if_long(100.0)
 
     def _on_pod_add(self, pod: Pod) -> None:
         if pod.spec.node_name:
@@ -593,7 +639,7 @@ class Scheduler:
                                     namespace=res.pod.metadata.namespace),
                 target=ObjectReference(kind="Node", name=res.node_name))
                 for res in bound]
-            outs = self.client.pods().bind_bulk(bindings)
+            outs = self._bind_bulk_with_retry(bindings, len(bound))
         self.metrics.binding_duration.observe(_time.perf_counter() - t_bind)
         n_assumed = 0
         for res, out in zip(bound, outs):
@@ -693,10 +739,7 @@ class Scheduler:
 
         def job():
             t0 = _time.perf_counter()
-            try:
-                outs = self.client.pods().bind_bulk(bindings)
-            except Exception as e:
-                outs = [e] * len(pairs)
+            outs = self._bind_bulk_with_retry(bindings, len(pairs))
             self.metrics.binding_duration.observe(_time.perf_counter() - t0)
             self._reconcile_bind_outcomes(pairs, outs)
         fut = self._bind_pool.submit(job)
@@ -706,6 +749,22 @@ class Scheduler:
                               if not f.done()]
         self._bind_futures.append(fut)
         return n_assumed
+
+    def _bind_bulk_with_retry(self, bindings, n: int) -> list:
+        """The bulk bind POST, retried with backoff on transport-level
+        failures (hub hiccup, injected chaos) — per-slot rejections
+        (NotFound/Conflict) come back inside the result list and are NOT
+        retried here. A bind that still fails after the policy returns
+        the error in every slot; the caller's forget/requeue machinery
+        self-heals exactly as for any failed bind."""
+        from ..utils import backoff
+        try:
+            return backoff.retry(
+                lambda: self.client.pods().bind_bulk(bindings),
+                clock=self.clock, metrics=self.robustness,
+                component="scheduler", op="bind_bulk")
+        except Exception as e:
+            return [e] * n
 
     def _reconcile_bind_outcomes(self, pairs, outs) -> None:
         """Binder-thread half: a failed slot's pod was optimistically

@@ -585,6 +585,16 @@ class Store:
         with self._lock:
             return self._rv
 
+    def contents(self) -> Dict[Tuple[str, str, str], int]:
+        """{(resource, namespace, name): rv} for every live object — the
+        comparison surface for WAL-replay and replication verification
+        (chaos/invariants.py checks the journal reconstructs exactly
+        this map)."""
+        with self._lock:
+            return {(resource, ns, name): rv
+                    for resource, bucket in self._data.items()
+                    for (ns, name), (_obj, rv) in bucket.items()}
+
     # ------------------------------------------------------------- watch
 
     def watch(self, resource: str, namespace: Optional[str] = None,

@@ -212,12 +212,51 @@ class GangMetrics:
         self.gangs_timed_out = r.counter(
             "scheduler_gangs_timed_out_total",
             "PodGroups whose permit wait expired; reservations rolled back")
+        self.gangs_node_lost = r.counter(
+            "scheduler_gangs_node_lost_total",
+            "PodGroups whose reservations rolled back because a reserved "
+            "node died (deleted or NoExecute-dead)")
         self.gangs_rejected = r.counter(
             "scheduler_gangs_rejected_total",
             "Gangs the all-or-nothing kernel could not place atomically")
         self.gang_permit_wait = r.histogram(
             "scheduler_gang_permit_wait_seconds",
             "Seconds a gang member held a reservation at the permit gate")
+
+
+class RobustnessMetrics:
+    """Failure-handling metric families: retried/abandoned API writes
+    (utils/backoff.retry), gang-atomic evictions (nodelifecycle), and
+    chaos-injected faults (chaos/injector). Registered into the caller's
+    registry so they ride the same /metrics exposition as the component
+    that owns them."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: transient API-write failures retried with backoff, by
+        #: component/op — what the bare `except: pass` blocks used to hide
+        self.api_retries = r.counter(
+            "api_request_retries_total",
+            "API writes retried after a transient failure")
+        self.api_give_ups = r.counter(
+            "api_request_give_ups_total",
+            "API writes abandoned after exhausting the backoff policy")
+        #: whole-PodGroup evictions driven by a member's node dying
+        self.gang_evictions = r.counter(
+            "nodelifecycle_gang_evictions_total",
+            "PodGroups evicted atomically because a member's node died")
+        self.pods_evicted = r.counter(
+            "nodelifecycle_pods_evicted_total",
+            "Pods removed or failed by the node-lifecycle eviction path")
+        #: PodGroups rebuilt from Failed back to Pending as one unit
+        self.gang_resubmissions = r.counter(
+            "podgroup_resubmissions_total",
+            "Failed PodGroups resubmitted (members recreated as a unit)")
+        #: faults the chaos injector actually fired, by kind
+        self.faults_injected = r.counter(
+            "chaos_faults_injected_total",
+            "Faults injected by the chaos harness, by kind")
 
 
 class Registry:

@@ -250,6 +250,65 @@ class TestPermitGate:
         assert q.pop_batch(10, timeout=0) == []
         assert q.num_pending() == 1
 
+    def test_node_gone_rolls_back_whole_gang_immediately(self):
+        """A reservation's NODE dying must not sit out the permit
+        timeout: node_gone rolls back the whole affected gang NOW and
+        requeues every surviving member (the pods still exist — only
+        their slice broke)."""
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 3, timeout=300),
+                  ("default", "g2"): make_group("g2", 2, timeout=300)}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        other = make_pod("o1", group="g2")
+        assert gm.permit(m1, m1, "n1")[0] == "wait"
+        assert gm.permit(m2, m2, "n2")[0] == "wait"
+        assert gm.permit(other, other, "n3")[0] == "wait"
+        rollbacks, requeue = gm.node_gone("n1")
+        # the WHOLE gang on the dead slice rolls back...
+        assert sorted(p.metadata.name for p, _ in rollbacks) == ["m1", "m2"]
+        assert sorted(p.metadata.name for p in requeue) == ["m1", "m2"]
+        # ...the unaffected gang keeps its reservation
+        assert gm.reservations() == [("default/g2",
+                                      other.metadata.key(), "n3")]
+        # idempotent: the node is already drained
+        assert gm.node_gone("n1") == ([], [])
+
+    def test_node_gone_resets_domain_pin(self):
+        """After the reserved slice dies, the rescheduled gang must be
+        free to pick a NEW domain — a stale pin would wedge it on the
+        dead slice forever."""
+        clock = FakeClock()
+        groups = {("default", "g1"):
+                  make_group("g1", 2, topology_key="tpu/slice")}
+        slice_of = {"n1": "a", "n2": "b", "n3": "b"}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock,
+                         node_label=lambda node, key: slice_of.get(node))
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        assert gm.permit(m1, m1, "n1")[0] == "wait"   # pins slice "a"
+        gm.node_gone("n1")
+        # both members re-reserve on slice "b" without a reject
+        assert gm.permit(m1, m1, "n2")[0] == "wait"
+        assert gm.permit(m2, m2, "n3")[0] == "allow"
+
+    def test_orphaned_reservation_drains_via_expire(self):
+        """pod_gone (the POD deleted mid-gate) orphans only that
+        reservation; the next expire() sweep returns it for cache
+        rollback without requeueing the deleted pod."""
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 3, timeout=300)}
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock)
+        m1, m2 = make_pod("m1", group="g1"), make_pod("m2", group="g1")
+        gm.permit(m1, m1, "n1")
+        gm.permit(m2, m2, "n2")
+        gm.pod_gone(m1)
+        rollbacks, requeue = gm.expire(clock.now())
+        assert [p.metadata.name for p, _ in rollbacks] == ["m1"]
+        assert requeue == []  # the pod is gone; nothing to requeue
+        # the survivor still holds its reservation for a recreated member
+        assert gm.reservations() == [("default/g1",
+                                      m2.metadata.key(), "n2")]
+
     def test_expire_rolls_back_whole_gang(self):
         clock = FakeClock()
         groups = {("default", "g1"): make_group("g1", 3, timeout=30)}

@@ -102,6 +102,38 @@ class TestWal:
         assert names == ["after-crash", "before-crash"]
         store3.close()
 
+    def test_reopen_truncates_exactly_at_clean_offset(self, tmp_path):
+        """Crash recovery contract: load_wal reports the byte offset of
+        the last COMPLETE record; reopening the store truncates the file
+        to exactly that offset before appending, and every post-recovery
+        append lands where the next replay reads it (the chaos
+        invariant's wal_digest sees the full post-crash history)."""
+        from kubernetes_tpu.chaos.invariants import wal_digest
+        from kubernetes_tpu.state.wal import load_wal
+        path = str(tmp_path / "store.wal")
+        store = Store(wal_path=path)
+        Client(store).pods("default").create(make_pod("p1"))
+        store.close()
+        _, clean = load_wal(path)
+        assert clean == os.path.getsize(path)
+        with open(path, "ab") as f:  # torn tail: header + partial payload
+            f.write(struct.pack("<I", 9999))
+            f.write(b'{"op":')
+        assert os.path.getsize(path) > clean
+        store2 = Store(wal_path=path)  # reopen truncates at clean_offset
+        assert os.path.getsize(path) == clean
+        client2 = Client(store2)
+        client2.pods("default").create(make_pod("p2"))
+        client2.pods("default").delete("p1")
+        store2.flush_wal()
+        # the journal now replays to EXACTLY the live store
+        assert wal_digest(path) == store2.contents()
+        store2.close()
+        store3 = Store(wal_path=path)  # post-recovery appends survive
+        names = [p.metadata.name for p in Client(store3).pods("default").list()]
+        assert names == ["p2"]
+        store3.close()
+
     def test_compaction_bounds_replay(self, tmp_path):
         path = str(tmp_path / "store.wal")
         store = Store(wal_path=path)
