@@ -596,6 +596,28 @@ def measure_device_profile(n_nodes=None, n_pods=16384, batch=16384):
         n_bound = sched._commit_results(results, 0)
         t4 = _time.perf_counter()
     total = t4 - t0
+    # ---- pipeline occupancy: the SAME stages through drain_pipelined's
+    # three-stage overlap (commit thread + chained device usage). The
+    # serial stage sum above is the no-overlap cost of one batch; the
+    # pipelined per-batch critical path must come in below it — i.e.
+    # host_commit no longer serializes the loop (ISSUE 3 acceptance).
+    # 4 batches: the first has no predecessor to overlap and the last
+    # commit has no successor to hide under, so 2 batches would measure
+    # mostly pipeline fill/drain tail, not steady state.
+    n_pipe = 4
+    pipe_pods = []
+    for i in range(n_pipe * batch):
+        p = client.pods().create(make_pod(4_000_000 + i))
+        precompute_pod_features(p)
+        pipe_pods.append(p)
+        sched.queue.add(p)
+    with _gc_paused():
+        p0 = _time.perf_counter()
+        pipe_bound = sched.drain_pipelined()
+        p1 = _time.perf_counter()
+    pipe_wall = p1 - p0
+    per_batch = pipe_wall / n_pipe
+    commit_h = sched.metrics.commit_overlap_duration
     return {
         "batch": len(first), "nodes": n_nodes,
         "host_launch_s": round(t1 - t0, 4),
@@ -604,8 +626,24 @@ def measure_device_profile(n_nodes=None, n_pods=16384, batch=16384):
         "host_commit_s": round(t4 - t3, 4),
         "total_s": round(total, 4),
         "bound": n_bound,
+        "pipeline": {
+            "batches": n_pipe, "bound": pipe_bound,
+            "wall_s": round(pipe_wall, 4),
+            "per_batch_critical_path_s": round(per_batch, 4),
+            "stage_sum_s": round(total, 4),
+            #: commit-thread wall time overlapped with the next batch's
+            #: launch + device compute (scheduler_commit_overlap_*)
+            "commit_overlapped_s": round(commit_h.sum(), 4),
+            "commit_batches": commit_h.count(),
+            "host_commit_overlapped": bool(per_batch < total),
+            "occupancy_vs_serial": round(total / per_batch, 2)
+            if per_batch > 0 else None,
+        },
         "note": "device_compute includes TPU-tunnel RTT; fetch_unpack is"
-                " the packed [2,P] device->host transfer + repair",
+                " the packed [2,P] device->host transfer + repair;"
+                " pipeline.* is the same work through the pipelined drain"
+                " (commit stage concurrent with the next batch's"
+                " launch+compute)",
     }
 
 

@@ -472,6 +472,16 @@ def shallow_bind_clone(pod: T) -> T:
     return new
 
 
+def shallow_meta_clone(obj: T) -> T:
+    """Clone only the object shell + metadata — the layers a delete path
+    or a resourceVersion restamp mutates — sharing spec/status/everything
+    else with the frozen source (the delete/restamp analog of
+    shallow_bind_clone, same read-only-discipline safety argument)."""
+    new = _dict_copy(obj)
+    new.metadata = _dict_copy(obj.metadata)
+    return new
+
+
 def _dict_copy(obj):
     new = object.__new__(obj.__class__)
     new.__dict__ = obj.__dict__.copy()

@@ -83,15 +83,21 @@ class _HTTPWatch:
                 if not line:
                     continue
                 frame = json.loads(line)
-                if frame.get("slim") == "bind":
-                    # negotiated compact bind frame: the informer
-                    # materializes the pod from its cached prior revision
-                    o = frame["o"]
-                    rv = int(o["rv"])
-                    self.events.put(WatchEvent(
-                        frame["type"],
-                        SlimBindRef(o.get("namespace", ""), o["name"],
-                                    o["node"], o.get("ts"), rv), rv))
+                slim = frame.get("slim")
+                if slim == "bind" or slim == "binds":
+                    # negotiated compact bind frame(s): the informer
+                    # materializes each pod from its cached prior
+                    # revision. "binds" is the server's coalesced form —
+                    # one frame (one dumps/loads) for a whole bind batch,
+                    # split back into per-pod events here
+                    items = [frame["o"]] if slim == "bind" \
+                        else frame["o"]["items"]
+                    for o in items:
+                        rv = int(o["rv"])
+                        self.events.put(WatchEvent(
+                            frame["type"],
+                            SlimBindRef(o.get("namespace", ""), o["name"],
+                                        o["node"], o.get("ts"), rv), rv))
                     continue
                 obj = serde.decode(self._cls, frame["object"])
                 rv = int(obj.metadata.resource_version or 0)
@@ -361,6 +367,42 @@ class HTTPPodClient(HTTPResourceClient):
             "POST", self._url(binding.metadata.name, namespace=ns,
                               subresource="binding"), binding))
 
+    def bind_bulk_pairs(self, namespace: str, pairs) -> List[Any]:
+        """One POST of slim BindList pairs to one namespace -> one store
+        transaction server-side. The cheapest wire bind: no Binding/
+        ObjectMeta construction caller-side, no per-item serde decode
+        server-side. Result slots are truthy success markers or per-slot
+        Exceptions, in pair order."""
+        if not pairs:
+            return []
+        body = {"apiVersion": "v1", "kind": "BindList",
+                "items": [[name, node] for name, node in pairs]}
+        url = f"{self._base}/api/v1/namespaces/{namespace}/bindings"
+        resp = self._request("POST", url, body,
+                             content_type="application/json")
+        out = [self._decode_bind_slot(item)
+               for item in resp.get("items", [])]
+        # a truncated/malformed response must not leave missing slots —
+        # the scheduler treats non-Exception slots as bound pods
+        while len(out) < len(pairs):
+            out.append(RuntimeError("bulk bind: missing result slot"))
+        return out[:len(pairs)]
+
+    @staticmethod
+    def _decode_bind_slot(item):
+        from ..state.store import ConflictError, NotFoundError
+        if item.get("kind") == "Status" and \
+                item.get("status") != "Success":
+            reason = item.get("reason", "")
+            msg = item.get("message", "")
+            return {"NotFoundError": NotFoundError,
+                    "ConflictError": ConflictError} \
+                .get(reason, RuntimeError)(msg)
+        if item.get("kind") == "Status":
+            return True
+        # an older/full server echoing the bound pod
+        return serde.decode(corev1.Pod, item)
+
     def bind_bulk(self, bindings: List[corev1.Binding]) -> List[Any]:
         """One POST of a Binding List per namespace -> one store
         transaction server-side (the wire analog of the in-process batch
@@ -372,44 +414,20 @@ class HTTPPodClient(HTTPResourceClient):
         (the scheduler clones locally; the informer echo confirms)."""
         if not bindings:
             return []
-        from ..state.store import ConflictError, NotFoundError
         by_ns: dict = {}
         for i, b in enumerate(bindings):
             ns = b.metadata.namespace or self._effective_ns()
             by_ns.setdefault(ns, []).append((i, b))
         out: List[Any] = [None] * len(bindings)
         for ns, slots in by_ns.items():
-            # the slim BindList form: [name, nodeName] pairs — the server
-            # reconstructs Bindings without a per-item serde decode
-            body = {"apiVersion": "v1", "kind": "BindList",
-                    "items": [[b.metadata.name, b.target.name]
-                              for _, b in slots]}
-            url = (f"{self._base}/api/v1/namespaces/{ns}/bindings")
             try:
-                resp = self._request("POST", url, body,
-                                     content_type="application/json")
+                rs = self.bind_bulk_pairs(
+                    ns, [(b.metadata.name, b.target.name)
+                         for _, b in slots])
             except Exception as e:
-                for i, _ in slots:
-                    out[i] = e
-                continue
-            for (i, _), item in zip(slots, resp.get("items", [])):
-                if item.get("kind") == "Status" and \
-                        item.get("status") != "Success":
-                    reason = item.get("reason", "")
-                    msg = item.get("message", "")
-                    exc = {"NotFoundError": NotFoundError,
-                           "ConflictError": ConflictError} \
-                        .get(reason, RuntimeError)(msg)
-                    out[i] = exc
-                elif item.get("kind") == "Status":
-                    out[i] = True
-                else:  # an older/full server echoing the bound pod
-                    out[i] = serde.decode(corev1.Pod, item)
-        # a truncated/malformed response must not leave None slots — the
-        # scheduler treats non-Exception slots as bound pods
-        for i, v in enumerate(out):
-            if v is None:
-                out[i] = RuntimeError("bulk bind: missing result slot")
+                rs = [e] * len(slots)
+            for (i, _), r in zip(slots, rs):
+                out[i] = r
         return out
 
 

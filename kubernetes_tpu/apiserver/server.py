@@ -37,8 +37,8 @@ from .admission import QuotaExceeded
 from ..api.validation import ValidationError
 from ..runtime.scheme import SCHEME, Scheme
 from ..state.client import Client, TooManyDisruptions
-from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
-                           NotFoundError, Store)
+from ..state.store import (MODIFIED, AlreadyExistsError, ConflictError,
+                           ExpiredError, NotFoundError, Store)
 
 
 class AdmissionDenied(Exception):
@@ -1236,20 +1236,41 @@ class APIServer:
                     batch.append(nxt)
                 # per-object cached JSON: one encode per revision shared
                 # across every watcher/list/journal of that revision;
-                # negotiated slim frames skip even that
+                # negotiated slim frames skip even that. Consecutive slim
+                # bind events COALESCE into one {"slim": "binds"} frame —
+                # a bulk bind lands thousands of MODIFIED events in this
+                # batch, and one json.dumps per event was the hub's
+                # largest remaining watch cost (the client splits the
+                # frame back into per-pod events)
                 parts = []
+                slim_run: list = []
+
+                def flush_slim():
+                    if not slim_run:
+                        return
+                    if len(slim_run) == 1:
+                        parts.append(
+                            f'{{"type": "MODIFIED", "slim": "bind", '
+                            f'"o": {json.dumps(slim_run[0])}}}\n'.encode())
+                    else:
+                        parts.append(
+                            ('{"type": "MODIFIED", "slim": "binds", "o": '
+                             + json.dumps({"items": slim_run})
+                             + "}\n").encode())
+                    slim_run.clear()
                 for e in batch:
-                    if slim_ok and e.slim is not None:
+                    if slim_ok and e.slim is not None and \
+                            e.type == MODIFIED:
                         d = dict(e.slim)
                         d["rv"] = e.resource_version
-                        parts.append(
-                            f'{{"type": "{e.type}", "slim": "bind", '
-                            f'"o": {json.dumps(d)}}}\n'.encode())
+                        slim_run.append(d)
                     else:
+                        flush_slim()
                         parts.append(
                             (f'{{"type": "{e.type}", "object": '
                              f"{serde.to_json_cached(e.object)}}}\n")
                             .encode())
+                flush_slim()
                 write_chunk(b"".join(parts))
                 if closing:
                     break

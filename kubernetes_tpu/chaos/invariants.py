@@ -51,6 +51,13 @@ def wal_digest(path: str) -> dict:
             if key in state:
                 state[key] = rec["rv"]
             continue
+        if op == "BINDS":
+            # group-commit bind transaction: per-entry rv restamps
+            for b in obj.get("binds", ()):
+                key = (resource, b.get("namespace", ""), b.get("name", ""))
+                if key in state:
+                    state[key] = b["rv"]
+            continue
         md = obj.get("metadata") or {}
         key = (resource, md.get("namespace", ""), md.get("name", ""))
         if op == "DELETE":

@@ -141,16 +141,19 @@ class PodGroupController(Controller):
         """Failed -> Pending: delete EVERY member (failed ones and
         survivors alike — the slice fails as a unit) and recreate each as
         a clean clone, then reset the group's status. Clones are captured
-        up front and deletes run BEFORE any create, so a delete failure
-        aborts with every not-yet-deleted member intact (the re-synced
-        rebuild still has their specs). Creates retry with backoff and
+        up front and deletes run BEFORE any create; a delete failure
+        aborts AFTER recreating the members already deleted (their specs
+        live only in the clones), leaving every spec reachable for the
+        re-synced retry. Creates retry with backoff and
         are all attempted even when one exhausts its policy; a member
         whose create still fails is LOST — its spec lived only in the
         deleted pod — so the loss is raised loudly rather than absorbed
         (ROADMAP: spec snapshots on the PodGroup would close this)."""
         from ..state.store import AlreadyExistsError, NotFoundError
         clones = [self._clean_clone(pod) for pod in members]
-        for pod in members:
+        deleted: list = []   # clones of members whose delete committed
+        abort = None
+        for pod, clone in zip(members, clones):
             try:
                 backoff.retry(
                     lambda p=pod: self.client.pods(ns).delete(
@@ -160,8 +163,17 @@ class PodGroupController(Controller):
                     op="resubmit_delete")
             except NotFoundError:
                 pass  # already gone; recreate below regardless
+            except Exception as e:
+                # a delete that exhausted its retry policy: the members
+                # not yet deleted are intact in the store, but the ones
+                # ALREADY deleted exist only as clones here — recreate
+                # THEM before aborting, or the re-synced rebuild (which
+                # reads live members) could never see their specs again
+                abort = e
+                break
+            deleted.append(clone)
         lost = []
-        for clone in clones:
+        for clone in (deleted if abort is not None else clones):
             try:
                 backoff.retry(
                     lambda c=clone: self.client.pods(ns).create(c),
@@ -178,6 +190,10 @@ class PodGroupController(Controller):
                 f"{lost}: deleted but could not be recreated — the gang "
                 f"cannot reach minMember until they are resubmitted "
                 f"out of band")
+        if abort is not None:
+            # every committed delete was restored; the phase stays Failed
+            # and the rate-limited re-sync retries the whole resubmission
+            raise abort
         self.metrics.gang_resubmissions.inc()
 
         def reset(cur):

@@ -57,6 +57,15 @@ class Cache:
         self._assumed_deadline: Dict[str, float] = {}
         self._node_tree = NodeTree()
 
+    @property
+    def lock(self):
+        """The cache's RLock (reentrant). The pipelined drain takes it to
+        make {assume_pod + its own-mutation counter bump} and
+        {mutation_seq vs counter comparison} atomic steps — the chain
+        validity protocol between the commit thread and the launch path
+        (scheduler._tracked_assume / _chain_intact)."""
+        return self._lock
+
     def node_names(self) -> List[str]:
         with self._lock:
             return list(self._nodes)

@@ -954,10 +954,20 @@ class BatchScheduler:
         is provably host truth + the chain's own assignments:
           - the chain batch is residual-free (no repair can demote a winner),
           - every cache mutation since the drain's bookkeeping point came
-            from the drain's own assumes (cache.mutation_seq == chain_seq),
+            from the drain's own assumes (`chain_seq`: either the expected
+            mutation_seq, or a callable the pipelined drain supplies that
+            performs the {mutation_seq == base + own assumes} comparison
+            under the cache lock — the commit thread assumes concurrently,
+            so a point-in-time integer cannot express the condition),
           - device state survived (no capacity/column resize), and
           - this batch carries no host-computed static scores (they would be
             one batch staler than the sequential path).
+        Gang-carrying batches chain too (both directions): the gang kernel's
+        trial/commit carry means its post-batch usage holds only COMMITTED
+        gangs' placements, and every committed member is assumed (bind path
+        or permit-gate reservation) — losses after the chain was taken
+        (atomicity demotions, permit rejects) surface through the same
+        phantom/epoch machinery as singleton losses.
         Otherwise returns None and the caller must flush the pipeline and
         relaunch unchained."""
         if not pods:
@@ -972,11 +982,13 @@ class BatchScheduler:
         affinity_only = not self._has_filter_extenders() and all(
             not (_pod_has_conflict_volumes(p) or _pod_has_pvc(p)
                  or _pod_has_attach_volumes(p)) for p in pods)
+        chain_intact = chain_seq is not None and (
+            chain_seq() if callable(chain_seq)
+            else self.cache.mutation_seq == chain_seq)
         chaining = (chain is not None
                     and (chain.residual_free or chain.affinity_chainable)
                     and DEFAULT_FEATURE_GATE.enabled("SchedulerDeviceChaining")
-                    and chain_seq is not None
-                    and self.cache.mutation_seq == chain_seq
+                    and chain_intact
                     and not self._static_likely
                     and self.mirror.device_ready()
                     and affinity_only)
@@ -1008,12 +1020,12 @@ class BatchScheduler:
         affinity_chainable = affinity_only and not any(
             helpers.pod_host_ports(p) for p in pods)
         #: gang units present -> the all-or-nothing kernel decides this
-        #: batch; such batches never chain in either direction (the gang
-        #: trial/commit windows need the committed usage as their base)
+        #: batch. Gang batches CHAIN like singleton batches: the kernel's
+        #: trial/commit carry isolates uncommitted (rejected-gang) state,
+        #: so its post-batch usage is exactly committed-gang placements —
+        #: each of which the commit path assumes (bind or reservation)
         gang_units = self.gang.batch_groups(pods) \
             if self.gang is not None else None
-        if chaining and gang_units is not None:
-            return None
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
                                 seq_base=self._seq_base)
@@ -1077,10 +1089,8 @@ class BatchScheduler:
         return PendingBatch(pods=pods, profiles=profiles, batch=batch,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
-                            residual_free=(residual_free
-                                           and gang_units is None),
-                            affinity_chainable=(affinity_chainable
-                                                and gang_units is None),
+                            residual_free=residual_free,
+                            affinity_chainable=affinity_chainable,
                             chained=chaining,
                             usage_epoch=self.mirror.usage_epoch,
                             gang_units=gang_units)
@@ -1119,14 +1129,15 @@ class BatchScheduler:
             for r in out:
                 if r.node_name is None:
                     r.retry = True
-        if not any(r.retry for r in out) and \
-                pending.usage_epoch == self.mirror.usage_epoch:
+        if not any(r.retry for r in out):
             # every surviving assignment flows through cache.assume_pod, so
             # the chained usage matches host truth (or gets scatter-repaired).
-            # An epoch mismatch means invalidate_usage fired after this
-            # batch launched: its usage input carries the phantom state that
-            # invalidation dropped — re-adopting would resurrect it.
-            self.mirror.adopt_usage(pending.new_usage)
+            # The epoch is checked INSIDE adopt_usage (atomically with the
+            # write): an invalidate_usage after this batch launched means
+            # its usage input carries the phantom state that invalidation
+            # dropped — re-adopting would resurrect it, so it is refused.
+            self.mirror.adopt_usage(pending.new_usage,
+                                    epoch=pending.usage_epoch)
         return out
 
     def _enforce_gang_atomicity(self, results: List[ScheduleResult],

@@ -32,6 +32,14 @@ would wedge the slice the moment the PodGroup arrives.
 Lock order: callers holding the SchedulingQueue lock may call into the
 manager (pop/add hooks); the manager never calls back into the queue, so
 queue-lock -> manager-lock is the only ordering.
+
+Pipelined-drain interplay: permit-gate reservations are TRACKED assumes
+(scheduler._tracked_assume), so a gang straddling batches keeps the
+device-usage chain account balanced; every rollback path here (reject,
+timeout expire, node_gone, bind_failed) forgets reservations UNtracked —
+by design, that breaks the chain equality so the drain flushes and
+relaunches from host truth, and the scheduler shell phantom-marks
+in-flight chained batches whose usage counted the rolled-back members.
 """
 
 from __future__ import annotations

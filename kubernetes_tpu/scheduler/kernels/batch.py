@@ -376,7 +376,10 @@ def apply_dirty(node_cfg: dict, usage: dict, idx: jnp.ndarray,
                 cfg_rows: dict, usage_rows: dict) -> Tuple[dict, dict]:
     """Scatter O(delta) dirty rows (cache.go:210-246's generation scan,
     shipped as one packed upload) into the device-resident state. Padded
-    slots carry idx = -1 and are dropped (out-of-bounds scatter mode)."""
+    slots carry an OUT-OF-RANGE row index (the mirror pads with
+    `capacity`, one past the last row) and are dropped by the scatter's
+    mode="drop" — a pad row must never alias row 0 or clamp onto the last
+    real row (covered by tests/test_pipeline.py's pad-row fixture)."""
     new_cfg = {k: node_cfg[k].at[idx].set(cfg_rows[k], mode="drop")
                for k in node_cfg}
     new_usage = {k: usage[k].at[idx].set(usage_rows[k], mode="drop")

@@ -39,6 +39,13 @@ masked to -1 AFTER the scan via the per-gang ok vector — the usage they
 touched only ever lived in the discarded trial, so no rollback scatter is
 needed.
 
+The same isolation is what makes gang batches CHAINABLE in the pipelined
+drain (core.schedule_launch): the returned usage holds exactly the
+committed gangs' placements — every one of which the commit path assumes
+into the cache (bind or permit-gate reservation) — so a successor batch
+may take it as its usage input before the host commit lands, with losses
+surfacing through the ordinary phantom/epoch machinery.
+
 `gang_schedule_reference` is the host numpy mirror (same op order, f32
 throughout) — the parity oracle for tests/test_gang.py's randomized
 instances, in the same role predicates.py/priorities.py play for the

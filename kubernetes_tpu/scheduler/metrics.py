@@ -35,6 +35,15 @@ class SchedulerMetrics:
             "scheduler_binding_duration_seconds",
             "Bind transaction latency per batch",
             buckets=SCHEDULING_LATENCY_BUCKETS)
+        # pipelined drain: wall time the commit stage spent on the commit
+        # thread — time the drain thread did NOT serialize on (it was
+        # tensorizing/dispatching the next batch); the occupancy lens the
+        # device_profile's pipelined section reports per-batch
+        self.commit_overlap_duration = r.histogram(
+            "scheduler_commit_overlap_duration_seconds",
+            "Commit-stage wall time overlapped with the next batch's "
+            "launch and device compute (pipelined drain)",
+            buckets=SCHEDULING_LATENCY_BUCKETS)
         # ref: scheduleAttempts counter labeled result
         # {scheduled, unschedulable, error}
         self.schedule_attempts = r.counter(

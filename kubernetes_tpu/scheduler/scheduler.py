@@ -6,10 +6,13 @@ assume :382, bind :411) and eventhandlers.go:319-469 AddAllEventHandlers.
 Differences from the reference, by design:
   - scheduleOne becomes schedule_batch: the queue drains up to `batch_size`
     pods per cycle and the TPU kernel decides the whole batch.
-  - binds are issued synchronously against the in-process store as ONE bulk
-    transaction per batch (`_assume_and_bind_all` -> PodClient.bind_bulk);
-    the reference's async bind goroutine exists to overlap a ~100ms apiserver
-    round trip that does not exist in-process.
+  - binds are issued against the store as ONE bulk transaction per batch
+    (`_assume_and_bind_all` -> PodClient.bind_bulk_pairs); in the
+    pipelined drain the whole commit stage (volumes + plugins + bind +
+    assume) runs on a dedicated commit thread, overlapped with the next
+    batch's tensorization and device scan — the batch-scale analog of
+    the reference's async bind goroutine, which exists to overlap a
+    ~100ms apiserver round trip.
   - assume/finish_binding/forget semantics are identical: assumed pods count
     against nodes immediately, are confirmed by the informer's add event, and
     expire on TTL if a bind is lost (internal/cache/interface.go:40-120).
@@ -57,10 +60,39 @@ class Scheduler:
         #: scheduler.go:411 — extender bind wins when it manages the pod)
         self._bind_extender = next(
             (e for e in self.extenders if e.supports_bind()), None)
-        #: last committed batch's winners + phantom flag — handed to a
-        #: successor batch that chained on it (drain_pipelined)
-        self._last_commit_winners: list = []
-        self._last_commit_phantom = False
+        # ---- pipelined-drain state (drain_pipelined) ----
+        #: chain-validity protocol: mutation_seq anchor + count of the
+        #: pipeline's OWN tracked assumes since the anchor. The commit
+        #: thread bumps the count under the cache lock together with each
+        #: assume; _chain_intact compares under the same lock.
+        self._pipe_base = 0
+        self._pipe_assumes = 0
+        #: sticky since the last anchor: some chained batch's usage counts
+        #: a winner that was later lost (repair demotion, commit drop,
+        #: permit reject/rollback) — in-flight chained batches must retry
+        #: their unassigned pods instead of parking them
+        self._pipe_phantom = False
+        #: winners of the last two finished batches — the set whose commits
+        #: may postdate an in-flight chained batch's snapshot (its repair
+        #: validates against them exactly like same-batch winners)
+        from collections import deque as _deque
+        self._pipe_outcomes = _deque(maxlen=2)
+        #: single-worker commit stage (created on first pipelined drain):
+        #: FIFO, so batch N's commit completes before batch N+1's starts
+        self._commit_pool_ = None
+        #: None until first drain: run the commit stage on the commit
+        #: thread only when it can overlap something outside this
+        #: thread's GIL — a cross-process bind POST (wire path), a real
+        #: accelerator's dispatch/fetch waits, or XLA CPU compute on a
+        #: many-core host. On a GIL-starved small host (<=2 cores, CPU
+        #: backend, in-process store) the thread only timeshares against
+        #: tensorize, so the stage runs inline — same code, same
+        #: bookkeeping. KTPU_COMMIT_THREAD=0/1 overrides.
+        self._commit_async: Optional[bool] = None
+        #: serializes the tensorize/launch/finish machinery (drain thread)
+        #: against the rare commit-thread re-entries into the algorithm
+        #: (explain / preempt refresh the snapshot+mirror)
+        self._algo_lock = threading.RLock()
         import os as _os
         #: split pops at power-of-two boundaries when the scan pad would
         #: exceed 25% (see drain_pipelined); KTPU_ALIGN_SPLIT=0 disables
@@ -248,6 +280,10 @@ class Scheduler:
         trace = Trace("gang_node_gone", node=node_name,
                       reservations=len(rollbacks))
         self.cache.forget_pods([clone for _, clone in rollbacks])
+        # chained usage may count the rolled-back reservations: in-flight
+        # chained batches must retry their losers (the untracked forgets
+        # already force the next launch to flush and re-upload host truth)
+        self._pipe_phantom = True
         trace.step("reservations rolled back from the cache")
         for pod in requeue:
             self.volume_binder.forget_pod_volumes(pod)
@@ -374,24 +410,108 @@ class Scheduler:
             return self._assume_and_bind_all(bound)
         return 0
 
+    # ------------------------------------------------- pipelined drain
+
+    @property
+    def _commit_pool(self):
+        if self._commit_pool_ is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._commit_pool_ = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="commit")
+        return self._commit_pool_
+
+    def _commit_overlaps(self) -> bool:
+        if self._commit_async is None:
+            import os as _os
+            flag = _os.environ.get("KTPU_COMMIT_THREAD")
+            if flag is not None:
+                self._commit_async = flag != "0"
+            elif self._async_bind:
+                self._commit_async = True
+            else:
+                # a real accelerator's dispatch/fetch waits release the
+                # GIL, and on a many-core host the XLA CPU "device" runs
+                # on cores the commit thread doesn't contend; only a
+                # GIL-starved small host loses to the extra thread
+                try:
+                    import jax
+                    backend = jax.default_backend()
+                except Exception:
+                    backend = "cpu"
+                self._commit_async = backend != "cpu" or \
+                    (_os.cpu_count() or 1) >= 4
+        return self._commit_async
+
+    def _pipe_anchor(self) -> None:
+        """(Re)anchor the chain-validity protocol. Callers guarantee no
+        finish or commit is in flight. From here on, every cache mutation
+        must be one of the pipeline's own tracked assumes for device-usage
+        chaining to continue."""
+        with self.cache.lock:
+            self._pipe_base = self.cache.mutation_seq
+            self._pipe_assumes = 0
+        self._pipe_phantom = False
+        self._pipe_outcomes.clear()
+
+    def _chain_intact(self) -> bool:
+        """True while every mutation since the anchor was our own tracked
+        assume. Read atomically vs the commit thread (both counters only
+        grow, so a foreign mutation breaks the equality permanently).
+        core.schedule_launch calls this as its chain_seq check."""
+        with self.cache.lock:
+            return self.cache.mutation_seq == \
+                self._pipe_base + self._pipe_assumes
+
+    def _tracked_assume(self, pod: Pod) -> None:
+        """cache.assume_pod plus the pipeline's own-mutation accounting in
+        ONE cache-lock critical section — the commit thread assumes while
+        the drain thread launches, and a torn read of (mutation_seq,
+        assume count) would refuse every overlapped chain."""
+        with self.cache.lock:
+            self.cache.assume_pod(pod)
+            self._pipe_assumes += 1
+
     def drain_pipelined(self) -> int:
-        """Drain the queue with device/host overlap: batch N+1's kernel runs
-        on device (usage chained from batch N's dispatch, ahead of its host
-        commit) while batch N's results are repaired, bound, and assumed on
-        host. Chaining is refused — and the pipeline falls back to the
-        sequential path — whenever any cache mutation did not come from this
-        drain's own assumes (cache.mutation_seq bookkeeping), the previous
-        batch could be repaired on host, static scores are in play, or
-        device state was resized. Returns the number of pods bound."""
+        """Drain the queue with a three-stage pipeline:
+
+            drain thread   pop -> tensorize -> device dispatch   (batch N+1)
+            device         filter+score+assign scan              (batch N+1)
+            commit thread  volumes + plugins + bind + assume     (batch N)
+
+        Batch N+1's kernel runs against batch N's post-batch device usage
+        (chained ahead of the host commit) so its scan sees N's placements
+        without waiting for the commit, and the commit itself overlaps the
+        next batch's tensorization and device compute instead of
+        serializing the loop (BENCH_r05: host_commit was ~40% of batch
+        wall time with the device idle). Gang batches chain like singleton
+        batches — the gang kernel's trial/commit carry isolates rejected
+        gangs, so its post-batch usage holds only committed placements.
+
+        Chaining is refused — and the pipeline flushed back to the
+        sequential path — whenever any cache mutation since the anchor was
+        not the pipeline's own tracked assume (_chain_intact), the
+        previous batch could be repaired on host, static scores are in
+        play, or device state was resized/invalidated. A commit failure
+        (lost bind, permit reject) forgets the assumed pod, invalidates
+        chained device usage, and marks the pipeline phantom so in-flight
+        chained batches retry their unassigned pods. Returns pods bound."""
         self._gang_housekeeping()
-        start = self.scheduled_count
+        with self._count_lock:
+            start = self.scheduled_count
         prev: Optional[tuple] = None        # (PendingBatch, cycle)
-        expected_seq: Optional[int] = None
+        commit_fut = None                   # in-flight commit stage
         carry: List[Pod] = []               # soft-score sub-batch tail
+        self._pipe_anchor()
         def _mark(n: int) -> None:
-            self._in_flight += n
+            with self._count_lock:
+                self._in_flight += n
         try:
             while True:
+                # per-cycle like schedule_pending's loop: a long drain must
+                # still roll back permit-timeout reservations mid-stream
+                # (the untracked forgets break the chain -> flush, which is
+                # exactly the self-heal the rollback needs)
+                self._gang_housekeeping()
                 cycle = self.queue.scheduling_cycle
                 if carry:
                     pods, carry = carry, []
@@ -423,6 +543,12 @@ class Scheduler:
                 if pods:
                     self.metrics.batch_size.observe(len(pods))
                 if not pods and prev is None:
+                    if commit_fut is not None:
+                        # a failed commit may have requeued pods — settle
+                        # it and re-check the queue
+                        commit_fut.result()
+                        commit_fut = None
+                        continue
                     # drain the binder thread before declaring done: a
                     # failed async bind may have requeued its pod
                     if self._flush_binds():
@@ -430,66 +556,103 @@ class Scheduler:
                     break
                 pending = None
                 if pods:
-                    if prev is not None and expected_seq is not None:
-                        pending = self.algorithm.schedule_launch(
-                            pods, chain=prev[0], chain_seq=expected_seq)
+                    if prev is not None:
+                        with self._algo_lock:
+                            pending = self.algorithm.schedule_launch(
+                                pods, chain=prev[0],
+                                chain_seq=self._chain_intact)
                     if pending is None:
+                        # pipeline flush: settle every in-flight stage,
+                        # then relaunch sequentially from host truth
                         if prev is not None:
-                            expected_seq = self._finish_and_commit(
-                                prev[0], prev[1], expected_seq)
+                            commit_fut = self._finish_pipelined(
+                                prev[0], prev[1], commit_fut)
                             prev = None
-                        pre_seq = self.cache.mutation_seq
-                        pending = self.algorithm.schedule_launch(pods)
-                        expected_seq = pre_seq
+                        if commit_fut is not None:
+                            commit_fut.result()
+                            commit_fut = None
+                        self._pipe_anchor()
+                        with self._algo_lock:
+                            pending = self.algorithm.schedule_launch(pods)
                 if prev is not None:
-                    expected_seq = self._finish_and_commit(
-                        prev[0], prev[1], expected_seq)
-                    if pending is not None and pending.chained:
-                        # the pending batch launched against prev's
-                        # UNCOMMITTED state: hand it prev's committed
-                        # winners (its repair validates against them) and
-                        # whether prev lost winners after the usage chain
-                        # was taken (phantom space in pending's input)
-                        pending.stale_winners = self._last_commit_winners
-                        pending.phantom = self._last_commit_phantom
-                        if pending.phantom:
-                            # the chained usage permanently carries the
-                            # lost winners; drop device usage so the next
-                            # launch re-uploads host truth (and pending's
-                            # own adopt is epoch-refused)
-                            self.algorithm.mirror.invalidate_usage()
+                    commit_fut = self._finish_pipelined(prev[0], prev[1],
+                                                        commit_fut)
                 prev = (pending, cycle) if pending is not None else None
         finally:
-            self._in_flight = 0
-        return self.scheduled_count - start
+            if commit_fut is not None:
+                try:
+                    commit_fut.result()
+                except Exception:
+                    pass
+            with self._count_lock:
+                self._in_flight = 0
+        with self._count_lock:
+            return self.scheduled_count - start
 
-    def _finish_and_commit(self, pending, cycle: int,
-                           expected_seq: Optional[int]) -> Optional[int]:
+    def _finish_pipelined(self, pending, cycle: int, commit_fut):
+        """Fetch+repair `pending` on the drain thread, then hand its
+        results to the commit stage (returns the new commit future). The
+        PREDECESSOR's commit is joined first: this batch's repair
+        validates against its final winners and losses."""
         import time as _time
+        if commit_fut is not None:
+            commit_fut.result()
+        if pending.chained:
+            # winners the snapshot/mask predate: the last two finished
+            # batches (their commits may postdate this batch's launch);
+            # a conservative double-count only makes the repair stricter
+            stale: list = []
+            for winners in self._pipe_outcomes:
+                stale.extend(winners)
+            pending.stale_winners = stale or None
+            pending.phantom = self._pipe_phantom
+            if pending.phantom:
+                # the chained usage permanently carries the lost winners;
+                # drop device usage so the next launch re-uploads host
+                # truth (and this batch's own adopt is epoch-refused)
+                self.algorithm.mirror.invalidate_usage()
         t0 = _time.perf_counter()
-        results = self.algorithm.schedule_finish(pending)
+        with self._algo_lock:
+            results = self.algorithm.schedule_finish(pending)
         t1 = _time.perf_counter()
+        self.metrics.scheduling_duration.observe(t1 - t0, operation="fetch")
+        if any(r.retry for r in results):
+            # losers the chained usage already counted: in-flight chained
+            # successors must retry their unassigned pods, not park them
+            self._pipe_phantom = True
+        self._pipe_outcomes.append(
+            [(r.pod, r.node_name) for r in results
+             if r.node_name is not None])
+        if self._commit_overlaps():
+            return self._commit_pool.submit(self._commit_stage, results,
+                                            cycle, t0)
+        self._commit_stage(results, cycle, t0)
+        return None
+
+    def _commit_stage(self, results: List[ScheduleResult], cycle: int,
+                      t_start: float) -> int:
+        """The commit half, on the commit thread: requeue retries, park
+        unschedulables, volume-bind + plugins + bind + assume winners. A
+        loss discovered here (failed bind, duplicate, permit rollback)
+        invalidates chained device usage; the epoch bump is folded into
+        the pipeline's phantom flag so in-flight chained batches retry
+        their unassigned pods. Returns the number of assumes."""
+        import time as _time
         epoch_before = self.algorithm.mirror.usage_epoch
-        n_assumed = self._commit_results(results, cycle)
-        t2 = _time.perf_counter()
-        # bookkeeping for a successor batch chained on THIS batch's usage:
-        # its mask/index predate these winners (stale_winners) and any
-        # winner lost after the chain was taken (repair demotion or commit
-        # drop, the latter visible as a usage-epoch bump) leaves phantom
-        # space in the successor's usage input
-        self._last_commit_winners = [
-            (r.pod, r.node_name) for r in results if r.node_name is not None]
-        self._last_commit_phantom = (
-            any(r.retry for r in results)
-            or self.algorithm.mirror.usage_epoch != epoch_before)
-        m = self.metrics
-        m.scheduling_duration.observe(t1 - t0, operation="fetch")
-        m.scheduling_duration.observe(t2 - t1, operation="commit")
-        m.e2e_scheduling_duration.observe(t2 - t0)
-        self._in_flight -= len(results)
-        if expected_seq is None:
-            return None
-        return expected_seq + n_assumed
+        t1 = _time.perf_counter()
+        try:
+            return self._commit_results(results, cycle)
+        finally:
+            if self.algorithm.mirror.usage_epoch != epoch_before:
+                self._pipe_phantom = True
+                self.robustness.commit_rollbacks.inc()
+            t2 = _time.perf_counter()
+            m = self.metrics
+            m.scheduling_duration.observe(t2 - t1, operation="commit")
+            m.commit_overlap_duration.observe(t2 - t1)
+            m.e2e_scheduling_duration.observe(t2 - t_start)
+            with self._count_lock:
+                self._in_flight -= len(results)
 
     def _assume_and_bind_all(self, bound: List[ScheduleResult]) -> int:
         """Ref: scheduler.go assume :382 + bind :411 — batched and inverted:
@@ -541,13 +704,20 @@ class Scheduler:
             # failure rejects the pod for this cycle. One context PER POD,
             # matching the reference's per-scheduleOne pluginContext —
             # plugins key their scratch by fixed names, so sharing across
-            # pods would leak one pod's reserve state into another's prebind
-            ctx = PluginContext()
-            st = self.framework.run_reserve_plugins(ctx, res.pod,
-                                                    res.node_name)
-            if st.success:
-                st = self.framework.run_permit_plugins(ctx, res.pod,
-                                                       res.node_name)
+            # pods would leak one pod's reserve state into another's
+            # prebind. With NO plugins registered (the common deployment)
+            # the context and all three runner calls are skipped — at 16k
+            # pods/batch the empty-runner round trips were measurable
+            # commit-stage time.
+            has_plugins = bool(self.framework.plugins)
+            ctx = PluginContext() if has_plugins else None
+            st = Status.ok()
+            if has_plugins:
+                st = self.framework.run_reserve_plugins(ctx, res.pod,
+                                                        res.node_name)
+                if st.success:
+                    st = self.framework.run_permit_plugins(ctx, res.pod,
+                                                           res.node_name)
             if st.success and not st.is_wait:
                 gang_out = self._gang_permit(res)
                 if gang_out is not None:
@@ -562,30 +732,30 @@ class Scheduler:
                     # then the deferred PV writes, so a plugin veto costs
                     # nothing irreversible.
                     fail_msg = None
-                    for r, clone in gang_out:
-                        rctx = ctx if r is res else PluginContext()
-                        st2 = self.framework.run_prebind_plugins(
-                            rctx, r.pod, r.node_name)
-                        if not st2.success:
-                            fail_msg = st2.message
-                            break
-                    if fail_msg is None:
-                        # RESIDUAL: PV writes commit member-by-member; a
-                        # mid-loop store failure (deleted-PV race) leaves
-                        # the earlier members' claims bound while the gang
-                        # rolls back — those members' retries are then
-                        # volume-pinned to the old slice. Rare enough that
-                        # a store-side multi-claim bind txn is left as
-                        # future work; the common veto (plugins) runs
-                        # before any write.
+                    if has_plugins:
                         for r, clone in gang_out:
-                            if not self._pod_wants_volumes(r.pod):
-                                continue
+                            rctx = ctx if r is res else PluginContext()
+                            st2 = self.framework.run_prebind_plugins(
+                                rctx, r.pod, r.node_name)
+                            if not st2.success:
+                                fail_msg = st2.message
+                                break
+                    if fail_msg is None:
+                        # the deferred PV writes commit as ONE all-or-
+                        # nothing multi-claim transaction: a mid-txn store
+                        # failure (deleted-PV race) rolls back every claim
+                        # already written, so no member's retry is ever
+                        # volume-pinned to the old slice while the gang
+                        # rolls back (the common veto — plugins — still
+                        # runs before any write)
+                        vol_pods = [r.pod for r, _ in gang_out
+                                    if self._pod_wants_volumes(r.pod)]
+                        if vol_pods:
                             try:
-                                self.volume_binder.bind_pod_volumes(r.pod)
+                                self.volume_binder.bind_pods_volumes(
+                                    vol_pods)
                             except Exception as e:
                                 fail_msg = str(e)
-                                break
                     if fail_msg is None:
                         fresh.extend(r for r, _ in gang_out)
                     else:
@@ -600,7 +770,7 @@ class Scheduler:
                 # has release machinery — park the pod for this cycle
                 st = Status.error(st.message or "permit plugin asked to "
                                   "wait without a gang release path")
-            if st.success:
+            if st.success and has_plugins:
                 st = self.framework.run_prebind_plugins(ctx, res.pod,
                                                         res.node_name)
             if not st.success:
@@ -634,13 +804,11 @@ class Scheduler:
                 except Exception as e:
                     outs.append(e)
         else:
-            bindings = [Binding(
-                metadata=ObjectMeta(name=res.pod.metadata.name,
-                                    namespace=res.pod.metadata.namespace),
-                target=ObjectReference(kind="Node", name=res.node_name))
-                for res in bound]
-            outs = self._bind_bulk_with_retry(bindings, len(bound))
+            outs = self._bind_items_with_retry(
+                [(res.pod.metadata.namespace, res.pod.metadata.name,
+                  res.node_name) for res in bound])
         self.metrics.binding_duration.observe(_time.perf_counter() - t_bind)
+        nom_live = bool(self.queue.nominated.by_node())
         n_assumed = 0
         for res, out in zip(bound, outs):
             if not isinstance(out, Exception):
@@ -651,10 +819,13 @@ class Scheduler:
                     out = serde.shallow_bind_clone(res.pod)
                     out.spec.node_name = res.node_name
                 # ref: scheduler.go assume :382-409 — the nomination is
-                # consumed the moment the pod lands
-                self.queue.nominated.delete(out)
+                # consumed the moment the pod lands (skipped wholesale
+                # while the map is empty: nominations for pods in THIS
+                # bind list can only predate the batch)
+                if nom_live:
+                    self.queue.nominated.delete(out)
                 try:
-                    self.cache.assume_pod(out)
+                    self._tracked_assume(out)
                     n_assumed += 1
                 except ValueError:
                     if self.cache.assigned_node(
@@ -674,7 +845,8 @@ class Scheduler:
                     self.cache.finish_binding(out)
                 if self.gang is not None:
                     self.gang.pod_bound(out)
-                self.scheduled_count += 1
+                with self._count_lock:
+                    self.scheduled_count += 1
                 self.metrics.schedule_attempts.inc(result="scheduled")
                 continue
             # any failed bind is a kernel winner that will never be assumed:
@@ -710,13 +882,15 @@ class Scheduler:
         thread. Returns the number of assumes (chain bookkeeping)."""
         import time as _time
         n_assumed = 0
+        nom_live = bool(self.queue.nominated.by_node())
         pairs = []  # (result, assumed clone)
         for res in bound:
             out = serde.shallow_bind_clone(res.pod)
             out.spec.node_name = res.node_name
-            self.queue.nominated.delete(out)
+            if nom_live:
+                self.queue.nominated.delete(out)
             try:
-                self.cache.assume_pod(out)
+                self._tracked_assume(out)
                 n_assumed += 1
             except ValueError:
                 if self.cache.assigned_node(
@@ -731,15 +905,12 @@ class Scheduler:
             self.metrics.schedule_attempts.inc(result="scheduled")
         if not pairs:
             return n_assumed
-        bindings = [Binding(
-            metadata=ObjectMeta(name=res.pod.metadata.name,
-                                namespace=res.pod.metadata.namespace),
-            target=ObjectReference(kind="Node", name=res.node_name))
-            for res, _ in pairs]
+        items = [(res.pod.metadata.namespace, res.pod.metadata.name,
+                  res.node_name) for res, _ in pairs]
 
         def job():
             t0 = _time.perf_counter()
-            outs = self._bind_bulk_with_retry(bindings, len(pairs))
+            outs = self._bind_items_with_retry(items)
             self.metrics.binding_duration.observe(_time.perf_counter() - t0)
             self._reconcile_bind_outcomes(pairs, outs)
         fut = self._bind_pool.submit(job)
@@ -750,21 +921,48 @@ class Scheduler:
         self._bind_futures.append(fut)
         return n_assumed
 
-    def _bind_bulk_with_retry(self, bindings, n: int) -> list:
-        """The bulk bind POST, retried with backoff on transport-level
-        failures (hub hiccup, injected chaos) — per-slot rejections
-        (NotFound/Conflict) come back inside the result list and are NOT
-        retried here. A bind that still fails after the policy returns
-        the error in every slot; the caller's forget/requeue machinery
-        self-heals exactly as for any failed bind."""
+    def _bind_items_with_retry(self, items) -> list:
+        """The bulk bind, from (namespace, podName, nodeName) tuples —
+        issued as BindList PAIRS when the client supports them, so the
+        hot path constructs no per-pod Binding/ObjectMeta/ObjectReference
+        at all (3 dataclass inits per pod at 16k pods/batch was a
+        measurable slice of the commit stage). Retried with backoff on
+        transport-level failures (hub hiccup, injected chaos) — per-slot
+        rejections (NotFound/Conflict) come back inside the result list
+        and are NOT retried. A bind that still fails after the policy
+        returns the error in every slot; the caller's forget/requeue
+        machinery self-heals exactly as for any failed bind."""
         from ..utils import backoff
-        try:
-            return backoff.retry(
-                lambda: self.client.pods().bind_bulk(bindings),
-                clock=self.clock, metrics=self.robustness,
-                component="scheduler", op="bind_bulk")
-        except Exception as e:
-            return [e] * n
+        pc = self.client.pods()
+        if not hasattr(pc, "bind_bulk_pairs"):
+            bindings = [Binding(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                target=ObjectReference(kind="Node", name=node))
+                for ns, name, node in items]
+            try:
+                return backoff.retry(
+                    lambda: self.client.pods().bind_bulk(bindings),
+                    clock=self.clock, metrics=self.robustness,
+                    component="scheduler", op="bind_bulk")
+            except Exception as e:
+                return [e] * len(items)
+        by_ns: dict = {}
+        for i, (ns, name, node) in enumerate(items):
+            by_ns.setdefault(ns, []).append((i, name, node))
+        out: list = [None] * len(items)
+        for ns, slots in by_ns.items():
+            pair_list = [(name, node) for _, name, node in slots]
+            try:
+                rs = backoff.retry(
+                    lambda ns=ns, pl=pair_list:
+                    self.client.pods().bind_bulk_pairs(ns, pl),
+                    clock=self.clock, metrics=self.robustness,
+                    component="scheduler", op="bind_bulk")
+            except Exception as e:
+                rs = [e] * len(pair_list)
+            for (i, _, _), r in zip(slots, rs):
+                out[i] = r
+        return out
 
     def _reconcile_bind_outcomes(self, pairs, outs) -> None:
         """Binder-thread half: a failed slot's pod was optimistically
@@ -832,8 +1030,10 @@ class Scheduler:
         try:
             # the RESERVATION: the gang member's space is held on its node
             # so later batches cannot steal it while the rest of the gang
-            # is still scheduling (rolled back by expire on timeout)
-            self.cache.assume_pod(clone)
+            # is still scheduling (rolled back by expire on timeout).
+            # Tracked: the kernel counted the member in the chained usage,
+            # so the reservation keeps the chain account balanced.
+            self._tracked_assume(clone)
         except ValueError:
             if self.cache.assigned_node(
                     clone.metadata.key()) != res.node_name:
@@ -851,11 +1051,16 @@ class Scheduler:
             # the node breaks the gang's cross-batch ICI-domain pin: drop
             # the reservation — cache clone AND the cycle's PV assumption,
             # which would otherwise pin a PV outside the gang's slice —
-            # and retry; the next launch seeds the kernel with the pin
+            # and retry; the next launch seeds the kernel with the pin.
+            # The UNtracked forget breaks the chain equality (next launch
+            # flushes); the kernel counted this member in chained usage,
+            # so drop device usage and phantom-mark in-flight batches.
             try:
                 self.cache.forget_pod(clone)
             except ValueError:
                 pass
+            self.algorithm.mirror.invalidate_usage()
+            self._pipe_phantom = True
             self.volume_binder.forget_pod_volumes(res.pod)
             self.queue.add(res.pod)
             return []
@@ -871,13 +1076,17 @@ class Scheduler:
 
     def _gang_rollback_one(self, pod: Pod, clone: Pod, message: str) -> None:
         """A released member failed prebind: drop its reservation and park
-        it; assume/forget dirty rows repair the device mirror."""
+        it; assume/forget dirty rows repair the device mirror. Chained
+        device usage counted the member — invalidate it and phantom-mark
+        the pipeline (in-flight chained batches retry, not park)."""
         try:
             self.cache.forget_pod(clone)
         except ValueError:
             pass
         if self.gang is not None:
             self.gang.bind_failed(pod)
+        self.algorithm.mirror.invalidate_usage()
+        self._pipe_phantom = True
         self.volume_binder.forget_pod_volumes(pod)
         self._record_event(pod, "FailedScheduling", message)
         self.queue.add_unschedulable_if_not_present(
@@ -895,7 +1104,8 @@ class Scheduler:
             return
         from ..utils.trace import Trace
         trace = Trace("gang_rollback", reservations=len(rollbacks))
-        self.cache.forget_pods([clone for _, clone in rollbacks])
+        if self.cache.forget_pods([clone for _, clone in rollbacks]):
+            self._pipe_phantom = True
         trace.step("gang reservations rolled back from the cache")
         cycle = self.queue.scheduling_cycle
         for pod in requeue:
@@ -913,12 +1123,16 @@ class Scheduler:
         self.unschedulable_count += 1
         self.metrics.schedule_attempts.inc(result="unschedulable")
         self.queue.add_unschedulable_if_not_present(pod, cycle)
-        try:
-            fit_err = self.algorithm.explain(pod)
-            self._record_event(pod, "FailedScheduling", fit_err.error())
-        except Exception:
-            pass
-        self._try_preempt(pod)
+        # _algo_lock: this may run on the COMMIT thread while the drain
+        # thread tensorizes the next batch — explain iterates the snapshot
+        # and preempt refreshes it, both of which would race the launch
+        with self._algo_lock:
+            try:
+                fit_err = self.algorithm.explain(pod)
+                self._record_event(pod, "FailedScheduling", fit_err.error())
+            except Exception:
+                pass
+            self._try_preempt(pod)
 
     def _try_preempt(self, pod: Pod) -> None:
         """Ref: scheduler.go preempt (:292-380): nominate the pod to the
@@ -1011,6 +1225,8 @@ class Scheduler:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._commit_pool_ is not None:
+            self._commit_pool_.shutdown(wait=True)
         if self._bind_pool is not None:
             self._flush_binds()
             self._bind_pool.shutdown(wait=True)

@@ -33,6 +33,7 @@ of two) so XLA compiles one kernel per bucket instead of one per cluster size.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -153,8 +154,13 @@ class TensorMirror:
         self._device_cfg: Optional[dict] = None
         self._device_usage: Optional[dict] = None
         #: bumped by invalidate_usage; pending batches launched before an
-        #: invalidation must not adopt_usage their (phantom-carrying) output
+        #: invalidation must not adopt_usage their (phantom-carrying) output.
+        #: _usage_lock makes the epoch check and the adopt/invalidate write
+        #: ONE atomic step: the pipelined drain invalidates from the commit
+        #: thread while the drain thread adopts, and a lost race would
+        #: resurrect phantom usage that invalidation just dropped.
         self.usage_epoch = 0
+        self._usage_lock = threading.Lock()
 
     # ------------------------------------------------------------ updates
 
@@ -346,15 +352,24 @@ class TensorMirror:
         self._dirty_rows.clear()
         return self._device_cfg, self._device_usage
 
-    def adopt_usage(self, usage: dict) -> None:
+    def adopt_usage(self, usage: dict, epoch: Optional[int] = None) -> bool:
         """Adopt the kernel's post-batch usage (device-side chaining). Safe
         whenever every assignment in the batch was committed via assume_pod:
         the cache bumps those nodes' generations, so the next dirty scatter
         rewrites the same rows with identical host-truth values (idempotent);
         rows the host disagrees on (forgotten binds, node churn) are repaired
         by that same scatter. An assignment that never reaches assume_pod
-        leaves no dirty row — callers must invalidate_usage() instead."""
-        self._device_usage = usage
+        leaves no dirty row — callers must invalidate_usage() instead.
+
+        `epoch` is the usage_epoch the batch launched at: the adopt is
+        REFUSED (returns False) when an invalidation landed in between —
+        checked and applied under one lock, so a commit-thread invalidation
+        can never lose the race to a concurrent adopt."""
+        with self._usage_lock:
+            if epoch is not None and epoch != self.usage_epoch:
+                return False
+            self._device_usage = usage
+            return True
 
     def invalidate_usage(self) -> None:
         """Drop adopted device usage; the next device_cfg_usage() re-uploads
@@ -362,8 +377,9 @@ class TensorMirror:
         cache forget (no dirty row would repair the adopted tensors).
         Bumps usage_epoch so an in-flight PendingBatch whose usage input
         predates the invalidation cannot re-adopt phantom state."""
-        self._device_usage = None
-        self.usage_epoch += 1
+        with self._usage_lock:
+            self._device_usage = None
+            self.usage_epoch += 1
 
     @property
     def n_rows(self) -> int:

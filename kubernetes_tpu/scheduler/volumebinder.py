@@ -251,6 +251,77 @@ class VolumeBinder:
         # dropped lazily in _select_unbound_locked once the informer-visible
         # PV shows Bound.
 
+    def bind_pods_volumes(self, pods: List[Pod]) -> None:
+        """Atomic multi-claim bind for a released GANG: every member's PV
+        claimRef + PVC volumeName write commits, or — on ANY mid-stream
+        store failure (deleted-PV race, hub error) — every write already
+        made is rolled back: claimed PVs return to Available and bound
+        PVCs are unbound again. Without this, a failure on member k left
+        members 1..k-1's claims bound while the gang itself rolled back,
+        and their retries were volume-pinned to the abandoned slice (the
+        scheduler.py RESIDUAL this transaction resolves).
+
+        On failure the members' assumed state and reservations are
+        released here (the callers' forget_pod_volumes then no-ops), and
+        the original exception is re-raised for the gang rollback path."""
+        with self._lock:
+            all_bindings = [(pod, self._assumed.pop(pod.metadata.key(), []))
+                            for pod in pods]
+        if self.client is None or not any(b for _, b in all_bindings):
+            return
+        #: (kind, pv_name | (ns, pvc_name)) journal of completed writes,
+        #: undone in reverse on failure
+        done: List[Tuple[str, object]] = []
+        try:
+            for pod, bindings in all_bindings:
+                for pvc, pv_name in bindings:
+                    def set_claim(pv, _pvc=pvc):
+                        pv.spec.claim_ref = {
+                            "kind": "PersistentVolumeClaim",
+                            "namespace": _pvc.metadata.namespace,
+                            "name": _pvc.metadata.name,
+                            "uid": _pvc.metadata.uid}
+                        pv.status.phase = "Bound"
+                        return pv
+                    self.client.persistent_volumes().patch(pv_name,
+                                                           set_claim)
+                    done.append(("pv", pv_name))
+
+                    def set_volume(cur, _pv=pv_name):
+                        cur.spec.volume_name = _pv
+                        cur.status.phase = "Bound"
+                        return cur
+                    self.client.persistent_volume_claims(
+                        pvc.metadata.namespace).patch(pvc.metadata.name,
+                                                      set_volume)
+                    done.append(("pvc", (pvc.metadata.namespace,
+                                         pvc.metadata.name)))
+        except Exception:
+            for kind, ref in reversed(done):
+                try:
+                    if kind == "pv":
+                        def unclaim(pv):
+                            pv.spec.claim_ref = None
+                            pv.status.phase = "Available"
+                            return pv
+                        self.client.persistent_volumes().patch(ref, unclaim)
+                    else:
+                        ns, name = ref
+                        def unbind(cur):
+                            cur.spec.volume_name = ""
+                            cur.status.phase = "Pending"
+                            return cur
+                        self.client.persistent_volume_claims(ns).patch(
+                            name, unbind)
+                except Exception:
+                    pass  # best effort; the PV controller reconciles
+            with self._lock:
+                for pod, bindings in all_bindings:
+                    self._release(pod.metadata.key(), bindings)
+            raise
+        # success: reservations stay until the informer shows the PVs Bound
+        # (same lazy drop as bind_pod_volumes)
+
 
 class FakeVolumeBinder:
     """Ref: scheduler_binder_fake.go:66 — everything binds."""
@@ -268,4 +339,7 @@ class FakeVolumeBinder:
         pass
 
     def bind_pod_volumes(self, pod) -> None:
+        pass
+
+    def bind_pods_volumes(self, pods) -> None:
         pass

@@ -262,10 +262,13 @@ class TooManyDisruptions(Exception):
 class PodClient(ResourceClient):
     def bind(self, binding: corev1.Binding):
         """The scheduler's bind subresource: sets spec.nodeName
-        (ref: pkg/registry/core/pod/rest BindingREST.Create)."""
+        (ref: pkg/registry/core/pod/rest BindingREST.Create). The bind
+        mutator only touches spec.nodeName + status.conditions, so the
+        read-side copy is the shallow bind clone, not a full deepcopy."""
         ns = binding.metadata.namespace or self._effective_ns()
         return self._store.guaranteed_update("pods", ns, binding.metadata.name,
-                                             _bind_mutator(binding))
+                                             _bind_mutator(binding),
+                                             copy_fn=serde.shallow_bind_clone)
 
     def evict(self, name: str, namespace: Optional[str] = None):
         """The pods/eviction subresource: a PDB-guarded delete (ref:

@@ -154,20 +154,27 @@ class Store:
                 self._rv = max(self._rv, rec["rv"])
                 self._uid_counter = max(self._uid_counter, rec.get("uc", 0))
                 continue
-            if rec["op"] == "BIND":
-                # slim bind record: re-derive the bound pod from the state
-                # the log built so far (its PUT necessarily precedes) —
-                # byte-identical to the original via apply_bind_fields
-                b = rec["object"]
+            if rec["op"] in ("BIND", "BINDS"):
+                # slim bind record(s): re-derive the bound pods from the
+                # state the log built so far (their PUTs necessarily
+                # precede) — byte-identical to the originals via
+                # apply_bind_fields. "BINDS" is the group-commit form: one
+                # record per bind transaction, each entry carrying its own
+                # rv; "BIND" is the legacy one-record-per-pod shape.
+                from .client import apply_bind_fields
                 bucket = self._data.setdefault(rec["resource"], {})
-                key = (b.get("namespace", ""), b["name"])
-                cur = bucket.get(key)
-                if cur is not None:
-                    from .client import apply_bind_fields
-                    new = serde.shallow_bind_clone(cur[0])
-                    apply_bind_fields(new, b["node"], b.get("ts"))
-                    new.metadata.resource_version = str(rec["rv"])
-                    bucket[key] = (new, rec["rv"])
+                if rec["op"] == "BIND":
+                    entries = [dict(rec["object"], rv=rec["rv"])]
+                else:
+                    entries = rec["object"]["binds"]
+                for b in entries:
+                    key = (b.get("namespace", ""), b["name"])
+                    cur = bucket.get(key)
+                    if cur is not None:
+                        new = serde.shallow_bind_clone(cur[0])
+                        apply_bind_fields(new, b["node"], b.get("ts"))
+                        new.metadata.resource_version = str(b["rv"])
+                        bucket[key] = (new, b["rv"])
                 self._rv = max(self._rv, rec["rv"])
                 continue
             cls = SCHEME.type_for_resource(rec["resource"])
@@ -389,9 +396,13 @@ class Store:
             if resource_version is not None and int(resource_version) != cur_rv:
                 raise ConflictError(f"{resource} {key}: stale resourceVersion")
             # finalizer semantics: objects with finalizers get a deletion
-            # timestamp instead of vanishing (ref: registry/generic Store.Delete)
+            # timestamp instead of vanishing (ref: registry/generic
+            # Store.Delete). Both paths mutate ONLY metadata fields
+            # (deletionTimestamp / resourceVersion), so a shallow shell+
+            # metadata clone replaces the former full deepcopy — the frozen
+            # source keeps every shared sub-object read-only.
             if cur_obj.metadata.finalizers and cur_obj.metadata.deletion_timestamp is None:
-                marked = serde.deepcopy_obj(cur_obj)
+                marked = serde.shallow_meta_clone(cur_obj)
                 from ..utils.clock import now_iso
                 marked.metadata.deletion_timestamp = now_iso()
                 self._rv += 1
@@ -403,7 +414,7 @@ class Store:
                 return marked
             del bucket[key]
             self._rv += 1
-            final = serde.deepcopy_obj(cur_obj)
+            final = serde.shallow_meta_clone(cur_obj)
             final.metadata.resource_version = str(self._rv)
             self._journal("DELETE", resource, final, self._rv)
             self._wal_commit()
@@ -427,6 +438,10 @@ class Store:
         """
         out: List[Any] = []
         events: List[Tuple[str, WatchEvent]] = []
+        #: slim records of this transaction, journaled as ONE group-commit
+        #: "BINDS" WAL record — one encode + one append per bind batch
+        #: instead of one per pod (each entry carries its own rv for replay)
+        slim_batch: List[Any] = []
         with self._lock:
             bucket = self._data.setdefault(resource, {})
             for namespace, name, mutate in items:
@@ -457,15 +472,19 @@ class Store:
                         # watch layer the same dict — no full-pod encode
                         # on either path
                         if self._wal is not None:
-                            self._wal.append("BIND", resource, self._rv,
-                                             slim,
-                                             uid_counter=self._uid_counter)
+                            rec = dict(slim)
+                            rec["rv"] = self._rv
+                            slim_batch.append(rec)
                     else:
                         self._journal("PUT", resource, updated, self._rv)
                     events.append((resource,
                                    WatchEvent(MODIFIED, updated, self._rv,
                                               slim=slim)))
                 out.append(updated)
+            if slim_batch:
+                self._wal.append("BINDS", resource, self._rv,
+                                 {"binds": slim_batch},
+                                 uid_counter=self._uid_counter)
             self._wal_commit()  # one durability point per transaction
             for res, ev in events:
                 self._publish(res, ev)
@@ -540,11 +559,16 @@ class Store:
             self._wal_commit()
 
     def guaranteed_update(self, resource: str, namespace: str, name: str,
-                          mutate: Callable[[Any], Any], retries: int = 16) -> Any:
-        """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238)."""
+                          mutate: Callable[[Any], Any], retries: int = 16,
+                          copy_fn: Callable[[Any], Any] = serde.deepcopy_obj,
+                          ) -> Any:
+        """CAS retry loop (ref: etcd3/store.go GuaranteedUpdate :238).
+        `copy_fn` is the read-side copy handed to `mutate`: callers whose
+        mutator only touches known layers (the bind subresource) pass
+        serde.shallow_bind_clone and skip the full deepcopy."""
         for _ in range(retries):
             # get() returns the frozen canonical object; mutate a copy
-            updated = mutate(serde.deepcopy_obj(self.get(resource, namespace, name)))
+            updated = mutate(copy_fn(self.get(resource, namespace, name)))
             try:
                 return self.update(resource, updated)
             except ConflictError:

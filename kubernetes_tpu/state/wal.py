@@ -10,6 +10,13 @@ mutation appends one length-prefixed JSON record
 and `Store(wal_path=...)` replays the log before serving. `compact()`
 rewrites the log as one PUT per live object (the snapshot analog).
 
+Bind transactions group-commit: a bulk bind journals ONE
+    {"op": "BINDS", "rv": <last rv>, "object": {"binds": [
+        {"namespace", "name", "node", "ts", "rv"}, ...]}}
+record per transaction (one encode + one append for the whole batch —
+at 16k binds/batch the per-record dumps were the hub's largest WAL
+cost); the legacy per-pod {"op": "BIND"} shape still replays.
+
 The append hot path runs in C (native/walcore.cc) when the toolchain is
 available; the python fallback is behavior-identical.
 """

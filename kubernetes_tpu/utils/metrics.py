@@ -257,6 +257,13 @@ class RobustnessMetrics:
         self.faults_injected = r.counter(
             "chaos_faults_injected_total",
             "Faults injected by the chaos harness, by kind")
+        #: pipelined commits whose failure rolled chained device usage
+        #: back (forget assumed pods + invalidate + phantom-mark) — the
+        #: self-heal path the mid-commit chaos test drives
+        self.commit_rollbacks = r.counter(
+            "scheduler_pipelined_commit_rollbacks_total",
+            "Pipelined commit stages that lost winners and invalidated "
+            "chained device usage")
 
 
 class Registry:

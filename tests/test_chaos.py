@@ -501,3 +501,69 @@ class TestChaosRuns:
             assert report.nodes_killed + report.nodes_deleted > 5
         finally:
             h.close()
+
+
+class TestStoreKillMidCommit:
+    """ISSUE 3 acceptance: a failed (or partially failed) async commit
+    must roll the chained usage back — forget/requeue the losers and
+    invalidate device usage — so the pipeline never publishes placements
+    the store rejected, and the InvariantChecker stays green."""
+
+    def test_store_dies_mid_pipelined_commit_then_heals(self, tmp_path):
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.state.store import Store
+
+        clock = FakeClock()
+        wal = str(tmp_path / "hub.wal")
+        store = Store(wal_path=wal)
+        client = Client(store=store, validate=False)
+        sched = Scheduler(client, batch_size=4, clock=clock)
+        sched._commit_async = True   # the ASYNC commit path, even on CPU
+        for i in range(6):
+            node = make_node(f"n{i}")
+            client.nodes().create(node)
+            sched.cache.add_node(node)
+        for i in range(12):
+            sched.queue.add(client.pods("default").create(
+                make_pod(f"p{i:02d}")))
+        sched.algorithm.refresh()
+
+        # kill the store for the SECOND bind transaction (and all of its
+        # backoff retries): batch 1 commits clean, batch 2's commit dies
+        # while batch 3 is already launched chained on its usage
+        calls = {"n": 0}
+        orig = store.bulk_apply
+
+        def dying(resource, items, **kw):
+            calls["n"] += 1
+            if 2 <= calls["n"] <= 5:   # attempt + 3 retries, all dead
+                raise ChaosError("injected store crash mid-commit")
+            return orig(resource, items, **kw)
+        store.bulk_apply = dying
+        epoch_before = sched.algorithm.mirror.usage_epoch
+        n = sched.drain_pipelined()
+        assert n == 8, f"expected 8 survivors of the dead txn, got {n}"
+        # the self-heal fired: chained device usage was invalidated (the
+        # kernel's winners for the dead txn can never be assumed)
+        assert sched.algorithm.mirror.usage_epoch > epoch_before
+        # no cache assume references a pod the store never bound
+        bound = {p.metadata.name for p in client.pods("default").list()
+                 if p.spec.node_name}
+        assert len(bound) == 8
+        for pod in sched.cache.assumed_pods():
+            assert pod.metadata.name in bound, \
+                f"phantom assume for unbound pod {pod.metadata.name}"
+
+        # heal the store; the parked losers reschedule and EVERY
+        # invariant (gang atomicity, cache assumes, WAL replay) is green
+        store.bulk_apply = orig
+        clock.step(120.0)   # past the unschedulable backoff window
+        sched.queue.move_all_to_active_queue()
+        n2 = sched.drain_pipelined()
+        assert n2 == 4
+        assert all(p.spec.node_name
+                   for p in client.pods("default").list())
+        store.flush_wal()
+        checker = InvariantChecker(client, scheduler=sched, wal_path=wal)
+        violations = checker.check()
+        assert violations == [], violations

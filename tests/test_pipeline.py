@@ -201,3 +201,163 @@ class TestChainedAffinity:
         zones = {binds[f"pod-{i}"] for i in range(4)}
         zone_labels = {f"node-{i}": f"zone-{i % 3}" for i in range(6)}
         assert len({zone_labels[h] for h in zones if h}) == 1, binds
+
+
+def test_perf_smoke_pipelined_parity_200x1000():
+    """Tier-1 perf smoke (small wire-shape fixture on CPU): 200 nodes x
+    1000 pods through the PIPELINED drain — commit stage on its own
+    thread, device usage chained across batches — must schedule every
+    pod and make bit-identical decisions to the serial path
+    (schedule_pending run to exhaustion), the same parity bar bench.py's
+    oracle holds the full shape to."""
+    n_nodes, n_pods, batch = 200, 1000, 256
+    client_a, sched_a = build(n_nodes, n_pods, batch_size=batch)
+    while sched_a.schedule_pending(timeout=0):
+        pass
+    client_b, sched_b = build(n_nodes, n_pods, batch_size=batch)
+    # force the commit THREAD even on the CPU backend (where the drain
+    # would otherwise run the stage inline): the smoke must cover the
+    # overlapped path's chain-validity protocol, not just its bookkeeping
+    sched_b._commit_async = True
+    n = sched_b.drain_pipelined()
+    assert n == n_pods, f"pipelined drain scheduled {n}/{n_pods}"
+    serial, piped = bind_map(client_a), bind_map(client_b)
+    mismatches = {k: (serial[k], piped.get(k))
+                  for k in serial if serial[k] != piped.get(k)}
+    assert not mismatches, f"{len(mismatches)} decisions diverged: " \
+        f"{dict(list(mismatches.items())[:5])}"
+    assert all(v for v in piped.values()), "some pod failed to schedule"
+    # the overlap actually engaged: commit stages ran on the commit thread
+    assert sched_b.metrics.commit_overlap_duration.count() > 0
+
+
+def test_pipelined_drain_chains_across_gang_batches():
+    """Gang batches chain in BOTH directions now: a singleton batch
+    launched after a gang batch rides the gang kernel's post-batch usage
+    (trial/commit carry isolates rejected gangs), and the permit-gate
+    reservations keep the chain account balanced."""
+    from kubernetes_tpu.api.scheduling import PodGroup, PodGroupSpec
+    from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
+    client = Client(validate=False)
+    sched = Scheduler(client, batch_size=4)
+    for i in range(8):
+        node = make_node(i, pods=8)
+        client.nodes().create(node)
+        sched.cache.add_node(node)
+    pg = PodGroup(metadata=api.ObjectMeta(name="g1", namespace="default"),
+                  spec=PodGroupSpec(min_member=4))
+    client.pod_groups("default").create(pg)
+    sched.informers.informer_for(PodGroup).indexer.add(pg)
+    # batch 1: the whole gang; batches 2-3: singletons chained on it
+    for i in range(4):
+        pod = make_pod(100 + i)
+        pod.metadata.labels[LABEL_POD_GROUP] = "g1"
+        sched.queue.add(client.pods().create(pod))
+    for i in range(8):
+        sched.queue.add(client.pods().create(make_pod(200 + i)))
+    sched.algorithm.refresh()
+    chained_calls = []
+    orig = sched.algorithm.mirror.apply_chained
+    sched.algorithm.mirror.apply_chained = \
+        lambda *a, **k: (chained_calls.append(1), orig(*a, **k))[1]
+    n = sched.drain_pipelined()
+    assert n == 12
+    binds = bind_map(client)
+    assert all(binds[f"pod-{100 + i}"] for i in range(4)), binds
+    assert all(binds[f"pod-{200 + i}"] for i in range(8)), binds
+    # at least one successor batch launched CHAINED on a predecessor
+    # (the gang batch is first in queue order, so the first chained
+    # launch necessarily chained across it)
+    assert chained_calls, "no launch ever chained across the gang batch"
+
+
+class TestMirrorGrowAndDirtyScatter:
+    """TensorMirror._grow and the apply_dirty packed scatter's
+    out-of-range pad-row handling (the pad index is `capacity`, one past
+    the last row — it must be DROPPED, never clamped onto the last real
+    row or aliased to row 0)."""
+
+    def _snapshot_of(self, nodes):
+        from kubernetes_tpu.scheduler.cache import Snapshot
+        from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+        snap = Snapshot()
+        for n in nodes:
+            snap.node_infos[n.metadata.name] = NodeInfo(n)
+        return snap
+
+    def test_grow_preserves_rows_and_drops_device_state(self):
+        import numpy as np
+        from kubernetes_tpu.scheduler.tensorize import TensorMirror
+        mirror = TensorMirror(min_capacity=4)
+        nodes = [make_node(i) for i in range(4)]
+        snap = self._snapshot_of(nodes)
+        mirror.apply(snap, [n.metadata.name for n in nodes])
+        assert mirror.t.capacity == 4
+        mirror.device_cfg_usage()
+        assert mirror.device_ready()
+        before = {name: mirror.t.alloc[row].copy()
+                  for name, row in mirror.row_of.items()}
+        # a fifth node forces _grow to the next bucket
+        extra = [make_node(10 + i) for i in range(3)]
+        for n in extra:
+            snap.node_infos[n.metadata.name] = \
+                self._snapshot_of([n]).node_infos[n.metadata.name]
+        mirror.apply(snap, [n.metadata.name for n in extra])
+        # _grow buckets to the default minimum (128), not the next power
+        assert mirror.t.capacity == 128
+        # grow dropped device handles (shapes changed): full re-upload due
+        assert not mirror.device_ready()
+        for name, alloc_row in before.items():
+            row = mirror.row_of[name]
+            assert np.array_equal(mirror.t.alloc[row], alloc_row), name
+            assert mirror.t.valid[row]
+        assert len(mirror.row_of) == 7
+        assert sorted(mirror.name_of[r] for r in mirror.row_of.values()) \
+            == sorted(mirror.row_of)
+        # and the next device upload serves consistent full-state tensors
+        cfg, usage = mirror.device_cfg_usage()
+        assert np.array_equal(np.asarray(cfg["alloc"]), mirror.t.alloc)
+        assert np.array_equal(np.asarray(usage["used"]), mirror.t.used)
+
+    def test_dirty_scatter_pad_rows_dropped(self):
+        """device_cfg_usage pads the dirty index to a power-of-two bucket
+        with `capacity` (out of range). The padded scatter must write ONLY
+        the real dirty rows — pad slots carry zeros that would wipe row
+        state if clamped or wrapped."""
+        import numpy as np
+        from kubernetes_tpu.scheduler.tensorize import TensorMirror
+        mirror = TensorMirror(min_capacity=8)
+        nodes = [make_node(i) for i in range(8)]
+        snap = self._snapshot_of(nodes)
+        mirror.apply(snap, [n.metadata.name for n in nodes])
+        mirror.device_cfg_usage()   # full upload; dirty set cleared
+        # dirty exactly ONE row -> bucket of 8 means 7 pad slots
+        name = nodes[3].metadata.name
+        ni = snap.node_infos[name]
+        ni.requested.milli_cpu += 500
+        mirror._write_row(name, ni)
+        assert len(mirror._dirty_rows) == 1
+        cfg, usage = mirror.device_cfg_usage()
+        assert np.array_equal(np.asarray(usage["used"]), mirror.t.used)
+        assert np.array_equal(np.asarray(cfg["alloc"]), mirror.t.alloc)
+        # row 0 and the LAST row kept their values (no alias, no clamp)
+        assert np.asarray(cfg["valid"])[0] and np.asarray(cfg["valid"])[7]
+
+    def test_apply_dirty_out_of_range_index_is_noop(self):
+        """kernels.apply_dirty directly: an all-pad index vector (every
+        slot out of range) must leave the device state untouched."""
+        import jax.numpy as jnp
+        import numpy as np
+        from kubernetes_tpu.scheduler.kernels.batch import apply_dirty
+        N, R = 8, 4
+        cfg = {"alloc": jnp.arange(N * R, dtype=jnp.float32).reshape(N, R)}
+        usage = {"used": jnp.ones((N, R), jnp.float32)}
+        idx = jnp.full((4,), N, jnp.int32)           # all out of range
+        cfg_rows = {"alloc": jnp.full((4, R), -7.0)}  # poison, must drop
+        usage_rows = {"used": jnp.full((4, R), -7.0)}
+        before_cfg = np.asarray(cfg["alloc"]).copy()
+        before_usage = np.asarray(usage["used"]).copy()
+        new_cfg, new_usage = apply_dirty(cfg, usage, idx, cfg_rows,
+                                         usage_rows)
+        assert np.array_equal(np.asarray(new_cfg["alloc"]), before_cfg)
+        assert np.array_equal(np.asarray(new_usage["used"]), before_usage)

@@ -272,12 +272,16 @@ class TestSlimBindRecords:
         outs = c.pods("default").bind_bulk(bindings)
         assert not any(isinstance(o, Exception) for o in outs)
         st.flush_wal()
-        # the journal holds slim BIND records, not full pods
+        # the journal holds ONE group-commit BINDS record for the whole
+        # transaction (one encode + one append per bind batch), carrying
+        # slim per-pod entries with their own rvs — not full pods
         ops = [r["op"] for r in read_wal(path)]
-        assert ops.count("BIND") == 5
-        bind_rec = next(r for r in read_wal(path) if r["op"] == "BIND")
-        assert set(bind_rec["object"]) == {"namespace", "name", "node",
-                                           "ts"}
+        assert ops.count("BINDS") == 1 and "BIND" not in ops
+        bind_rec = next(r for r in read_wal(path) if r["op"] == "BINDS")
+        entries = bind_rec["object"]["binds"]
+        assert len(entries) == 5
+        assert all(set(b) == {"namespace", "name", "node", "ts", "rv"}
+                   for b in entries)
         st2 = Store(wal_path=path)
         c2 = Client(store=st2)
         for i in range(5):
