@@ -17,7 +17,7 @@ manifests carry over unchanged.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Dict, Optional
 
 from .meta import ObjectMeta
 from .wellknown import LABEL_POD_GROUP
@@ -59,6 +59,13 @@ class PodGroupStatus:
     #: (every member recreated as a unit after a node death or member
     #: crash wedged the slice)
     resubmissions: int = 0
+    #: member pod templates keyed by pod name — serde-encoded CLEAN
+    #: clones (no node, no status, no server-stamped metadata) recorded
+    #: by the PodGroup controller when each member is first observed.
+    #: Resubmission rebuilds from these, so a member DELETED before the
+    #: rebuild (its spec would otherwise exist nowhere) is still
+    #: recreated and the gang can reach minMember again.
+    member_templates: Dict[str, dict] = field(default_factory=dict)
 
 
 @dataclass

@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from queue import Queue
 from typing import Any, Callable, List, Optional, Type
 from urllib import error as urlerror
 from urllib import request as urlrequest
+from urllib.parse import urlsplit
 
 from ..api import core as corev1
 from ..api import labels as labelsmod
@@ -24,10 +26,39 @@ from ..api.meta import LabelSelector
 from ..runtime.scheme import SCHEME, Scheme
 from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
                            NotFoundError, SlimBindRef, WatchEvent)
+from ..utils.metrics import Counter
+
+#: terminal watch-stream errors by (resource, reason) — the TRANSPORT
+#: layer's family, counted in the pump for every consumer including raw
+#: .watch() users that have no informer. Informer consumers get a
+#: second, per-factory family (InformerMetrics.watch_stream_errors) with
+#: reconnect/relist context; the two deliberately overlap for informer
+#: streams because they serve different audiences. Standalone Counter:
+#: register into a Registry only if exposition is wanted.
+WATCH_STREAM_ERRORS = Counter(
+    "httpwatch_stream_errors_total",
+    "HTTP watch streams terminated by an error, by resource and reason")
+
+
+class WatchStaleError(ConnectionError):
+    """A watch stream went silent past the heartbeat-staleness window and
+    was killed by the consumer's watchdog (the server heartbeats every
+    second, so silence means dead TCP, not an idle cluster)."""
 
 
 class TooManyRequestsError(RuntimeError):
     """HTTP 429 from the server's overload protection (max-inflight)."""
+
+
+#: wire-hook kinds — an injectable transport interceptor
+#: (`HTTPClient(wire_hook=...)`): called as hook(kind, op, resource, path)
+#: ahead of every request ("request" — may sleep to model latency or
+#: raise to model a connection reset) and at watch-stream creation
+#: ("watch" — returns None, or an int K to sever the stream after K
+#: events, the mid-stream-drop fault). chaos/injector.py provides the
+#: deterministic implementation.
+WIRE_REQUEST = "request"
+WIRE_WATCH = "watch"
 
 
 def _raise_for(status: int, body: str) -> None:
@@ -60,28 +91,55 @@ def _raise_for(status: int, body: str) -> None:
 
 class _HTTPWatch:
     """Client half of the chunked watch stream; mirrors store.Watch's
-    iterator contract (iterate WatchEvents, stop() to cancel)."""
+    iterator contract (iterate WatchEvents, stop() to cancel), plus the
+    reflector-resume surface:
 
-    def __init__(self, resp, cls: Type):
+      - `last_rv`: resourceVersion of the last event delivered — the
+        consumer reconnects here instead of relisting.
+      - `error`: the terminal stream error, or None for a clean close
+        (stop() or the server ending the stream). The old blanket
+        `except Exception: pass` made those indistinguishable.
+      - `last_activity`: time.monotonic() of the last byte read —
+        heartbeat lines included — so a consumer can tell a silently-dead
+        TCP stream (no FIN ever arrives) from an idle-but-alive one and
+        `kill()` it instead of hanging forever.
+    """
+
+    def __init__(self, resp, cls: Type, resource: str = "",
+                 drop_after: Optional[int] = None):
         self._resp = resp
         self._cls = cls
+        self._resource = resource
         self._stopped = False
+        #: injected wire fault: sever the stream after this many events
+        self._drop_after = drop_after
+        self.killed = False
+        self.error: Optional[BaseException] = None
+        self.last_rv: Optional[int] = None
+        self.last_activity = time.monotonic()
         self.events: "Queue[Optional[WatchEvent]]" = Queue()
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
     def _pump(self) -> None:
+        delivered = 0
         try:
             # the server heartbeats an empty line every second, so this
             # blocking read always turns over and a stop() is noticed
             # promptly; the response is closed HERE (closing from another
             # thread deadlocks http.client's buffered reader)
             for line in self._resp:
+                self.last_activity = time.monotonic()
                 if self._stopped:
                     break
                 line = line.strip()
                 if not line:
                     continue
+                if self._drop_after is not None \
+                        and delivered >= self._drop_after:
+                    raise ConnectionResetError(
+                        "injected watch drop "
+                        f"(after {delivered} events)")
                 frame = json.loads(line)
                 slim = frame.get("slim")
                 if slim == "bind" or slim == "binds":
@@ -94,16 +152,28 @@ class _HTTPWatch:
                         else frame["o"]["items"]
                     for o in items:
                         rv = int(o["rv"])
+                        self.last_rv = rv
                         self.events.put(WatchEvent(
                             frame["type"],
                             SlimBindRef(o.get("namespace", ""), o["name"],
                                         o["node"], o.get("ts"), rv), rv))
+                        delivered += 1
                     continue
                 obj = serde.decode(self._cls, frame["object"])
                 rv = int(obj.metadata.resource_version or 0)
+                self.last_rv = rv
                 self.events.put(WatchEvent(frame["type"], obj, rv))
-        except Exception:
-            pass
+                delivered += 1
+        except Exception as e:
+            # a stop() tears the socket down under the read — that is a
+            # clean close, not a stream failure; everything else is
+            # terminal and the consumer decides resume-vs-relist from it
+            if not self._stopped and self.error is None:
+                self.error = e
+            if self.error is not None:
+                WATCH_STREAM_ERRORS.inc(
+                    resource=self._resource,
+                    reason=type(self.error).__name__)
         finally:
             try:
                 self._resp.close()
@@ -113,6 +183,29 @@ class _HTTPWatch:
 
     def stop(self) -> None:
         self._stopped = True
+
+    def kill(self, reason: str = "watch stream stale") -> None:
+        """Force-abort a silently-dead stream: mark it errored and shut
+        the socket down so the blocked read returns NOW (a plain close()
+        from this thread would deadlock http.client's buffered reader;
+        socket shutdown doesn't take the reader's lock). Idempotent —
+        the watchdog polls every second and the dead stream's
+        last_activity never advances, so repeat calls must be no-ops."""
+        if self.killed:
+            return
+        self.killed = True
+        if self.error is None:
+            self.error = WatchStaleError(reason)
+        try:
+            import socket as _socket
+            self._resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:
+            # the socket is unreachable (nonstandard transport, fp
+            # already detached): end the CONSUMER's round so it can
+            # reconnect; the pump thread stays parked on its blocked
+            # read (daemon — leaks until process exit). Never close()
+            # from this thread: that deadlocks the buffered reader.
+            self.events.put(None)
 
     def __iter__(self):
         while True:
@@ -125,8 +218,13 @@ class _HTTPWatch:
 class HTTPResourceClient:
     def __init__(self, base_url: str, scheme: Scheme, cls: Type,
                  namespace: Optional[str] = None,
-                 token: Optional[str] = None, ssl_context=None):
+                 token: Optional[str] = None, ssl_context=None,
+                 wire_hook: Optional[Callable] = None):
         self._ssl = ssl_context
+        #: transport interceptor (see WIRE_REQUEST/WIRE_WATCH above):
+        #: chaos runs inject latency, connection resets, and watch drops
+        #: into the REAL http path here, not into a client wrapper
+        self._wire_hook = wire_hook
         self._base = base_url.rstrip("/")
         self._scheme = scheme
         self._cls = cls
@@ -173,6 +271,12 @@ class HTTPResourceClient:
             headers["Content-Type"] = content_type
         req = urlrequest.Request(url, data=data, method=method,
                                  headers=headers)
+        if self._wire_hook is not None:
+            # may sleep (latency) or raise (connection reset) BEFORE the
+            # bytes leave this process — the path component only, so the
+            # fault signature is stable across runs with ephemeral ports
+            self._wire_hook(WIRE_REQUEST, method, self._resource,
+                            urlsplit(url).path)
         try:
             with urlrequest.urlopen(req, context=self._ssl) as resp:
                 return json.loads(resp.read())
@@ -341,12 +445,20 @@ class HTTPResourceClient:
         if self._SLIM_WATCH:
             query += "&slimBind=true"
         url = self._url(namespace=ns or "", query=query)
+        drop_after = None
+        if self._wire_hook is not None:
+            # the hook may raise (connect-time reset) or hand back an
+            # event budget after which the stream is severed mid-flight
+            drop_after = self._wire_hook(WIRE_WATCH, "WATCH",
+                                         self._resource,
+                                         urlsplit(url).path)
         req = urlrequest.Request(url, headers=self._headers())
         try:
             resp = urlrequest.urlopen(req, context=self._ssl)
         except urlerror.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"))
-        return _HTTPWatch(resp, self._cls)
+        return _HTTPWatch(resp, self._cls, resource=self._resource,
+                          drop_after=drop_after)
 
 
 class HTTPPodClient(HTTPResourceClient):
@@ -440,10 +552,12 @@ class HTTPClient:
                  cert_file: Optional[str] = None,
                  key_file: Optional[str] = None,
                  ca_file: Optional[str] = None,
-                 insecure_skip_tls_verify: bool = False):
+                 insecure_skip_tls_verify: bool = False,
+                 wire_hook: Optional[Callable] = None):
         self.base_url = base_url
         self.scheme = scheme
         self.token = token
+        self.wire_hook = wire_hook
         self.ssl_context = None
         if base_url.startswith("https") or cert_file or ca_file:
             # kubeconfig TLS shape: server CA pinning + optional client
@@ -471,10 +585,12 @@ class HTTPClient:
         if cls is corev1.Pod:
             return HTTPPodClient(self.base_url, self.scheme, cls, namespace,
                                  token=self.token,
-                                 ssl_context=self.ssl_context)
+                                 ssl_context=self.ssl_context,
+                                 wire_hook=self.wire_hook)
         return HTTPResourceClient(self.base_url, self.scheme, cls, namespace,
                                   token=self.token,
-                                  ssl_context=self.ssl_context)
+                                  ssl_context=self.ssl_context,
+                                  wire_hook=self.wire_hook)
 
     def __getattr__(self, name):
         """Convenience accessors (pods(), nodes(), ...) mirror Client's by

@@ -6,7 +6,10 @@ The subsystem has three layers:
     `ChaosClient` (a state.client.Client whose mutating verbs consult the
     injector before touching the store) — API errors, apiserver
     partitions, node crashes, and heartbeat suppression, every decision a
-    pure function of `(seed, step, call signature)`.
+    pure function of `(seed, step, call signature)`. Wire fault classes
+    (request latency, connection resets, watch-stream drops) ride the
+    httpclient's injectable transport hook, and `ChaosHTTPClient` layers
+    the API-error oracle over a real HTTP connection.
   - invariants.py: `InvariantChecker` — sweeps live cluster state for the
     things failure handling must never leave behind: half-bound gangs,
     scheduler-cache assumes or permit reservations referencing dead
@@ -17,9 +20,11 @@ The subsystem has three layers:
     Two runs with the same seed produce identical event logs.
 """
 
-from .injector import ChaosClient, ChaosError, FaultInjector
+from .injector import (ChaosClient, ChaosError, ChaosHTTPClient,
+                       ChaosResetError, FaultInjector)
 from .invariants import InvariantChecker
 from .harness import ChaosHarness, ChaosReport
 
-__all__ = ["ChaosClient", "ChaosError", "FaultInjector",
-           "InvariantChecker", "ChaosHarness", "ChaosReport"]
+__all__ = ["ChaosClient", "ChaosError", "ChaosHTTPClient",
+           "ChaosResetError", "FaultInjector", "InvariantChecker",
+           "ChaosHarness", "ChaosReport"]

@@ -9,18 +9,39 @@ workload creation (gangs + singletons), node crashes and restarts,
 heartbeat suppression, node deletion, apiserver write partitions, and a
 background injected API error rate on every control-plane write.
 
+WIRE mode (`http=True`): a real `APIServer` serves the store and every
+control-plane component talks to it over actual HTTP — informers included
+— through `ChaosHTTPClient`, so the injector's wire fault classes
+(request latency, connection resets, watch-stream drops) hit the real
+transport: sockets reset, watch streams die mid-flight and RESUME at
+last_sync_rv, exactly the failure surface a remote hub has.
+
+COMPONENT RESTARTS (`with_restarts=True` adds them to the schedule; the
+methods are also directly callable): `restart_scheduler` crash-replaces
+the scheduler — its cache, assumed pods, and gang permit reservations die
+with it and must be rebuilt from informers; `restart_controller_manager`
+does the same for the controllers; `restart_store` WAL-replays the store
+in place (the etcd-restart analog), severing every live watch stream so
+clients must relist or resume.
+
 Determinism contract: the schedule is pregenerated from `seed` before the
 run; every control loop is stepped SYNCHRONOUSLY from the single driver
 thread; after each step the harness settles (waits until each informer's
 indexer matches the store) so informer-thread timing cannot change which
 calls the next step issues. Two runs with the same seed therefore produce
-identical FaultInjector event logs — `report.events`.
+identical FaultInjector event logs — `report.events`. (Read-path wire
+faults fire on informer threads and are deliberately excluded from the
+step-ordered log — see injector.py.)
 
 After the scheduled events, the run quiesces (faults off, dead nodes stay
 dead) long enough for eviction timeouts, permit timeouts, and gang
 resubmissions to converge, then sweeps the InvariantChecker. A green
 report means: no PodGroup partially bound, no cache assume or permit
 reservation on a dead node, and the WAL replays to the live store.
+`report.store_state` is the run's SEMANTIC end state (which objects
+exist, each pod's phase and boundness — not which node, not rv): a
+faulted run must converge to the same store_state as a fault-free run of
+the same schedule, or the faults leaked into outcomes.
 """
 
 from __future__ import annotations
@@ -43,7 +64,7 @@ from ..state.informer import SharedInformerFactory
 from ..state.store import NotFoundError, Store
 from ..utils.clock import FakeClock, now_iso
 from ..utils.metrics import RobustnessMetrics
-from .injector import ChaosClient, FaultInjector
+from .injector import ChaosClient, ChaosHTTPClient, FaultInjector
 from .invariants import InvariantChecker
 
 SLICE_LABEL = "tpu/slice"
@@ -54,6 +75,12 @@ _ACTIONS = (("create_gang", 0.26), ("create_singleton", 0.14),
             ("drop_heartbeat", 0.08), ("resume_heartbeat", 0.05),
             ("delete_node", 0.06), ("partition", 0.04), ("heal", 0.05),
             ("noop", 0.10))
+
+#: appended when with_restarts=True — component crash/restart as
+#: first-class chaos actions (rng.choices renormalizes the weights)
+_RESTART_ACTIONS = (("restart_scheduler", 0.05),
+                    ("restart_controllers", 0.04),
+                    ("restart_store", 0.03))
 
 
 @dataclass
@@ -68,6 +95,13 @@ class ChaosReport:
     resubmissions: int = 0
     nodes_killed: int = 0
     nodes_deleted: int = 0
+    scheduler_restarts: int = 0
+    controller_restarts: int = 0
+    store_restarts: int = 0
+    #: the semantic end state — sorted (resource, namespace, name,
+    #: phase, bound) tuples; node choice and resourceVersions excluded.
+    #: Comparable between a faulted and a fault-free run of one schedule.
+    store_state: List[Tuple] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -81,40 +115,85 @@ class ChaosHarness:
                  clock_step: float = 5.0,
                  grace_period: float = 12.0,
                  eviction_timeout: float = 30.0,
-                 gang_timeout: int = 60):
+                 gang_timeout: int = 60,
+                 http: bool = False,
+                 reset_rate: float = 0.0,
+                 latency_rate: float = 0.0,
+                 latency_max: float = 0.005,
+                 watch_drop_rate: float = 0.0,
+                 with_restarts: bool = False,
+                 enable_restarts: bool = True):
         self.seed = seed
         self.n_nodes = nodes
         self.nodes_per_slice = max(1, nodes_per_slice)
         self.clock_step = clock_step
         self.gang_timeout = gang_timeout
         self.wal_path = wal_path
+        self.grace_period = grace_period
+        self.eviction_timeout = eviction_timeout
+        self.http = http
+        #: with_restarts puts restart actions IN the schedule;
+        #: enable_restarts=False executes them as noops — a control run
+        #: keeps the identical schedule while skipping the restarts
+        self.with_restarts = with_restarts
+        self.enable_restarts = enable_restarts
         self.clock = FakeClock()
         self.metrics = RobustnessMetrics()
-        self.injector = FaultInjector(seed=seed, error_rate=error_rate,
-                                      metrics=self.metrics)
+        self.injector = FaultInjector(
+            seed=seed, error_rate=error_rate, metrics=self.metrics,
+            reset_rate=reset_rate, latency_rate=latency_rate,
+            latency_max=latency_max, watch_drop_rate=watch_drop_rate)
         self._base_error_rate = error_rate
         store = Store(wal_path=wal_path)
         #: the control plane's (faulted) client vs the harness's own
         #: admin view of the same store — workload creation and virtual
         #: kubelet writes stay fault-free so the run's INPUT is stable
         #: and only the control plane's handling of faults is under test
-        self.client = ChaosClient(self.injector, store=store)
         self.admin = Client(store)
+        self._server = None
+        if http:
+            # wire mode: a real hub over the store; the control plane's
+            # client speaks actual HTTP through the injector's wire hook
+            from ..apiserver.server import APIServer
+            from ..apiserver.httpclient import HTTPClient
+            self._server = APIServer(store=store).start()
+            self.client = ChaosHTTPClient(
+                self.injector,
+                HTTPClient(self._server.address,
+                           wire_hook=self.injector.make_wire_hook()))
+        else:
+            self.client = ChaosClient(self.injector, store=store)
+        #: controllers' factory; the scheduler runs its OWN factory so a
+        #: scheduler crash can take its informers down with it
         self.factory = SharedInformerFactory(self.client)
-        self.scheduler = Scheduler(self.client, informer_factory=self.factory,
-                                   batch_size=64, clock=self.clock)
-        self.nodelifecycle = NodeLifecycleController(
-            self.client, self.factory, grace_period=grace_period,
-            eviction_timeout=eviction_timeout, clock=self.clock,
-            metrics=self.metrics)
-        self.podgroups = PodGroupController(
-            self.client, self.factory, metrics=self.metrics,
-            clock=self.clock)
-        self.podgc = PodGCController(self.client, self.factory,
-                                     clock=self.clock)
+        self._sched_factory = SharedInformerFactory(self.client)
+        self.scheduler = self._build_scheduler(self._sched_factory)
+        self._build_controllers(self.factory)
         self._gang_counter = 0
         self._pod_counter = 0
         self._started = False
+
+    def _build_scheduler(self, factory: SharedInformerFactory) -> Scheduler:
+        # async_bind=False: the driver steps everything synchronously —
+        # a binder thread would commit binds at wall-clock-dependent
+        # times and break the identical-event-log contract in wire mode
+        return Scheduler(self.client, informer_factory=factory,
+                         batch_size=64, clock=self.clock,
+                         async_bind=False)
+
+    def _build_controllers(self, factory: SharedInformerFactory) -> None:
+        self.nodelifecycle = NodeLifecycleController(
+            self.client, factory, grace_period=self.grace_period,
+            eviction_timeout=self.eviction_timeout, clock=self.clock,
+            metrics=self.metrics)
+        self.podgroups = PodGroupController(
+            self.client, factory, metrics=self.metrics,
+            clock=self.clock)
+        self.podgc = PodGCController(self.client, factory,
+                                     clock=self.clock)
+
+    def _factories(self) -> List[SharedInformerFactory]:
+        return [self.factory, self._sched_factory]
 
     # ------------------------------------------------------------- setup
 
@@ -126,8 +205,9 @@ class ChaosHarness:
             return
         for i in range(self.n_nodes):
             self._register_node(i)
-        self.factory.start()
-        self.factory.wait_for_cache_sync()
+        for fac in self._factories():
+            fac.start()
+            fac.wait_for_cache_sync()
         self._settle()
         self._started = True
 
@@ -144,8 +224,54 @@ class ChaosHarness:
         self.admin.nodes().create(node)
 
     def close(self) -> None:
-        self.factory.stop()
+        for fac in self._factories():
+            fac.stop()
+        if self._server is not None:
+            self._server.stop()
         self.admin.store.close()
+
+    # ---------------------------------------------------------- restarts
+
+    def restart_scheduler(self) -> None:
+        """Crash-replace the scheduler: its informers stop, and its
+        cache, in-flight assumed pods, and gang permit-gate reservations
+        die with the process. The replacement rebuilds every bit of that
+        from a fresh informer sync — unbound members requeue, gangs
+        re-reserve — which is exactly the recovery under test."""
+        self.injector.record("restart_scheduler")
+        self._sched_factory.stop()
+        self.scheduler.crash()
+        self._sched_factory = SharedInformerFactory(self.client)
+        self.scheduler = self._build_scheduler(self._sched_factory)
+        self._sched_factory.start()
+        self._sched_factory.wait_for_cache_sync()
+        self._settle()
+
+    def restart_controller_manager(self) -> None:
+        """Crash-replace the controller manager's loops (nodelifecycle,
+        podgroup, podgc) and their shared informers. Controller-side soft
+        state — eviction timers, resubmission rate limits — is lost and
+        re-derived from observations, so recovery may converge LATER but
+        must still converge."""
+        self.injector.record("restart_controllers")
+        self.factory.stop()
+        self.factory = SharedInformerFactory(self.client)
+        self._build_controllers(self.factory)
+        self.factory.start()
+        self.factory.wait_for_cache_sync()
+        self._settle()
+
+    def restart_store(self) -> None:
+        """WAL-replay the store in place mid-run (the etcd/apiserver
+        restart analog). Every live watch stream is severed; informers
+        must resume or relist against the replayed state. No-op without
+        a wal_path — a journal-less restart would be data loss, which is
+        a different (unrecoverable) fault class."""
+        if self.wal_path is None:
+            return
+        self.injector.record("restart_store")
+        self.admin.store.restart()
+        self._settle()
 
     # ---------------------------------------------------------- schedule
 
@@ -157,8 +283,10 @@ class ChaosHarness:
         no-op) but never the script itself."""
         # string seeding is process-stable (sha512), tuple seeding is not
         rng = random.Random(f"chaos-schedule:{self.seed}")
-        names = [a for a, _ in _ACTIONS]
-        weights = [w for _, w in _ACTIONS]
+        table = _ACTIONS + _RESTART_ACTIONS if self.with_restarts \
+            else _ACTIONS
+        names = [a for a, _ in table]
+        weights = [w for _, w in table]
         out = []
         for _ in range(n_events):
             action = rng.choices(names, weights=weights)[0]
@@ -204,7 +332,25 @@ class ChaosHarness:
         report.resubmissions = sum(
             pg.status.resubmissions
             for pg in self.admin.pod_groups().list(namespace=None))
+        report.store_state = self.store_state()
         return report
+
+    def store_state(self) -> List[Tuple]:
+        """The run's semantic end state: which objects exist, each pod's
+        phase and whether it is bound — NOT which node (fault-driven
+        retries may legitimately land a pod elsewhere) and NOT rvs. The
+        surface on which a faulted run is compared to a fault-free run
+        of the same schedule."""
+        out: List[Tuple] = []
+        for n in self.admin.nodes().list():
+            out.append(("nodes", "", n.metadata.name, "", False))
+        for p in self.admin.pods().list(namespace=None):
+            out.append(("pods", p.metadata.namespace, p.metadata.name,
+                        p.status.phase or "", bool(p.spec.node_name)))
+        for pg in self.admin.pod_groups().list(namespace=None):
+            out.append(("podgroups", pg.metadata.namespace,
+                        pg.metadata.name, pg.status.phase or "", False))
+        return sorted(out)
 
     def _apply(self, ev: dict, report: ChaosReport) -> None:
         action = ev["action"]
@@ -241,6 +387,18 @@ class ChaosHarness:
         elif action == "heal":
             if self.injector.partitioned:
                 self.injector.partition(False)
+        elif action == "restart_scheduler":
+            if self.enable_restarts:
+                self.restart_scheduler()
+                report.scheduler_restarts += 1
+        elif action == "restart_controllers":
+            if self.enable_restarts:
+                self.restart_controller_manager()
+                report.controller_restarts += 1
+        elif action == "restart_store":
+            if self.enable_restarts and self.wal_path is not None:
+                self.restart_store()
+                report.store_restarts += 1
 
     def _node_exists(self, name: str) -> bool:
         try:
@@ -358,16 +516,18 @@ class ChaosHarness:
 
     def _informers_current(self) -> bool:
         from ..api.core import Node as NodeCls, Pod as PodCls
+        store = self.admin.store
         for cls in (PodCls, NodeCls, PodGroup):
-            inf = self.factory.informer_for(cls)
-            resource = self.client.scheme.resource_for(cls)
-            items, _ = self.client.store.list(resource)
+            resource = self.admin.scheme.resource_for(cls)
+            items, _ = store.list(resource)
             want = {o.metadata.key(): o.metadata.resource_version
                     for o in items}
-            have = {o.metadata.key(): o.metadata.resource_version
-                    for o in inf.indexer.list()}
-            if want != have:
-                return False
+            for fac in self._factories():
+                inf = fac.informer_for(cls)
+                have = {o.metadata.key(): o.metadata.resource_version
+                        for o in inf.indexer.list()}
+                if want != have:
+                    return False
         return True
 
     def _settle(self, timeout: float = 10.0) -> None:

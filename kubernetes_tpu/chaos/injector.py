@@ -13,12 +13,36 @@ class, modeled as a partition of WRITES), while every mutating verb
 consults the injector first and raises `ChaosError` when the oracle says
 so. Components under test see the same exception surface a flaky
 apiserver would give them.
+
+WIRE faults extend the same contract to the real HTTP transport
+(`apiserver/httpclient.py`'s injectable wire hook):
+
+  - request latency (`latency_rate`): a deterministic pre-send sleep;
+  - connection resets (`reset_rate`): the request dies with
+    `ChaosResetError` before any byte leaves the process;
+  - watch drops (`watch_drop_rate`): a watch stream is severed after a
+    deterministic number of events — keyed by the stream's per-resource
+    CONNECTION index, not the step, because reconnects happen on
+    informer threads whose timing the driver does not control. The
+    per-resource drop plans (`wire_watch_plans`) are therefore a pure
+    function of the seed and are comparable across runs even though
+    their wall-clock interleaving is not.
+
+Read-path wire faults (GET/WATCH) are deliberately kept out of the
+step-ordered event log: they fire on informer threads at nondeterministic
+times, and logging them would break the identical-event-log contract.
+They are still deterministic per signature and counted in metrics.
+
+`ChaosHTTPClient` mirrors ChaosClient over an `HTTPClient`: mutating
+verbs consult the injector (API-error faults) while the wire hook below
+them injects transport faults — both fault surfaces on the real wire.
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..state.client import Client
@@ -37,6 +61,12 @@ class ChaosError(Exception):
     retry with backoff or requeue."""
 
 
+class ChaosResetError(ConnectionResetError):
+    """An injected wire-level connection reset: the request never reached
+    the server. Transport-shaped (ConnectionResetError) so callers'
+    generic retry machinery treats it exactly like a real RST."""
+
+
 class FaultInjector:
     """Seeded fault oracle + chaos event log.
 
@@ -49,13 +79,30 @@ class FaultInjector:
     """
 
     def __init__(self, seed: int = 0, error_rate: float = 0.0,
-                 metrics=None):
+                 metrics=None, reset_rate: float = 0.0,
+                 latency_rate: float = 0.0, latency_max: float = 0.02,
+                 watch_drop_rate: float = 0.0,
+                 watch_drop_horizon: int = 12):
         self.seed = seed
         self.error_rate = error_rate
+        #: wire fault classes (see module docstring)
+        self.reset_rate = reset_rate
+        self.latency_rate = latency_rate
+        self.latency_max = latency_max
+        self.watch_drop_rate = watch_drop_rate
+        self.watch_drop_horizon = max(1, watch_drop_horizon)
         self.metrics = metrics
         self.step = 0
         self.partitioned = False
         self._lock = threading.Lock()
+        #: resource -> number of watch streams opened (the per-resource
+        #: connection index that keys drop decisions)
+        self._watch_conns: Dict[str, int] = {}
+        #: resource -> the drop plan of each connection in open order
+        #: (None = stream lives; K = severed after K events) — a pure
+        #: function of (seed, resource, connection index), comparable
+        #: across runs
+        self.wire_watch_plans: Dict[str, List[Optional[int]]] = {}
         #: nodes whose "kubelet process" is down (no heartbeats; cleared
         #: by restart_node)
         self._down: set = set()
@@ -158,6 +205,86 @@ class FaultInjector:
         if self.metrics is not None:
             self.metrics.faults_injected.inc(kind=kind)
 
+    # -------------------------------------------------------- wire layer
+
+    def _draw(self, *sig) -> float:
+        """One uniform [0,1) draw, a pure function of (seed, *sig)."""
+        digest = hashlib.sha1(
+            ":".join(str(s) for s in (self.seed,) + sig).encode()).digest()
+        return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+    def _wire_attempt(self, method: str, resource: str, path: str) -> int:
+        with self._lock:
+            sig = (self.step, "wire", method, resource, path)
+            attempt = self._attempts.get(sig, 0)
+            self._attempts[sig] = attempt + 1
+            return attempt
+
+    def wire_request(self, method: str, resource: str, path: str) -> None:
+        """Transport faults for one HTTP request: an independent reset
+        draw and latency draw per (step, signature, attempt). Mutating
+        requests come off the driver thread and are recorded in the
+        step-ordered event log; reads (GET) fire from informer threads
+        and are counted in metrics only (see module docstring)."""
+        if self.reset_rate <= 0.0 and self.latency_rate <= 0.0:
+            return
+        mutating = method not in ("GET", "WATCH")
+        attempt = self._wire_attempt(method, resource, path)
+        if self.latency_rate > 0.0:
+            d = self._draw(self.step, "latency", method, resource, path,
+                           attempt)
+            if d < self.latency_rate:
+                # the draw's sub-rate position scales the delay, so one
+                # signature yields both the decision and the magnitude
+                delay = (d / self.latency_rate) * self.latency_max
+                self._count("wire_latency")
+                if mutating:
+                    self.record("wire_latency", method, resource, path,
+                                attempt)
+                time.sleep(delay)
+        if self.reset_rate > 0.0:
+            d = self._draw(self.step, "reset", method, resource, path,
+                           attempt)
+            if d < self.reset_rate:
+                self._count("wire_reset")
+                if mutating:
+                    self.record("wire_reset", method, resource, path,
+                                attempt)
+                raise ChaosResetError(
+                    f"injected connection reset: {method} {path} "
+                    f"(attempt {attempt})")
+
+    def watch_plan(self, resource: str) -> Optional[int]:
+        """Drop decision for the next watch stream of `resource`: None to
+        let it live, or the number of events after which the transport
+        severs it. Keyed by the per-resource connection index so the plan
+        sequence is a pure function of the seed regardless of WHEN (on
+        which informer-thread schedule) each reconnect happens."""
+        with self._lock:
+            conn = self._watch_conns.get(resource, 0)
+            self._watch_conns[resource] = conn + 1
+        plan: Optional[int] = None
+        if self.watch_drop_rate > 0.0:
+            d = self._draw("watchdrop", resource, conn)
+            if d < self.watch_drop_rate:
+                plan = int(self._draw("watchdrop-k", resource, conn)
+                           * self.watch_drop_horizon)
+                self._count("watch_drop")
+        with self._lock:
+            self.wire_watch_plans.setdefault(resource, []).append(plan)
+        return plan
+
+    def make_wire_hook(self):
+        """The `HTTPClient(wire_hook=...)` adapter: one callable serving
+        both hook kinds (request faults; watch-stream drop budgets)."""
+        def hook(kind: str, op: str, resource: str, path: str):
+            if kind == "watch":
+                self.wire_request("WATCH", resource, path)
+                return self.watch_plan(resource)
+            self.wire_request(op, resource, path)
+            return None
+        return hook
+
 
 def _target_name(args, kwargs) -> str:
     """Best-effort object name from a verb's arguments (for the fault
@@ -181,6 +308,18 @@ class _FaultyResourceClient:
     def __init__(self, inner, injector: FaultInjector):
         self._inner = inner
         self._injector = injector
+
+    @property
+    def _SLIM_WATCH(self):
+        """Slim-frame negotiation is a TRANSPORT concern: forward it to
+        the inner client so informers over this proxy negotiate exactly
+        as they would against the bare transport (the chaos wire soak
+        must exercise the production slim-bind path)."""
+        return getattr(self._inner, "_SLIM_WATCH", None)
+
+    @_SLIM_WATCH.setter
+    def _SLIM_WATCH(self, value):
+        self._inner._SLIM_WATCH = value
 
     def __getattr__(self, name: str):
         attr = getattr(self._inner, name)
@@ -212,3 +351,32 @@ class ChaosClient(Client):
     def resource(self, cls, namespace=None):
         return _FaultyResourceClient(
             super().resource(cls, namespace), self.injector)
+
+
+class ChaosHTTPClient:
+    """ChaosClient's shape over the REAL wire: wraps an HTTPClient whose
+    transport already carries the injector's wire hook (latency, resets,
+    watch drops), and layers the same mutating-verb API-error oracle on
+    top. Components handed this client experience BOTH fault surfaces on
+    an actual HTTP connection to a live hub."""
+
+    def __init__(self, injector: FaultInjector, http):
+        self._inner = http
+        self.injector = injector
+        self.scheme = http.scheme
+        self.base_url = http.base_url
+
+    def resource(self, cls, namespace=None):
+        return _FaultyResourceClient(
+            self._inner.resource(cls, namespace), self.injector)
+
+    def __getattr__(self, name):
+        """Accessor delegation (pods(), nodes(), ...) through Client's
+        resource table, same shim trick as HTTPClient."""
+        template = getattr(Client, name, None)
+        if template is None or not callable(template):
+            raise AttributeError(name)
+
+        def accessor(*args, **kwargs):
+            return template(self, *args, **kwargs)
+        return accessor

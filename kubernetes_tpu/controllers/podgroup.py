@@ -15,6 +15,14 @@ assignment and status stripped), so a gang killed by a node death
 reschedules as one unit instead of leaving survivors wedged on a broken
 slice. Two-pass by design — record Failed, then resubmit — so the Failed
 observation is never lost to the rebuild.
+
+Member SPEC SNAPSHOTS: when a member is first observed, its clean
+template is recorded onto the PodGroup (status.member_templates) in the
+same status write the phase rides. Resubmission rebuilds from the union
+of live members and snapshots, so members LOST before the rebuild (node
+GC'd, deleted during an outage, or dropped mid-resubmission by a crash)
+are recreated from their snapshot instead of being gone forever — the
+gang can always reach minMember again.
 """
 
 from __future__ import annotations
@@ -97,11 +105,18 @@ class PodGroupController(Controller):
                                    RESUBMIT_MIN_INTERVAL - (now - last))
                 return
             self._last_resubmit[key] = now
-            self._resubmit(ns, name, members)
+            self._resubmit(ns, name, members,
+                           templates=dict(st.member_templates))
             return
+        #: members whose clean template is not yet snapshotted onto the
+        #: group — recorded in the SAME status write the phase rides, so
+        #: admission costs no extra API round trip
+        snap = {p.metadata.name: serde.encode(self._clean_clone(p))
+                for p in members
+                if p.metadata.name not in st.member_templates}
         if (st.phase == phase and st.scheduled == scheduled
                 and st.running == running and st.succeeded == succeeded
-                and st.failed == failed):
+                and st.failed == failed and not snap):
             return
 
         def mutate(cur):
@@ -110,6 +125,7 @@ class PodGroupController(Controller):
             cur.status.running = running
             cur.status.succeeded = succeeded
             cur.status.failed = failed
+            cur.status.member_templates.update(snap)
             return cur
         from ..state.store import NotFoundError
         try:
@@ -137,7 +153,8 @@ class PodGroupController(Controller):
         clone.status = PodStatus()
         return clone
 
-    def _resubmit(self, ns: str, name: str, members) -> None:
+    def _resubmit(self, ns: str, name: str, members,
+                  templates: dict = None) -> None:
         """Failed -> Pending: delete EVERY member (failed ones and
         survivors alike — the slice fails as a unit) and recreate each as
         a clean clone, then reset the group's status. Clones are captured
@@ -145,12 +162,30 @@ class PodGroupController(Controller):
         aborts AFTER recreating the members already deleted (their specs
         live only in the clones), leaving every spec reachable for the
         re-synced retry. Creates retry with backoff and
-        are all attempted even when one exhausts its policy; a member
-        whose create still fails is LOST — its spec lived only in the
-        deleted pod — so the loss is raised loudly rather than absorbed
-        (ROADMAP: spec snapshots on the PodGroup would close this)."""
+        are all attempted even when one exhausts its policy.
+
+        `templates` are the group's admission-time spec snapshots
+        (status.member_templates): members present there but MISSING from
+        the live set — lost to node GC, deleted during an outage, or
+        dropped by a crash mid-rebuild — are recreated from snapshot, so
+        a lost member no longer strands the gang below minMember. A
+        member whose create still fails after the retry policy is raised
+        loudly; its snapshot survives on the group, so the next rebuild
+        recovers it."""
         from ..state.store import AlreadyExistsError, NotFoundError
         clones = [self._clean_clone(pod) for pod in members]
+        live = {pod.metadata.name for pod in members}
+        for tname, tmpl in sorted((templates or {}).items()):
+            if tname in live:
+                continue
+            try:
+                lost_clone = serde.decode(Pod, tmpl)
+            except Exception:
+                continue  # unreadable snapshot: nothing to rebuild from
+            lost_clone.metadata.namespace = ns
+            # lost members have no live pod to delete — straight to the
+            # recreate list
+            clones.append(lost_clone)
         deleted: list = []   # clones of members whose delete committed
         abort = None
         for pod, clone in zip(members, clones):
@@ -187,9 +222,9 @@ class PodGroupController(Controller):
         if lost:
             raise RuntimeError(
                 f"PodGroup {ns}/{name} resubmission lost member(s) "
-                f"{lost}: deleted but could not be recreated — the gang "
-                f"cannot reach minMember until they are resubmitted "
-                f"out of band")
+                f"{lost}: deleted but could not be recreated — their "
+                f"spec snapshots remain on the group, so the next "
+                f"rate-limited rebuild recovers them")
         if abort is not None:
             # every committed delete was restored; the phase stays Failed
             # and the rate-limited re-sync retries the whole resubmission

@@ -44,7 +44,7 @@ class Scheduler:
                  clock: Clock = REAL_CLOCK,
                  disable_preemption: bool = False,
                  framework=None, extenders=None, metrics=None,
-                 mesh=None):
+                 mesh=None, async_bind: Optional[bool] = None):
         from .framework import Framework
         from .metrics import SchedulerMetrics
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
@@ -126,8 +126,13 @@ class Scheduler:
         #: binder thread forget the assumed pod + invalidate device usage
         #: (same self-heal as the reference's Forget on bind error,
         #: scheduler.go:556; assumed-TTL covers anything missed).
-        self._async_bind = (getattr(client, "base_url", None) is not None
-                            and self._bind_extender is None)
+        # `async_bind` overrides the transport heuristic: a caller that
+        # steps the scheduler synchronously (the chaos harness, whose
+        # determinism contract cannot tolerate binder-thread timing)
+        # passes False even over HTTP
+        self._async_bind = async_bind if async_bind is not None else (
+            getattr(client, "base_url", None) is not None
+            and self._bind_extender is None)
         self._bind_pool = None
         self._bind_futures: list = []
         self._count_lock = threading.Lock()
@@ -1231,6 +1236,20 @@ class Scheduler:
             self._flush_binds()
             self._bind_pool.shutdown(wait=True)
         self.informers.stop()
+
+    def crash(self) -> None:
+        """Abandon this scheduler as a dead process would: worker pools
+        shut down WITHOUT draining — in-flight binds and commits are
+        lost, assumed pods and permit reservations die with the object.
+        The replacement rebuilds all of that from a fresh informer sync
+        (the chaos harness's restart_scheduler drives exactly this).
+        Informers are the factory's to stop; stop() stays the graceful
+        path that drains everything."""
+        self._stop.set()
+        if self._commit_pool_ is not None:
+            self._commit_pool_.shutdown(wait=False)
+        if self._bind_pool is not None:
+            self._bind_pool.shutdown(wait=False)
 
     def wait_for_idle(self, timeout: float = 30.0,
                       settle: float = 0.25) -> bool:

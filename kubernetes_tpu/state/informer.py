@@ -10,11 +10,14 @@ indexer and notifies listeners.
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Type
 
+from ..utils.backoff import BackoffPolicy
+from ..utils.metrics import InformerMetrics
 from .client import Client, ResourceClient, apply_bind_fields
 from .store import ADDED, DELETED, ExpiredError, MODIFIED, SlimBindRef
 
@@ -103,11 +106,37 @@ class SharedInformer:
     Handlers run on the informer's delivery thread (the reference's
     processorListener goroutines collapse to direct calls here; handlers must
     be fast and push work onto workqueues, which is also the reference's
-    contract)."""
+    contract).
+
+    Failure model (ref: reflector.go ListAndWatch + the watch cache's
+    bounded history): the informer tracks `last_sync_rv` — the reference's
+    lastSyncResourceVersion — and answers a broken watch stream by
+    RECONNECTING the watch at that rv. A full LIST happens only on first
+    sync and when the server answers 410 Gone (the rv fell out of the
+    bounded history window — Store.HISTORY_WINDOW / ExpiredError).
+    Reconnect attempts back off with the shared utils/backoff policy and
+    reset once a stream makes progress. A heartbeat-staleness watchdog
+    kills wire streams that go silent (the hub heartbeats every second,
+    so silence is dead TCP, not an idle cluster) instead of blocking on a
+    read that will never return."""
+
+    #: reconnect backoff after a zero-progress watch round (connect
+    #: failure or a stream that died before delivering anything)
+    BACKOFF = BackoffPolicy(base=0.05, factor=2.0, cap=2.0, attempts=8,
+                            jitter=0.2)
+    #: kill a wire watch stream with no bytes (heartbeats included) for
+    #: this long; in-process store watches have no wire and are exempt
+    WATCH_STALENESS_TIMEOUT = 30.0
+    #: event-queue poll period — the cadence of stop checks and the
+    #: staleness watchdog while the stream is idle
+    _POLL = 1.0
 
     def __init__(self, rc: ResourceClient,
-                 index_funcs: Optional[Dict[str, Callable]] = None):
+                 index_funcs: Optional[Dict[str, Callable]] = None,
+                 metrics: Optional[InformerMetrics] = None):
         self._rc = rc
+        self._resource = getattr(rc, "_resource", "")
+        self.metrics = metrics if metrics is not None else InformerMetrics()
         self.indexer = Indexer(index_funcs)
         self._handlers: List[EventHandlers] = []
         self._lock = threading.Lock()
@@ -116,6 +145,10 @@ class SharedInformer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch = None
+        #: rv of the last event processed (or the last LIST) — where a
+        #: dropped watch resumes. None until the first sync.
+        self.last_sync_rv: Optional[int] = None
+        self.staleness_timeout = self.WATCH_STALENESS_TIMEOUT
 
     def add_event_handlers(self, handlers: EventHandlers) -> None:
         with self._lock:
@@ -123,6 +156,15 @@ class SharedInformer:
             if self._synced.is_set():
                 for obj in self.indexer.list():
                     self._dispatch(handlers.on_add, obj)
+
+    def remove_event_handlers(self, handlers: EventHandlers) -> None:
+        """Detach a handler set (a crashed/restarted component must not
+        keep receiving deliveries through a shared factory)."""
+        with self._lock:
+            try:
+                self._handlers.remove(handlers)
+            except ValueError:
+                pass
 
     def start(self) -> None:
         with self._lock:
@@ -138,13 +180,37 @@ class SharedInformer:
             if self._watch is not None:
                 self._watch.stop()
 
+    def _delays(self) -> Iterator[float]:
+        """The reconnect schedule: the shared policy's escalation, then
+        its cap forever (a reflector retries indefinitely — backoff
+        exhaustion must not strand the informer). Jitter is seeded per
+        INSTANCE: after a hub restart severs every replica's streams,
+        identically-seeded delays would reconnect the whole fleet at the
+        same instants — a synchronized herd against the recovering
+        server. The read path sits outside the chaos event-log
+        determinism contract, so instance-varying jitter breaks nothing."""
+        yield from self.BACKOFF.delays(seed=id(self) & 0xFFFFFFFF,
+                                       op=self._resource)
+        while True:
+            yield self.BACKOFF.cap
+
     def _run(self) -> None:
         auth_error_logged = False
+        relist = True
+        delay_iter: Optional[Iterator[float]] = None
         while not self._stop.is_set():
+            resumed = not relist
             try:
-                self._list_and_watch()
+                if relist:
+                    self._relist()
+                    relist = False
+                delivered = self._watch_round(resumed)
             except ExpiredError:
-                continue  # relist (ref: reflector resourceVersion-too-old path)
+                # 410 Gone: last_sync_rv fell out of the server's bounded
+                # history window — the ONLY error that costs a full LIST
+                # (ref: reflector resourceVersion-too-old path)
+                relist = True
+                continue
             except PermissionError as e:
                 # credential failures are not transient: surface once and
                 # back off hard instead of hammering the hub at 20 req/s
@@ -156,10 +222,18 @@ class SharedInformer:
                 if self._stop.is_set():
                     return
                 self._stop.wait(5.0)
+                continue
             except Exception:
                 if self._stop.is_set():
                     return
-                self._stop.wait(0.05)
+                if delay_iter is None:
+                    delay_iter = self._delays()
+                self._stop.wait(next(delay_iter))
+                continue
+            if delivered is None:
+                return  # stop() requested
+            if delivered > 0:
+                delay_iter = None  # the stream made progress: reset backoff
 
     def _dispatch(self, fn, *args) -> None:
         """Handler exceptions must not tear down the watch loop (a failing
@@ -172,7 +246,9 @@ class SharedInformer:
             import traceback
             traceback.print_exc()
 
-    def _list_and_watch(self) -> None:
+    def _relist(self) -> None:
+        """LIST + replace + synthetic delta dispatch — first sync and the
+        410 recovery path (ref: DeltaFIFO Replace semantics)."""
         with self._lock:
             if self._watch is not None:  # drop a stale watch from a prior round
                 self._watch.stop()
@@ -193,59 +269,131 @@ class SharedInformer:
         for prev in old.values():
             for h in handlers:
                 self._dispatch(h.on_delete, prev)
+        self.last_sync_rv = int(rv)
+        self.metrics.relists.inc(resource=self._resource)
         self._synced.set()
+
+    def _watch_round(self, resumed: bool) -> Optional[int]:
+        """One watch stream's lifetime, connected at last_sync_rv.
+        Returns the number of events processed (the caller resets its
+        backoff on progress), or None when stop() ended the round.
+        Raises ExpiredError on 410 (caller relists) and the stream/
+        connect error on a zero-progress round (caller backs off)."""
         # negotiate slim bind frames on transports that support them: the
         # informer (unlike raw watch consumers) holds every object's
-        # previous revision and can apply the delta
-        if getattr(type(self._rc), "_SLIM_WATCH", None) is False:
-            self._rc._SLIM_WATCH = True
-        watch = self._rc.watch(resource_version=rv)
+        # previous revision and can apply the delta. Instance-level
+        # lookup, not type-level, so proxies that forward the attribute
+        # (chaos/_FaultyResourceClient) negotiate for their inner client
+        # and the wire-chaos soak exercises the same slim path
+        # production informers use.
+        if getattr(self._rc, "_SLIM_WATCH", None) is False:
+            try:
+                self._rc._SLIM_WATCH = True
+            except AttributeError:
+                pass
+        watch = self._rc.watch(resource_version=self.last_sync_rv)
         with self._lock:
             self._watch = watch
             if self._stop.is_set():  # stop() raced the watch creation
                 watch.stop()
-                return
-        for ev in watch:
+                return None
+        if resumed:
+            self.metrics.watch_reconnects.inc(resource=self._resource)
+        delivered = 0
+        while True:
+            try:
+                ev = watch.events.get(timeout=self._POLL)
+            except queue_mod.Empty:
+                if self._stop.is_set():
+                    return None
+                # heartbeat-staleness watchdog: the server heartbeats
+                # every second, so a wire stream with no bytes at all is
+                # dead TCP — kill it and resume at last_sync_rv rather
+                # than block forever on a read that will never return
+                last_activity = getattr(watch, "last_activity", None)
+                if last_activity is not None:
+                    stale = time.monotonic() - last_activity
+                    self.metrics.watch_staleness.set(
+                        stale, resource=self._resource)
+                    if stale >= self.staleness_timeout \
+                            and hasattr(watch, "kill") \
+                            and not getattr(watch, "killed", False):
+                        self.metrics.watch_stale_kills.inc(
+                            resource=self._resource)
+                        watch.kill(f"no bytes for {stale:.1f}s")
+                        # the pump notices the dead socket and closes the
+                        # queue; keep draining until the None arrives
+                continue
+            if ev is None:
+                break
             if self._stop.is_set():
-                return
-            obj = ev.object
-            if isinstance(obj, SlimBindRef):
-                # negotiated slim bind frame: materialize the bound pod
-                # from our cached prior revision (the hub applied exactly
-                # these fields to exactly that object)
-                cached = self.indexer.get_by_key(
-                    f"{obj.namespace}/{obj.name}" if obj.namespace
-                    else obj.name)
-                if cached is None:
-                    try:  # cache miss (relist raced): fall back to a GET
-                        obj = self._rc.get(obj.name, namespace=obj.namespace)
-                    except Exception:
-                        continue
+                return None
+            if self._process_event(ev):
+                delivered += 1
+        if self._stop.is_set():
+            return None
+        self.metrics.watch_staleness.set(0.0, resource=self._resource)
+        err = getattr(watch, "error", None)
+        if err is not None:
+            self.metrics.watch_stream_errors.inc(
+                resource=self._resource, reason=type(err).__name__)
+        if delivered == 0:
+            # a stream that died (or closed) without ever delivering — a
+            # flapping/restarting hub: back off before reconnecting so a
+            # dead server isn't hammered. A stream that MADE progress
+            # reconnects immediately even when it ended in an error (the
+            # caller resets its backoff on the returned count).
+            raise err if err is not None else ConnectionError(
+                f"watch on {self._resource} closed without progress")
+        return delivered
+
+    def _process_event(self, ev) -> bool:
+        """Apply one watch event to the indexer, advance last_sync_rv,
+        and fan out to handlers. False if the event was dropped (a slim
+        frame whose object could not be materialized)."""
+        obj = ev.object
+        if isinstance(obj, SlimBindRef):
+            # negotiated slim bind frame: materialize the bound pod
+            # from our cached prior revision (the hub applied exactly
+            # these fields to exactly that object)
+            cached = self.indexer.get_by_key(
+                f"{obj.namespace}/{obj.name}" if obj.namespace
+                else obj.name)
+            if cached is None:
+                try:  # cache miss (relist raced): fall back to a GET
+                    obj = self._rc.get(obj.name, namespace=obj.namespace)
+                except Exception:
+                    return False
+            else:
+                from ..api import serde
+                new = serde.shallow_bind_clone(cached)
+                apply_bind_fields(new, obj.node, obj.ts)
+                new.metadata.resource_version = str(obj.rv)
+                obj = new
+        with self._lock:
+            handlers = list(self._handlers)
+        if ev.type == ADDED:
+            prev = self.indexer.get_by_key(Indexer.key_of(obj))
+            self.indexer.add(obj)
+            for h in handlers:
+                if prev is None:
+                    self._dispatch(h.on_add, obj)
                 else:
-                    from ..api import serde
-                    new = serde.shallow_bind_clone(cached)
-                    apply_bind_fields(new, obj.node, obj.ts)
-                    new.metadata.resource_version = str(obj.rv)
-                    obj = new
-            with self._lock:
-                handlers = list(self._handlers)
-            if ev.type == ADDED:
-                prev = self.indexer.get_by_key(Indexer.key_of(obj))
-                self.indexer.add(obj)
-                for h in handlers:
-                    if prev is None:
-                        self._dispatch(h.on_add, obj)
-                    else:
-                        self._dispatch(h.on_update, prev, obj)
-            elif ev.type == MODIFIED:
-                prev = self.indexer.get_by_key(Indexer.key_of(obj))
-                self.indexer.update(obj)
-                for h in handlers:
-                    self._dispatch(h.on_update, prev if prev is not None else obj, obj)
-            elif ev.type == DELETED:
-                self.indexer.delete(obj)
-                for h in handlers:
-                    self._dispatch(h.on_delete, obj)
+                    self._dispatch(h.on_update, prev, obj)
+        elif ev.type == MODIFIED:
+            prev = self.indexer.get_by_key(Indexer.key_of(obj))
+            self.indexer.update(obj)
+            for h in handlers:
+                self._dispatch(h.on_update, prev if prev is not None else obj, obj)
+        elif ev.type == DELETED:
+            self.indexer.delete(obj)
+            for h in handlers:
+                self._dispatch(h.on_delete, obj)
+        if ev.resource_version:
+            rv = int(ev.resource_version)
+            if self.last_sync_rv is None or rv > self.last_sync_rv:
+                self.last_sync_rv = rv
+        return True
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         """False fast if the informer is stopped (ref: WaitForCacheSync
@@ -270,8 +418,12 @@ class SharedInformerFactory:
     """Ref: client-go informers.NewSharedInformerFactory — one informer per
     type, shared across all consumers."""
 
-    def __init__(self, client: Client):
+    def __init__(self, client: Client,
+                 metrics: Optional[InformerMetrics] = None):
         self._client = client
+        #: one metric family set shared by this factory's informers
+        #: (series split by resource label)
+        self.metrics = metrics if metrics is not None else InformerMetrics()
         self._informers: Dict[Type, SharedInformer] = {}
         self._lock = threading.Lock()
         self._started = False
@@ -285,7 +437,8 @@ class SharedInformerFactory:
                 from ..api.core import Pod
                 if cls is Pod:
                     index_funcs["nodeName"] = pod_node_name_index
-                inf = SharedInformer(self._client.resource(cls), index_funcs)
+                inf = SharedInformer(self._client.resource(cls), index_funcs,
+                                     metrics=self.metrics)
                 self._informers[cls] = inf
             started = self._started
         if started:

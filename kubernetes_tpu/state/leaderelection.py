@@ -47,6 +47,7 @@ class LeaderElector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.is_leader = False
+        self._acquire_error_logged = False
 
     # ------------------------------------------------------------ lease ops
 
@@ -55,7 +56,33 @@ class LeaderElector:
 
     def _try_acquire_or_renew(self) -> bool:
         """Ref: leaderelection.go tryAcquireOrRenew — create the lease, or
-        take it over when expired, or renew when held by us."""
+        take it over when expired, or renew when held by us. ANY error is
+        a failed attempt, not a crash: a transient apiserver hiccup must
+        cost one retry period (and, for a holder, eventually the fencing
+        deadline) — it must never kill the election loop, which would
+        silently stop the component forever (the reference logs and
+        returns false for exactly this reason)."""
+        try:
+            out = self._try_acquire_or_renew_once()
+        except (ConflictError, NotFoundError, AlreadyExistsError):
+            return False  # lost a race; the next period re-evaluates
+        except Exception as e:
+            # transient API failure: retry, don't die — but say so ONCE
+            # per failure streak, or a permanent misconfiguration (bad
+            # credentials, wrong namespace) would spin silently forever
+            # with the gated component doing nothing
+            if not self._acquire_error_logged:
+                import logging
+                logging.getLogger("leaderelection").warning(
+                    "%s/%s: lease acquire/renew failed (will keep "
+                    "retrying every %.1fs): %r",
+                    self.name, self.identity, self.retry_period, e)
+                self._acquire_error_logged = True
+            return False
+        self._acquire_error_logged = False
+        return out
+
+    def _try_acquire_or_renew_once(self) -> bool:
         now = now_iso(self.clock)
         try:
             cur = self._leases().get(self.name)

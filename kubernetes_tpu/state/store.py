@@ -276,6 +276,47 @@ class Store:
                 self._wal.close()
                 self._wal = None
 
+    def restart(self) -> None:
+        """Crash-restart the store process in place: drain and close the
+        journal, drop ALL in-memory state (objects, watch history, live
+        watch subscriptions), and rebuild by replaying the WAL — the
+        etcd-restart analog the chaos harness drives mid-run.
+
+        Every live watcher's stream ends (a clean close, no error): store
+        clients must reconnect, and because the event history dies with
+        the process, a resume at any rv below the replayed head answers
+        ExpiredError — exactly the relist storm a real apiserver restart
+        causes. Requires a wal_path'd store; a WAL-less restart would be
+        data loss, not recovery, and raises instead.
+
+        The journal tail is drained before the crash point (the wal_sync
+        deployment's guarantee); testing torn-tail loss is wal.py's
+        domain, not this hook's."""
+        with self._lock:
+            if self._wal is None:
+                raise RuntimeError(
+                    "store restart without a WAL would lose everything; "
+                    "construct the Store with wal_path to use restart()")
+            path = self._wal.path
+            sync = self._wal.sync
+            self._wal.flush()
+            self._wal.close()
+            self._wal = None
+            # sever every live stream: each watcher sees its queue end
+            watches = list(self._watches.values())
+            self._watches.clear()
+            for _res, _ns, w in watches:
+                w._stopped = True
+                w.events.put(None)
+            self._data.clear()
+            self._history.clear()
+            self._rv = 0
+            self._uid_counter = 0
+            self._replay_wal(path)
+            from .wal import WalWriter
+            self._wal = WalWriter(path, sync=sync, deferred=not sync,
+                                  encoder=serde.encode_cached)
+
     # ------------------------------------------------------------- writes
 
     def create(self, resource: str, obj: Any) -> Any:

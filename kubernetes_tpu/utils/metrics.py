@@ -224,6 +224,49 @@ class GangMetrics:
             "Seconds a gang member held a reservation at the permit gate")
 
 
+class InformerMetrics:
+    """Reflector/informer observability: how often watch streams break,
+    how they recover (resume at last_sync_rv vs full relist), and how
+    stale a live stream is. One family set is shared by every informer of
+    a factory — series are labeled by resource."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: watch streams re-established at last_sync_rv WITHOUT a relist —
+        #: the reflector resume path (a dropped connection costs one
+        #: reconnect, not one LIST of every object)
+        self.watch_reconnects = r.counter(
+            "informer_watch_reconnects_total",
+            "Watch streams re-established at last_sync_rv without a "
+            "relist, by resource")
+        #: full LIST+replace resyncs: first sync, 410 history overflow,
+        #: or a server that lost its watch history (store restart)
+        self.relists = r.counter(
+            "informer_relists_total",
+            "Full LIST+replace resyncs (initial sync or 410 Gone), "
+            "by resource")
+        #: watch streams that terminated with a recorded error (vs the
+        #: server's clean close), by resource and error class
+        self.watch_stream_errors = r.counter(
+            "informer_watch_stream_errors_total",
+            "Watch streams torn down by a stream error, by resource "
+            "and reason")
+        #: seconds since the last byte (events OR server heartbeats) on
+        #: the informer's current watch stream; sampled while the event
+        #: queue is idle. A stream past the staleness timeout is killed
+        #: and resumed instead of hanging forever.
+        self.watch_staleness = r.gauge(
+            "informer_watch_staleness_seconds",
+            "Seconds since the last byte on the informer's watch stream, "
+            "by resource")
+        #: streams killed by the staleness watchdog (silently-dead TCP:
+        #: no FIN, no heartbeats — the read would otherwise block forever)
+        self.watch_stale_kills = r.counter(
+            "informer_watch_stale_kills_total",
+            "Watch streams killed after heartbeat staleness, by resource")
+
+
 class RobustnessMetrics:
     """Failure-handling metric families: retried/abandoned API writes
     (utils/backoff.retry), gang-atomic evictions (nodelifecycle), and

@@ -22,7 +22,8 @@ from kubernetes_tpu.api.scheduling import (PHASE_FAILED, PHASE_PENDING,
                                            PodGroup, PodGroupSpec)
 from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
 from kubernetes_tpu.chaos import (ChaosClient, ChaosError, ChaosHarness,
-                                  FaultInjector, InvariantChecker)
+                                  ChaosResetError, FaultInjector,
+                                  InvariantChecker)
 from kubernetes_tpu.state import Client, SharedInformerFactory
 from kubernetes_tpu.utils import backoff
 from kubernetes_tpu.utils.clock import FakeClock, now_iso
@@ -501,6 +502,301 @@ class TestChaosRuns:
             assert report.nodes_killed + report.nodes_deleted > 5
         finally:
             h.close()
+
+
+class TestWireFaults:
+    """The injector's wire-level fault classes (latency, resets, watch
+    drops) keyed by the same determinism contract."""
+
+    def test_resets_deterministic_and_mixed(self):
+        a = FaultInjector(seed=9, reset_rate=0.5)
+        b = FaultInjector(seed=9, reset_rate=0.5)
+        for inj in (a, b):
+            inj.advance(2)
+
+        def outcomes(inj):
+            out = []
+            for name in ("x1", "x2", "x3", "x4", "x5", "x6"):
+                try:
+                    inj.wire_request("POST", "pods", f"/api/v1/{name}")
+                    out.append("ok")
+                except ChaosResetError:
+                    out.append("rst")
+            return out
+        oa, ob = outcomes(a), outcomes(b)
+        assert oa == ob
+        assert "rst" in oa and "ok" in oa
+        assert a.events == b.events  # mutating wire faults are logged
+
+    def test_reset_attempts_retry_independently(self):
+        inj = FaultInjector(seed=6, reset_rate=0.5)
+        inj.advance(0)
+        results = []
+        for _ in range(8):  # same signature, rising attempt counter
+            try:
+                inj.wire_request("POST", "pods", "/api/v1/p")
+                results.append(True)
+            except ChaosResetError:
+                results.append(False)
+        assert True in results and False in results
+
+    def test_read_path_faults_stay_out_of_event_log(self):
+        """GET/WATCH faults fire on informer threads at nondeterministic
+        times — they must never enter the step-ordered log."""
+        inj = FaultInjector(seed=1, reset_rate=1.0)
+        inj.advance(0)
+        with pytest.raises(ChaosResetError):
+            inj.wire_request("GET", "pods", "/api/v1/pods")
+        assert inj.events == []
+        with pytest.raises(ChaosResetError):
+            inj.wire_request("POST", "pods", "/api/v1/pods")
+        assert len(inj.events) == 1  # only the mutating one
+
+    def test_watch_plans_pure_function_of_seed(self):
+        a = FaultInjector(seed=7, watch_drop_rate=0.5)
+        b = FaultInjector(seed=7, watch_drop_rate=0.5)
+        c = FaultInjector(seed=8, watch_drop_rate=0.5)
+        for inj in (a, b, c):
+            for _ in range(20):
+                inj.watch_plan("pods")
+        assert a.wire_watch_plans == b.wire_watch_plans
+        assert a.wire_watch_plans != c.wire_watch_plans
+        plans = a.wire_watch_plans["pods"]
+        assert any(p is not None for p in plans)  # some streams drop
+        assert any(p is None for p in plans)      # some live
+
+
+class TestComponentRestarts:
+    """Crash/restart recovery: a restarted component rebuilds its state
+    from informers and the run converges with invariants green."""
+
+    def test_scheduler_restart_mid_run_recovers(self, tmp_path):
+        h = ChaosHarness(seed=3, nodes=6, error_rate=0.0,
+                         wal_path=str(tmp_path / "s.wal"))
+        try:
+            h.start()
+            # workload in flight, then crash-replace the scheduler
+            h._create_gang(3, 500)
+            h._tick()
+            old_cache = h.scheduler.cache
+            h.restart_scheduler()
+            assert h.scheduler.cache is not old_cache
+            # the new cache was rebuilt from informers: it already knows
+            # every node
+            assert h.scheduler.cache.node_count() == 6
+            h._create_gang(2, 250)
+            for _ in range(6):
+                h._tick()
+            checker = InvariantChecker(h.admin, scheduler=h.scheduler,
+                                       wal_path=h.wal_path)
+            assert checker.check() == []
+            pods = h.admin.pods().list(namespace=None)
+            assert pods and all(p.spec.node_name for p in pods)
+        finally:
+            h.close()
+
+    def test_store_restart_replays_wal_and_informers_recover(
+            self, tmp_path):
+        h = ChaosHarness(seed=3, nodes=4, error_rate=0.0,
+                         wal_path=str(tmp_path / "w.wal"))
+        try:
+            h.start()
+            h._create_gang(2, 250)
+            for _ in range(3):
+                h._tick()
+            before = h.admin.store.contents()
+            assert before
+            h.restart_store()
+            # WAL replay reconstructed the exact store
+            assert h.admin.store.contents() == before
+            # informers survived the severed streams and keep working
+            h._create_pod("after-restart", 100)
+            for _ in range(3):
+                h._tick()
+            assert h.admin.pods().get("after-restart").spec.node_name
+            checker = InvariantChecker(h.admin, scheduler=h.scheduler,
+                                       wal_path=h.wal_path)
+            assert checker.check() == []
+        finally:
+            h.close()
+
+    def test_controller_restart_still_converges(self, tmp_path):
+        h = ChaosHarness(seed=3, nodes=4, error_rate=0.0,
+                         wal_path=str(tmp_path / "c.wal"))
+        try:
+            h.start()
+            h._create_gang(2, 250)
+            h._tick()
+            h.restart_controller_manager()
+            for _ in range(4):
+                h._tick()
+            checker = InvariantChecker(h.admin, scheduler=h.scheduler,
+                                       wal_path=h.wal_path)
+            assert checker.check() == []
+            for pg in h.admin.pod_groups().list(namespace=None):
+                assert pg.status.phase == "Running"
+        finally:
+            h.close()
+
+
+class TestWireChaosRuns:
+    """ACCEPTANCE: chaos over the REAL HTTP transport — resets, latency,
+    watch-stream drops, and component restarts mid-run."""
+
+    _FAULTS = dict(error_rate=0.05, reset_rate=0.05, latency_rate=0.08,
+                   latency_max=0.003, watch_drop_rate=0.15)
+
+    def _run(self, tmp_path, tag, seed=5, n_events=14, **kw):
+        h = ChaosHarness(seed=seed, nodes=6, http=True, with_restarts=True,
+                         wal_path=str(tmp_path / f"{tag}.wal"), **kw)
+        try:
+            return h.run(n_events=n_events, quiesce_steps=10)
+        finally:
+            h.close()
+
+    def test_wire_smoke_identical_logs_and_state_parity(self, tmp_path):
+        """Two faulted wire runs produce identical event logs; both end
+        invariants-green with the SAME semantic store state as a
+        fault-free run of the same schedule (restarts skipped, no
+        injected faults) — the wire faults and crashes were fully
+        absorbed."""
+        r1 = self._run(tmp_path, "a", **self._FAULTS)
+        r2 = self._run(tmp_path, "b", **self._FAULTS)
+        r0 = self._run(tmp_path, "c", error_rate=0.0,
+                       enable_restarts=False)
+        assert r1.ok and r2.ok and r0.ok, \
+            (r1.violations, r2.violations, r0.violations)
+        assert r1.events == r2.events
+        assert r1.store_state == r2.store_state
+        assert r1.store_state == r0.store_state
+        assert r1.pods_bound > 0
+
+    @pytest.mark.slow
+    def test_wire_soak_500_events(self, tmp_path):
+        """The full wire-chaos soak: 500 events of workload churn, node
+        kills, API errors, connection resets, latency, watch drops, and
+        scheduler/controller/store restarts — invariants green and the
+        run reproducible from its seed."""
+        r = self._run(tmp_path, "soak", seed=42, n_events=500,
+                      **self._FAULTS)
+        assert r.ok, r.violations
+        assert r.gangs_created > 20
+        assert r.scheduler_restarts + r.controller_restarts \
+            + r.store_restarts > 0
+        # wire faults actually fired on the mutating path
+        assert any(ev[1] in ("wire_reset", "wire_latency")
+                   for ev in r.events)
+
+
+class TestPodGroupSnapshots:
+    """Satellite: resubmission spec snapshots — members lost before the
+    rebuild are recreated from the templates recorded at admission."""
+
+    def test_lost_member_recreated_from_snapshot(self):
+        from kubernetes_tpu.controllers.podgroup import PodGroupController
+        client = Client()
+        informers = SharedInformerFactory(client)
+        ctl = PodGroupController(client, informers, clock=FakeClock())
+        client.pod_groups("default").create(make_group("g1", 2))
+        client.pods().create(make_pod("w0", group="g1", node="n1"))
+        client.pods().create(make_pod("w1", group="g1", node="n2"))
+        informers.start()
+        assert informers.wait_for_cache_sync()
+        try:
+            ctl.sync("default/g1")   # snapshots both members' templates
+            time.sleep(0.1)
+            pg = client.pod_groups("default").get("g1")
+            assert sorted(pg.status.member_templates) == ["w0", "w1"]
+            # w1 vanishes entirely (deleted during an outage) and w0
+            # fails: the survivors can never reach minMember=2
+            client.pods().delete("w1")
+            def fail(cur):
+                cur.status.phase = "Failed"
+                return cur
+            client.pods().patch("w0", fail)
+            time.sleep(0.1)
+            ctl.sync("default/g1")   # records Failed
+            time.sleep(0.1)
+            assert client.pod_groups("default").get(
+                "g1").status.phase == PHASE_FAILED
+            ctl.sync("default/g1")   # resubmits — w1 ONLY exists as a
+            time.sleep(0.1)          # snapshot now
+            pods = {p.metadata.name: p for p in client.pods().list()}
+            assert sorted(pods) == ["w0", "w1"], \
+                "lost member must be rebuilt from its spec snapshot"
+            for pod in pods.values():
+                assert pod.spec.node_name == ""
+                assert pod.status.phase in ("", "Pending")
+                assert pod.metadata.labels[LABEL_POD_GROUP] == "g1"
+            assert client.pod_groups("default").get(
+                "g1").status.resubmissions == 1
+        finally:
+            informers.stop()
+
+    def test_snapshots_survive_resubmission(self):
+        """The templates stay on the group after a rebuild, so a SECOND
+        loss is recoverable too."""
+        client = Client()
+        client.pod_groups("default").create(make_group("g1", 2))
+        client.pods().create(make_pod("w0", group="g1", node="n1",
+                                      phase="Failed"))
+        client.pods().create(make_pod("w1", group="g1", node="n1",
+                                      phase="Failed"))
+        from kubernetes_tpu.controllers.podgroup import PodGroupController
+        informers = SharedInformerFactory(client)
+        ctl = PodGroupController(client, informers, clock=FakeClock())
+        informers.start()
+        assert informers.wait_for_cache_sync()
+        try:
+            for _ in range(3):
+                ctl.sync("default/g1")
+                time.sleep(0.1)
+            pg = client.pod_groups("default").get("g1")
+            assert pg.status.resubmissions == 1
+            assert sorted(pg.status.member_templates) == ["w0", "w1"]
+        finally:
+            informers.stop()
+
+
+class TestLeaderElectionFailover:
+    def test_standby_takes_over_after_crash_under_chaos(self):
+        """Leader election rides the same flaky API surface: the leader
+        crashes (no graceful release), the standby's retries — some of
+        them chaos-faulted — still acquire once the lease expires."""
+        from kubernetes_tpu.state.leaderelection import LeaderElector
+        inj = FaultInjector(seed=2, error_rate=0.25)
+        inj.advance(0)
+        client = ChaosClient(inj)
+        kw = dict(lease_duration=0.6, renew_deadline=0.4,
+                  retry_period=0.05)
+        became = []
+        a = LeaderElector(client, "cm", "node-a",
+                          on_started_leading=lambda: became.append("a"),
+                          **kw)
+        b = LeaderElector(client, "cm", "node-b",
+                          on_started_leading=lambda: became.append("b"),
+                          **kw)
+        a.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.01)
+        assert a.is_leader
+        b.start()
+        time.sleep(0.2)
+        assert not b.is_leader  # lease held and fresh
+        # CRASH a: stop its loop without releasing the lease — the
+        # standby must wait out the lease duration, then take over
+        a._stop.set()
+        a._thread.join(timeout=2)
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.is_leader:
+            time.sleep(0.01)
+        assert b.is_leader
+        assert became[0] == "a" and "b" in became
+        lease = client.leases("kube-system").get("cm")
+        assert lease.spec.holder_identity == "node-b"
+        assert lease.spec.lease_transitions >= 1
+        b.stop()
 
 
 class TestStoreKillMidCommit:
