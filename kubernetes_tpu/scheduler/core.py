@@ -111,6 +111,11 @@ class PendingBatch:
     #: this batch routed through the all-or-nothing kernel; finish uses
     #: them to demote whole gangs when repair invalidates any member
     gang_units: Optional[list] = None
+    #: True when the in-scan topology tables (or their provable inertness)
+    #: cover EVERY in-batch (anti-)affinity interaction AND the batch
+    #: carries no ports/volumes/extenders: with no stale winners, the
+    #: repair pass has nothing left to validate and is skipped outright
+    inscan_cover: bool = False
 
 
 class _RepairReassigner:
@@ -306,6 +311,42 @@ class BatchScheduler:
         #: carrying PodGroup members route through the all-or-nothing
         #: kernel (kernels/gang.py) instead of schedule_batch
         self.gang = None
+        import os as _os
+        #: soft-score sub-batch size, resolved ONCE at construction (like
+        #: KTPU_ALIGN_SPLIT) — re-reading the environment per batch was a
+        #: silent per-drain cost and an unannounced behavior knob
+        self.soft_score_chunk = int(_os.environ.get(
+            "SCHED_SOFT_SCORE_CHUNK", str(self.SOFT_SCORE_CHUNK)))
+        #: KTPU_TOPO_TABLE_CACHE=0 disables the epoch-keyed term-table and
+        #: profile caches (the tier-1 cached==uncached smoke's control)
+        self.topo_table_cache = _os.environ.get(
+            "KTPU_TOPO_TABLE_CACHE", "1") != "0"
+        #: residual-sig -> (profile_epoch, AffinityProfile): template
+        #: profile resolution survives across batches until a profile-
+        #: relevant topology change (new term, zero-crossing count)
+        self._profile_cache: Dict[Tuple, Tuple[int, AffinityProfile]] = {}
+        #: scheduler.SchedulerMetrics, installed by the shell (None in
+        #: bare-algorithm tests); used for in-scan fallback counters
+        self.sched_metrics = None
+        self._fallback_streak: Dict[str, int] = {}
+        #: (pod-list, plan) from the most recent _soft_plan: the drain's
+        #: soft_batch_limit and the launch's _assign_soft_terms see the
+        #: SAME list object when the batch wasn't truncated, so the O(P)
+        #: channel-planning pass runs once per batch, not twice
+        self._soft_plan_memo: Optional[Tuple[List[Pod], Optional[dict]]] = \
+            None
+        #: per-drain phase accounting, surfaced by bench.py's affinity
+        #: breakdown: host term-prep wall vs device scan wait vs
+        #: repair/reassign wall, plus profile-cache effectiveness
+        #: (term-table cache counters live on the TopologyIndex)
+        self.phase_stats = {"term_prep_s": 0.0, "scan_wait_s": 0.0,
+                            "repair_s": 0.0, "profile_builds": 0,
+                            "profile_hits": 0}
+
+    def reset_phase_stats(self) -> None:
+        for k in self.phase_stats:
+            self.phase_stats[k] = 0 if isinstance(
+                self.phase_stats[k], int) else 0.0
 
     def refresh(self) -> None:
         dirty = self.cache.update_snapshot(self.snapshot)
@@ -374,7 +415,12 @@ class BatchScheduler:
         TEMPLATE per batch, not once per pod (the affinity analog of the
         mask-row dedupe in PodBatchTensors). Structured canon, not repr() —
         a deep dataclass repr per pod per batch was the residual path's
-        largest host cost."""
+        largest host cost. Cached on the pod object (like tensorize's
+        _tsig): a pod retried across batches re-canonicalizes nothing —
+        informer updates replace the object, so staleness can't stick."""
+        sig = pod.__dict__.get("_rsig")
+        if sig is not None:
+            return sig
         aff = pod.spec.affinity
         aff_canon: Tuple = ()
         if aff is not None:
@@ -398,12 +444,22 @@ class BatchScheduler:
              repr(v.gce_persistent_disk), repr(v.aws_elastic_block_store),
              repr(v.azure_disk), repr(v.rbd), repr(v.iscsi))
             for v in pod.spec.volumes))
-        return (pod.metadata.namespace,
-                tuple(sorted(pod.metadata.labels.items())),
-                aff_canon, vols)
+        sig = (pod.metadata.namespace,
+               tuple(sorted(pod.metadata.labels.items())),
+               aff_canon, vols)
+        pod.__dict__["_rsig"] = sig
+        return sig
 
     def _residual_mask(self, pods: List[Pod]
-                       ) -> Tuple[Optional[np.ndarray], Dict[int, AffinityProfile]]:
+                       ) -> Tuple[Optional[np.ndarray],
+                                  Dict[int, AffinityProfile],
+                                  Optional[np.ndarray]]:
+        """(extra mask [P, N] | None, profiles, extra group ids [P] | None).
+        Group ids name each pod's extra-mask ROW by template (two pods in
+        one group provably share the row), so tensorization can dedupe
+        mask rows by id instead of hashing 8K of row bytes per pod; None
+        when filter extenders are in play (their masks are pod-addressed,
+        no sharing is provable)."""
         profiles: Dict[int, AffinityProfile] = {}
         extra: Optional[np.ndarray] = None
         filter_extenders = [e for e in self.extenders
@@ -425,6 +481,7 @@ class BatchScheduler:
                 extra = np.ones((len(pods), self.mirror.t.capacity), bool)
             if not self._passes_basic_checks(pod):
                 extra[i, :] = False
+                pod_sig[i] = -2  # group id for the shared all-False row
                 continue
             if internal:
                 sig = self._residual_sig(pod)
@@ -438,11 +495,17 @@ class BatchScheduler:
                     filter_extenders, pod, live_nodes, extra, i, enc_nodes):
                 continue
         if not sig_reps:
-            return extra, profiles
+            return extra, profiles, \
+                (None if filter_extenders else pod_sig)
         # pass 2: one vectorized affinity evaluation for ALL templates
         # (topology.required_masks — numpy or device matmuls by size), plus
-        # the per-node volume loop only for templates that carry volumes
-        sig_profiles = [self.topology.required_profile(p) for p in sig_reps]
+        # the per-node volume loop only for templates that carry volumes.
+        # Profile resolution is memoized ACROSS batches by template
+        # signature, invalidated by the topology index's profile_epoch
+        # (new terms, zero-crossing match/anti-carry counts — the only
+        # state a resolved profile depends on)
+        sig_profiles = [self._cached_profile(sig, p)
+                        for sig, p in zip(sig_index, sig_reps)]
         constrained = [u for u, pr in enumerate(sig_profiles)
                        if pr.constrained]
         aff_rows: Dict[int, np.ndarray] = {}
@@ -452,6 +515,15 @@ class BatchScheduler:
             for j, u in enumerate(constrained):
                 aff_rows[u] = rows[j]
         vol_rows = [self._volume_row(rep) for rep in sig_reps]
+        # templates whose residual row is provably all-True collapse back
+        # to "no extra row" (id -1): one .all() per TEMPLATE keeps the
+        # dedupe-by-id win while label-distinct but unconstrained
+        # templates share the no-extra mask row instead of each minting
+        # an identical all-True [N] row in the unique-mask bucket
+        inert_u = [
+            (aff_rows.get(u) is None or bool(aff_rows[u].all()))
+            and (vol_rows[u] is None or bool(vol_rows[u].all()))
+            for u in range(len(sig_reps))]
         for i in range(len(pods)):
             u = int(pod_sig[i])
             if u < 0:
@@ -463,7 +535,29 @@ class BatchScheduler:
                 extra[i] &= vol_rows[u]
             if sig_profiles[u].constrained:
                 profiles[i] = sig_profiles[u]
-        return extra, profiles
+            if inert_u[u]:
+                pod_sig[i] = -1
+        return extra, profiles, (None if filter_extenders else pod_sig)
+
+    def _cached_profile(self, sig: Tuple, pod: Pod) -> AffinityProfile:
+        """required_profile memoized by template signature across batches
+        (a controller's 16k-pod burst resolves its constraint plan once per
+        topology profile-epoch, not once per batch). Resolution itself may
+        register new match terms — the epoch is read AFTER computing so
+        the cached entry reflects the post-registration state."""
+        if not self.topo_table_cache:
+            self.phase_stats["profile_builds"] += 1
+            return self.topology.required_profile(pod)
+        hit = self._profile_cache.get(sig)
+        if hit is not None and hit[0] == self.topology.profile_epoch:
+            self.phase_stats["profile_hits"] += 1
+            return hit[1]
+        prof = self.topology.required_profile(pod)
+        if len(self._profile_cache) > 4096:
+            self._profile_cache.clear()
+        self._profile_cache[sig] = (self.topology.profile_epoch, prof)
+        self.phase_stats["profile_builds"] += 1
+        return prof
 
     def _volume_row(self, pod: Pod) -> Optional[np.ndarray]:
         """One template's [capacity] volume-predicate mask (NoDiskConflict,
@@ -562,11 +656,12 @@ class BatchScheduler:
 
     def topo_scan_likely(self, pods: List[Pod]) -> bool:
         """True when this batch carries required ANTI-affinity — the
-        in-scan counter workload whose ungrouped (GT=1) power-of-two
-        padding is worth splitting away (drain_pipelined's alignment
-        split, measured +30%). Required AFFINITY batches measure FASTER
-        unsplit (their tight feasible sets retry across launches), so
-        they keep the padded single scan."""
+        in-scan counter workload whose per-step [K, N] gathers still make
+        power-of-two padding worth splitting away (drain_pipelined's
+        alignment split: +24% at r06, down from +33% pre-class-scan).
+        Required AFFINITY batches measure FASTER unsplit (their tight
+        feasible sets retry across launches), so they keep the padded
+        single scan."""
         if self.topology.has_required_anti_carriers():
             return True
         return any(
@@ -580,24 +675,36 @@ class BatchScheduler:
         """How many of these pods may schedule in ONE kernel batch without
         visible soft-score drift. Preferred inter-pod (anti-)affinity
         scores change with every in-batch winner; the serial reference
-        re-scores per pod via assume-between-iterations. Pods carrying
-        preferred terms schedule in SOFT_SCORE_CHUNK sub-batches so the
-        credits refresh between chunks; everything else (uniform, required
-        affinity, spread — the latter in-scan) keeps the full batch."""
-        import os as _os
-        chunk = int(_os.environ.get("SCHED_SOFT_SCORE_CHUNK",
-                                    str(self.SOFT_SCORE_CHUNK)))
+        re-scores per pod via assume-between-iterations. When the batch's
+        soft term union fits the in-scan credit tables
+        (_assign_soft_terms), the kernel re-scores per pod itself and the
+        whole batch launches at once; only an overflowing union still
+        schedules in SOFT_SCORE_CHUNK sub-batches. Spread beyond the
+        in-scan group cap chunks as before."""
+        chunk = self.soft_score_chunk
         if len(pods) <= chunk or chunk <= 0:
             return len(pods)
         if self.scorer.weights.get("InterPodAffinityPriority"):
-            for pod in pods:
-                aff = pod.spec.affinity
-                if aff is None:
-                    continue
-                if (aff.pod_affinity is not None and
-                        aff.pod_affinity.preferred_during_scheduling_ignored_during_execution) or \
-                   (aff.pod_anti_affinity is not None and
-                        aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution):
+            has_pref = any(
+                p.spec.affinity is not None and (
+                    (p.spec.affinity.pod_affinity is not None and
+                     p.spec.affinity.pod_affinity
+                     .preferred_during_scheduling_ignored_during_execution)
+                    or (p.spec.affinity.pod_anti_affinity is not None and
+                        p.spec.affinity.pod_anti_affinity
+                        .preferred_during_scheduling_ignored_during_execution))
+                for p in pods)
+            if has_pref:
+                if self.gang is not None:
+                    from .gang import pod_group_key
+                    if any(pod_group_key(p) is not None for p in pods):
+                        # gang batches route the all-or-nothing kernel,
+                        # which runs frozen (batch-start) soft rows — keep
+                        # the pre-table chunking so credits refresh
+                        # between sub-batches, and keep it visible
+                        self._count_inscan_fallback("soft_gang")
+                        return chunk
+                if self._soft_plan_cached(pods) is None:
                     return chunk
         # spread carriers beyond the in-scan group cap would otherwise run
         # the whole batch on frozen counts — chunk so they refresh
@@ -682,18 +789,54 @@ class BatchScheduler:
     #: in-scan topology term cap per batch; bigger batches fall back to
     #: the repair overlay + reassignment path entirely
     TOPO_TERM_CAP = 512
+    #: per-pod in-scan term fan-out cap (the kernel's K axis)
+    TOPO_KMAX = 16
+
+    def _count_inscan_fallback(self, reason: str) -> None:
+        """No silent caps: every in-scan fallback (kmax/term-cap overflow,
+        soft term-union overflow) is counted by reason and logged once per
+        streak."""
+        if self.sched_metrics is not None:
+            self.sched_metrics.topo_inscan_fallbacks.inc(reason=reason)
+        streak = self._fallback_streak.get(reason, 0)
+        if streak == 0:
+            import logging
+            logging.getLogger(__name__).warning(
+                "in-scan topology fallback (%s): batch takes the repair/"
+                "chunked path; further occurrences counted in "
+                "scheduler_topo_inscan_fallbacks_total", reason)
+        self._fallback_streak[reason] = streak + 1
+
+    def _end_inscan_streak(self, *reasons: str) -> None:
+        """A batch made it through the in-scan caps: close these reasons'
+        fallback streaks so the NEXT overflow logs again (the per-streak
+        contract; without this the warning fires once per process)."""
+        for reason in reasons:
+            self._fallback_streak[reason] = 0
 
     def _assign_topology_terms(self, pods: List[Pod],
                                batch: PodBatchTensors,
-                               profiles: Dict[int, AffinityProfile]) -> bool:
+                               profiles: Dict[int, AffinityProfile]) -> str:
         """In-scan required (anti-)affinity tables: the kernel scan tracks
-        per-(term, domain) winner-match counts so each pod's feasibility
-        respects EARLIER SAME-BATCH winners — the serial reference's
+        per-(term, domain) winner-match AND winner-carry counts so each
+        pod's feasibility respects EARLIER SAME-BATCH winners in both
+        anti-affinity directions — the serial reference's
         assume-between-iterations visibility (scheduler.go:514), which the
         frozen batch-start mask lacks. The repair overlay stays as the
-        validator for ports/volumes/chained-predecessor winners."""
+        validator for ports/volumes/chained-predecessor winners.
+
+        Returns coverage: "installed" (tables active), "inert" (provably
+        no in-batch (anti-)affinity interaction exists to validate), or
+        "fallback" (caps overflowed; only the repair overlay validates).
+
+        Terms NO batch member matches are hoisted out entirely: their
+        counters could never move in-scan (only winner matches bump them),
+        so the pre-batch static mask already covers them — the per-pod K
+        axis then chains only genuinely carried terms through the scan.
+        The [T, N] dom table comes from the topology index's epoch-keyed
+        cache (one gather per node-topology change, not per batch)."""
         if not profiles:
-            return False
+            return "inert"
         idx = self.topology
         anti_tids: List[int] = []
         aff_tids: List[int] = []
@@ -707,22 +850,36 @@ class BatchScheduler:
                 if waived and tid not in seen:
                     seen.add(tid)
                     aff_tids.append(tid)
-        terms = anti_tids + aff_tids
-        if not terms or len(terms) > self.TOPO_TERM_CAP:
-            return False
-        N = self.mirror.t.capacity
-        T = len(terms)
+        if not anti_tids and not aff_tids:
+            return "inert"
+        # hoist: restrict the term union to terms some batch member
+        # MATCHES — an unmatched term's in-scan counter is provably static
+        cand = seen
+        matched: set = set()
+        match_sets: Dict[Tuple, frozenset] = {}
+        for pod in pods:
+            mkey = (pod.metadata.namespace,
+                    tuple(sorted(pod.metadata.labels.items())))
+            ms = match_sets.get(mkey)
+            if ms is None:
+                ms = idx.match_set(pod)
+                match_sets[mkey] = ms
+            matched |= ms & cand
+            if len(matched) == len(cand):
+                break
+        # sorted: the table's cache key is the term-id tuple, and batches
+        # popping the same templates in a different pod order must land on
+        # the same cached [T, N] table (positions are per-batch anyway)
+        terms = sorted(tid for tid in set(anti_tids + aff_tids)
+                       if tid in matched)
+        if not terms:
+            return "inert"  # every candidate term is in-batch inert
+        if len(terms) > self.TOPO_TERM_CAP:
+            self._count_inscan_fallback("term_cap")
+            return "fallback"
         P = len(pods)
-        dom = np.full((T, N), -1, np.int32)
-        n_domains = 1
-        for j, tid in enumerate(terms):
-            term = idx._by_id[tid]
-            # _node_dom_vec handles missing/short entries (capacity-sized,
-            # -1 for label-absent rows)
-            nd = idx._node_dom_vec(term.tk)
-            dom[j] = nd[:N]
-            if len(nd):
-                n_domains = max(n_domains, int(nd.max()) + 1)
+        dom, n_domains = idx.term_table(tuple(terms),
+                                        use_cache=self.topo_table_cache)
         tpos = {tid: j for j, tid in enumerate(terms)}
         # per-pod [K] term-index lists (-1 padded): the kernel's cost per
         # scan step is O(K*N), independent of the batch's term union
@@ -736,21 +893,62 @@ class BatchScheduler:
             a: List[int] = []
             f: List[int] = []
             if prof is not None:
-                a = [tpos[tid] for tid in prof.req_anti]
-                f = [tpos[tid] for tid, waived in prof.req_aff if waived]
+                a = [tpos[tid] for tid in prof.req_anti if tid in tpos]
+                f = [tpos[tid] for tid, waived in prof.req_aff
+                     if waived and tid in tpos]
             mkey = (pod.metadata.namespace,
                     tuple(sorted(pod.metadata.labels.items())))
             m = match_memo.get(mkey)
             if m is None:
-                m = [tpos[tid] for tid in idx.match_set(pod)
-                     if tid in tpos]
+                ms = match_sets.get(mkey)
+                if ms is None:
+                    # the hoist pass short-circuits once every candidate
+                    # term is matched — later templates fill in here
+                    ms = idx.match_set(pod)
+                    match_sets[mkey] = ms
+                m = [tpos[tid] for tid in ms if tid in tpos]
                 match_memo[mkey] = m
             kmax = max(kmax, len(a), len(f), len(m))
             anti_l.append(a)
             aff_l.append(f)
             match_l.append(m)
-        if kmax > 16:
-            return False  # degenerate term fan-out: repair path handles it
+        if kmax > self.TOPO_KMAX:
+            self._count_inscan_fallback("kmax")
+            return "fallback"  # degenerate fan-out: repair path handles it
+        # direction 2 (winner CARRIES anti term t, later pod MATCHES it):
+        # a pod needs an in-scan read on t only when the block isn't
+        # already implied by its own direction-1 read — i.e. unless the
+        # pod itself carries t AND every batch carrier of t also matches
+        # it (then {carriers} ⊆ {matchers} makes direction 1 strictly
+        # stronger). The common self-anti shape (each pod carries AND
+        # matches its own color) needs NO direction-2 state at all, so
+        # the extra [T, D] carry table ships only when some pure matcher
+        # exists.
+        carrier_pos: set = set()
+        carrier_ok: Dict[int, bool] = {}
+        for i in range(len(pods)):
+            mset = set(match_l[i])
+            for t in anti_l[i]:
+                carrier_pos.add(t)
+                if t not in mset:
+                    carrier_ok[t] = False
+        cmatch_l: List[List[int]] = []
+        dir2_read: set = set()
+        for i in range(len(pods)):
+            aset = set(anti_l[i])
+            cm = [t for t in match_l[i]
+                  if t in carrier_pos
+                  and not (t in aset and carrier_ok.get(t, True))]
+            dir2_read.update(cm)
+            cmatch_l.append(cm)
+        canti_l = [[t for t in anti_l[i] if t in dir2_read]
+                   for i in range(len(pods))] if dir2_read else None
+        if dir2_read:
+            kmax = max(kmax, max(len(l) for l in cmatch_l),
+                       max(len(l) for l in canti_l))
+            if kmax > self.TOPO_KMAX:
+                self._count_inscan_fallback("kmax")
+                return "fallback"
 
         def to_arr(lists: List[List[int]]) -> np.ndarray:
             K = max(1, kmax)
@@ -758,8 +956,194 @@ class BatchScheduler:
             for i, l in enumerate(lists):
                 out[i, :len(l)] = l
             return out
-        batch.set_topology_terms(dom, n_domains, to_arr(anti_l),
-                                 to_arr(aff_l), to_arr(match_l))
+        batch.set_topology_terms(
+            dom, n_domains, to_arr(anti_l), to_arr(aff_l), to_arr(match_l),
+            cmatch_tids=to_arr(cmatch_l) if dir2_read else None,
+            canti_tids=to_arr(canti_l) if dir2_read else None)
+        self._end_inscan_streak("term_cap", "kmax")
+        return "installed"
+
+    #: in-scan soft (preferred inter-pod affinity) channel caps: a batch
+    #: whose credit-channel union or per-pod fan-out overflows these falls
+    #: back to SOFT_SCORE_CHUNK sub-batching (counted, never silent)
+    SOFT_TERM_CAP = 64
+    SOFT_KMAX = 16
+
+    def _soft_plan_cached(self, pods: List[Pod]):
+        """_soft_plan, computed once per pod-list object. Keyed by list
+        IDENTITY: a truncated batch (drain slices pods[:limit]) is a new
+        list and recomputes; the plan itself only depends on batch specs
+        plus match-set membership of tids the first call interned, both
+        stable between pop and launch on the drain thread."""
+        memo = self._soft_plan_memo
+        if memo is not None and memo[0] is pods:
+            return memo[1]
+        plan = self._soft_plan(pods)
+        self._soft_plan_memo = (pods, plan)
+        return plan
+
+    def _soft_plan(self, pods: List[Pod]):
+        """Channel plan for in-scan preferred inter-pod (anti-)affinity
+        credits, or None when the batch can't (or needn't) run them
+        in-scan. Channels are per-(kind, term) accumulators a winner
+        writes and later pods read at their nodes' domains:
+            m:  winners MATCHING the term (readers: the term's owners, ±w)
+            ca: winners carrying the term as required affinity
+                (readers: matching pods, × hard_pod_affinity_weight)
+            cp/cn: winners carrying it as preferred (anti-)affinity,
+                weight-summed (readers: matching pods, × ±1)
+        — exactly the topology index's count kinds, scoped to one batch."""
+        w = self.scorer.weights.get("InterPodAffinityPriority", 0)
+        if not w:
+            return None
+        idx = self.topology
+        hard_w = float(self.scorer.hard_pod_affinity_weight)
+        channels: Dict[Tuple[str, int], int] = {}
+        chan_list: List[Tuple[str, int]] = []
+
+        def slot(kind: str, tid: int) -> int:
+            k = (kind, tid)
+            s = channels.get(k)
+            if s is None:
+                s = len(chan_list)
+                channels[k] = s
+                chan_list.append(k)
+            return s
+
+        # pass 1: template dedupe; own preferred read terms + carried
+        # write channels (a winner's contribution to later pods)
+        tmpl_key: Dict[Tuple, int] = {}
+        tmpl_pods: List[Pod] = []
+        tmpl_pref: List[List[Tuple[int, float]]] = []
+        tmpl_carry: List[List[Tuple[str, int, float]]] = []
+        tmpl_of = np.zeros((len(pods),), np.int32)
+        for i, pod in enumerate(pods):
+            key = self._residual_sig(pod)
+            t = tmpl_key.get(key)
+            if t is None:
+                t = len(tmpl_pods)
+                tmpl_key[key] = t
+                tmpl_pods.append(pod)
+                pref: List[Tuple[int, float]] = []
+                carry: List[Tuple[str, int, float]] = []
+                aff = pod.spec.affinity
+                pa = aff.pod_affinity if aff else None
+                paa = aff.pod_anti_affinity if aff else None
+                for sign, kind, wterms in (
+                        (1.0, "cp",
+                         pa.preferred_during_scheduling_ignored_during_execution
+                         if pa else ()),
+                        (-1.0, "cn",
+                         paa.preferred_during_scheduling_ignored_during_execution
+                         if paa else ())):
+                    for wt in wterms or ():
+                        if not wt.weight:
+                            continue
+                        term = idx.ensure_match(
+                            wt.pod_affinity_term.topology_key,
+                            idx._resolved_ns(wt.pod_affinity_term, pod),
+                            wt.pod_affinity_term.label_selector)
+                        slot("m", term.tid)
+                        pref.append((term.tid, sign * float(wt.weight)))
+                        carry.append((kind, term.tid, float(wt.weight)))
+                if hard_w and pa is not None:
+                    for rt in pa.required_during_scheduling_ignored_during_execution or ():
+                        term = idx._intern(
+                            rt.topology_key, idx._resolved_ns(rt, pod),
+                            rt.label_selector)
+                        carry.append(("ca", term.tid, 1.0))
+                for kind, tid, _cw in carry:
+                    slot(kind, tid)
+                tmpl_pref.append(pref)
+                tmpl_carry.append(carry)
+            tmpl_of[i] = t
+        if not any(tmpl_pref):
+            # no batch member carries preferred terms: only the frozen
+            # symmetric-credit drift remains, which the static rows cover
+            # (the same contract as the old chunk trigger) — required-only
+            # batches keep the incremental class-scan fast path
+            return None
+        if not chan_list:
+            return None  # no in-batch credit can move: static rows suffice
+        if len(chan_list) > self.SOFT_TERM_CAP:
+            self._count_inscan_fallback("soft_terms")
+            return None
+        # canonical channel order: the dom table's cache key is the slot
+        # term tuple, so pod-order-insensitive slot numbering keeps
+        # repeat batches on the cached table
+        chan_list = sorted(chan_list)
+        channels = {k: s for s, k in enumerate(chan_list)}
+        # pass 2: per-template read/write slot lists against the full
+        # channel union
+        read_kinds = {"ca": hard_w, "cp": 1.0, "cn": -1.0}
+        tmpl_reads: List[List[Tuple[int, float]]] = []
+        tmpl_writes: List[List[Tuple[int, float]]] = []
+        kmax = 0
+        for t, rep in enumerate(tmpl_pods):
+            mset = idx.match_set(rep)
+            reads = [(channels[("m", tid)], pw)
+                     for tid, pw in tmpl_pref[t]]
+            writes = [(channels[(kind, tid)], cw)
+                      for kind, tid, cw in tmpl_carry[t]]
+            for kind, tid in chan_list:
+                if tid not in mset:
+                    continue
+                if kind == "m":
+                    writes.append((channels[(kind, tid)], 1.0))
+                else:
+                    reads.append((channels[(kind, tid)],
+                                  read_kinds[kind]))
+            kmax = max(kmax, len(reads), len(writes))
+            tmpl_reads.append(reads)
+            tmpl_writes.append(writes)
+        if kmax > self.SOFT_KMAX:
+            self._count_inscan_fallback("soft_kmax")
+            return None
+        self._end_inscan_streak("soft_terms", "soft_kmax", "soft_gang")
+        return {"chan_list": chan_list, "tmpl_of": tmpl_of,
+                "tmpl_pods": tmpl_pods, "reads": tmpl_reads,
+                "writes": tmpl_writes, "kmax": max(1, kmax),
+                "weight": float(w), "hard_w": hard_w}
+
+    def _assign_soft_terms(self, pods: List[Pod],
+                           batch: PodBatchTensors) -> bool:
+        """Install in-scan preferred inter-pod (anti-)affinity credit
+        tables: the kernel then re-scores soft credits per pod from
+        running accumulators (the serial reference's re-score via
+        assume-between-iterations), which lifts the SOFT_SCORE_CHUNK
+        sub-batching for the common small-term-union case."""
+        plan = self._soft_plan_cached(pods)
+        self._soft_plan_memo = None   # batch consumed; drop the list ref
+        if plan is None:
+            return False
+        idx = self.topology
+        dom, n_domains = idx.term_table(
+            tuple(tid for _, tid in plan["chan_list"]),
+            use_cache=self.topo_table_cache)
+        cap = self.mirror.t.capacity
+        base_rows = []
+        for rep in plan["tmpl_pods"]:
+            raw = idx.score_vector(rep, plan["hard_w"])
+            base_rows.append(raw if raw is not None
+                             else np.zeros((cap,), np.float32))
+        base = np.stack(base_rows)
+        n = len(pods)
+        K = plan["kmax"]
+        read_tids = np.full((n, K), -1, np.int32)
+        read_w = np.zeros((n, K), np.float32)
+        write_tids = np.full((n, K), -1, np.int32)
+        write_w = np.zeros((n, K), np.float32)
+        for i in range(n):
+            t = plan["tmpl_of"][i]
+            for j, (s, rw) in enumerate(plan["reads"][t]):
+                read_tids[i, j] = s
+                read_w[i, j] = rw
+            for j, (s, ww) in enumerate(plan["writes"][t]):
+                write_tids[i, j] = s
+                write_w[i, j] = ww
+        batch.set_soft_terms(dom, n_domains, base, plan["tmpl_of"],
+                             read_tids, read_w, write_tids, write_w,
+                             plan["weight"])
         return True
 
     def _make_reassigner(self, batch: Optional[PodBatchTensors],
@@ -1013,7 +1397,9 @@ class BatchScheduler:
                     self.topology.has_score_carriers())
             if chain is not None:
                 return None
-        extra_mask, profiles = self._residual_mask(pods)
+        import time as _time
+        t_prep = _time.perf_counter()
+        extra_mask, profiles, extra_group = self._residual_mask(pods)
         residual_free = extra_mask is None and not any(
             helpers.pod_host_ports(p) or _pod_has_conflict_volumes(p)
             for p in pods)
@@ -1028,21 +1414,26 @@ class BatchScheduler:
             if self.gang is not None else None
         batch = PodBatchTensors(pods, self.mirror, self.terms,
                                 extra_mask=extra_mask,
+                                extra_group=extra_group,
                                 seq_base=self._seq_base)
         self._seq_base += len(pods)
         w = self.scorer.weights
         batch.resource_weights[0] = w.get("LeastRequestedPriority", 1)
         batch.resource_weights[1] = w.get("BalancedResourceAllocation", 1)
-        # gang batches skip the in-scan spread/topology tables — the gang
-        # kernel's trial/commit scan does not carry them; repair (with
-        # whole-gang demotion) validates affinity interactions, matching
-        # the pre-in-scan semantics. Nominated reservations DO ride along
-        # (both kernels take the same phantom overlay — a mixed batch's
-        # singletons must not steal a preemptor's freed space).
+        # gang batches skip the in-scan spread/topology/soft tables — the
+        # gang kernel's trial/commit scan does not carry them; repair
+        # (with whole-gang demotion) validates affinity interactions,
+        # matching the pre-in-scan semantics. Nominated reservations DO
+        # ride along (both kernels take the same phantom overlay — a mixed
+        # batch's singletons must not steal a preemptor's freed space).
         spread_present = False
+        soft_present = False
+        topo_cover = "fallback"
         if gang_units is None:
             spread_present = self._assign_spread_groups(pods, batch)
-            self._assign_topology_terms(pods, batch, profiles)
+            topo_cover = self._assign_topology_terms(pods, batch, profiles)
+            soft_present = self._assign_soft_terms(pods, batch)
+        self.phase_stats["term_prep_s"] += _time.perf_counter() - t_prep
         nom_dev = self._nominated_device()
         if nom_dev is not None:
             # each pod's own nominated row, from the EXACT snapshot the
@@ -1054,11 +1445,12 @@ class BatchScheduler:
                     batch.nom_row[i] = row
         static = self.scorer.static_scores(pods, batch)
         has_prio_ext = any(e.config.prioritize_verb for e in self.extenders)
-        # hysteresis: while static scores (or in-scan spread groups, whose
-        # base counts must fold each batch's winners) are in play, later
-        # launches refuse the chain up front instead of discarding work
+        # hysteresis: while static scores (or in-scan spread groups / soft
+        # credit tables, whose base rows must fold each batch's winners)
+        # are in play, later launches refuse the chain up front instead of
+        # discarding work
         self._static_likely = static is not None or has_prio_ext \
-            or spread_present
+            or spread_present or soft_present
         if has_prio_ext:
             if chaining:
                 return None  # host scores would lag the uncommitted chain
@@ -1067,13 +1459,19 @@ class BatchScheduler:
             if chaining:
                 return None
             batch.set_static_scores(*static)
-        if chaining and spread_present:
-            # spread base counts were computed from the committed state;
-            # a chained launch's usage includes UNCOMMITTED winners the
-            # counts don't — relaunch sequentially after the commit
+        if chaining and (spread_present or soft_present):
+            # spread base counts / soft base rows were computed from the
+            # committed state; a chained launch's usage includes
+            # UNCOMMITTED winners they don't — relaunch sequentially
             return None
         if chaining and not self.mirror.device_ready():
             return None  # tensorize grew the column axis; chain handle stale
+        if gang_units is None and nom_dev is None and not spread_present \
+                and not soft_present:
+            # the incremental class-indexed scan: per-(template, score-row)
+            # masked-score rows in the carry, one column refresh per winner
+            # (kernels/batch.py _schedule_batch_classes)
+            batch.enable_class_scan()
         if chaining:
             node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
         else:
@@ -1093,12 +1491,17 @@ class BatchScheduler:
                             affinity_chainable=affinity_chainable,
                             chained=chaining,
                             usage_epoch=self.mirror.usage_epoch,
-                            gang_units=gang_units)
+                            gang_units=gang_units,
+                            inscan_cover=(affinity_chainable
+                                          and topo_cover != "fallback"))
 
     def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
         """Back half: fetch results, host repair, adopt chained usage."""
+        import time as _time
         from .kernels.batch import unpack_results
+        t0 = _time.perf_counter()
         assign, scores = unpack_results(pending.packed)
+        self.phase_stats["scan_wait_s"] += _time.perf_counter() - t0
         out: List[ScheduleResult] = []
         for i, pod in enumerate(pending.pods):
             row = int(assign[i])
@@ -1112,13 +1515,21 @@ class BatchScheduler:
             for r in out:
                 if r.node_name is None:
                     r.retry = True
-        moved = self._repair_batch(
-            out, pending.profiles, pending.stale_winners,
-            # no serial reassignment for gang batches: the reassigner is
-            # blind to the gang's ICI-domain pin, so a "repaired" member
-            # could land outside the slice — demote-and-retry instead,
-            # and atomicity below demotes the rest of its gang with it
-            batch=None if pending.gang_units else pending.batch)
+        t1 = _time.perf_counter()
+        moved = False
+        if not (pending.inscan_cover and not pending.stale_winners):
+            moved = self._repair_batch(
+                out, pending.profiles, pending.stale_winners,
+                # no serial reassignment for gang batches: the reassigner
+                # is blind to the gang's ICI-domain pin, so a "repaired"
+                # member could land outside the slice — demote-and-retry
+                # instead, and atomicity below demotes its gang with it
+                batch=None if pending.gang_units else pending.batch)
+        # else: the kernel's in-scan tables already enforced every
+        # in-batch (anti-)affinity interaction (both directions + waived
+        # co-location) and the batch carries no ports/volumes/extenders —
+        # the overlay walk would re-prove what the scan decided
+        self.phase_stats["repair_s"] += _time.perf_counter() - t1
         if pending.gang_units:
             self._enforce_gang_atomicity(out, pending.gang_units)
         if moved and pending.batch.anti_dom is not None:

@@ -59,9 +59,11 @@ NEG = jnp.float32(-1e30)
 #: pods per scan step (unrolled inside the step, exact serial semantics);
 #: the scan is latency-bound so fewer, fatter steps win — see
 #: schedule_batch. Power of two <= the minimum pod bucket (8).
-#: Topology-carrying batches use their own knob: the in-step (anti-)
-#: affinity gathers/scatters chain through the carry, so fat steps buy
-#: less there (measured r05: uniform 7.7k->9.7k at G=8; anti 2.3k->2.1k).
+#: Topology-carrying batches on the CLASSIC path use their own knob: the
+#: in-step (anti-)affinity gathers/scatters chain through the carry, so
+#: fat steps buy less there (measured r05: uniform 7.7k->9.7k at G=8;
+#: anti 2.3k->2.1k). The CLASS-INDEXED path (below) made the whole step
+#: cheap enough that one shared fat-step knob covers topology batches too.
 import os as _os
 _STEP_GROUP = int(_os.environ.get("KTPU_SCAN_GROUP", "8"))
 _STEP_GROUP_TOPO = int(_os.environ.get("KTPU_SCAN_GROUP_TOPO", "1"))
@@ -134,7 +136,10 @@ ZONE_WEIGHTING = 2.0 / 3.0
 
 _BATCH_INVARIANT = ("unique_masks", "unique_scores", "resource_weights",
                     "spread_base", "spread_zone", "spread_zinit",
-                    "spread_weight", "anti_dom", "anti_cnt0")
+                    "spread_weight", "anti_dom", "anti_cnt0",
+                    "class_req", "class_nz", "class_blocked",
+                    "class_mask_idx", "class_score_idx",
+                    "soft_dom", "soft_cnt0", "soft_base", "soft_weight")
 
 
 def _spread_score(cnt_g: jnp.ndarray, fits: jnp.ndarray,
@@ -211,6 +216,260 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
     return jax.vmap(one)(per_pod)
 
 
+def _soft_tables(pod_batch: dict):
+    """(soft_dom [Ts,N], soft_cnt0 [Ts,Ds], soft_base [Sb,N], weight) or
+    None — the in-scan preferred inter-pod (anti-)affinity credit tables
+    (core._assign_soft_terms)."""
+    dom = pod_batch.get("soft_dom")
+    if dom is None:
+        return None
+    return (dom, pod_batch["soft_cnt0"], pod_batch["soft_base"],
+            pod_batch["soft_weight"])
+
+
+def _soft_raw(soft_dom, scnt, soft_base, pod):
+    """One pod's [N] raw inter-pod affinity score from the frozen base row
+    plus the running per-(term, domain) in-batch credit accumulators —
+    the serial reference's per-pod re-count (interpod_affinity.go) over
+    batch winners, in the scan carry."""
+    rt = pod["soft_read_tids"]                       # [Ks], -1 padded
+    t = jnp.maximum(rt, 0)
+    drow = soft_dom[t]                               # [Ks, N]
+    at = jnp.take_along_axis(scnt[t], jnp.maximum(drow, 0), axis=1)
+    valid = (rt[:, None] >= 0) & (drow >= 0)
+    delta = (pod["soft_read_w"][:, None]
+             * jnp.where(valid, at, 0.0)).sum(axis=0)
+    return soft_base[jnp.maximum(pod["soft_base_idx"], 0)] + delta
+
+
+def _soft_score(raw, fits, weight):
+    """minmax_normalize over the CURRENT feasible set (the oracle's
+    domain: prioritize_nodes normalizes over filtered nodes), floored with
+    the same 4e-6 epsilon as _balanced_allocation (f32 vs the oracle's f64
+    can land a hair under an exact-integer boundary)."""
+    mn = jnp.min(jnp.where(fits, raw, jnp.inf))
+    mx = jnp.max(jnp.where(fits, raw, -jnp.inf))
+    span_ok = (mx > mn) & jnp.isfinite(mn)
+    norm = jnp.floor(MAX_PRIORITY * (raw - mn)
+                     / jnp.maximum(mx - mn, jnp.float32(1e-30)) + 4e-6)
+    return jnp.where(span_ok, weight * norm, 0.0)
+
+
+def _class_resource_score(cap_cpu, cap_mem, req_cpu, req_mem, rw):
+    """LeastRequested + BalancedAllocation over pre-broadcast class/node
+    axes — the ONE copy of the f32 arithmetic (cap guards, floors, the
+    4e-6 boundary epsilon) shared by _class_col (one node row) and
+    _class_ms_init (all rows). Elementwise mirror of _least_requested /
+    _balanced_allocation, so class-path decisions stay bit-identical to
+    the classic per-pod path."""
+    lr_c = jnp.where((cap_cpu > 0) & (req_cpu <= cap_cpu),
+                     jnp.floor((cap_cpu - req_cpu) * MAX_PRIORITY
+                               / jnp.maximum(cap_cpu, 1.0)), 0.0)
+    lr_m = jnp.where((cap_mem > 0) & (req_mem <= cap_mem),
+                     jnp.floor((cap_mem - req_mem) * MAX_PRIORITY
+                               / jnp.maximum(cap_mem, 1.0)), 0.0)
+    lr = jnp.floor((lr_c + lr_m) / 2.0)
+    cpu_frac = jnp.where(cap_cpu > 0, req_cpu / jnp.maximum(cap_cpu, 1.0),
+                         1.0)
+    mem_frac = jnp.where(cap_mem > 0, req_mem / jnp.maximum(cap_mem, 1.0),
+                         1.0)
+    ba = jnp.floor((1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_PRIORITY
+                   + 4e-6)
+    ba = jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, ba)
+    return rw[0] * lr + rw[1] * ba
+
+
+def _class_col(node_cfg: dict, cls: dict, unique_masks, unique_scores, rw,
+               used_b, nz_b, cnt_b, b):
+    """Recompute every template class's masked score at ONE node row `b`
+    (the only row a winner's bind changes) — [C] f32, NEG where
+    infeasible. Same elementwise f32 arithmetic as the classic per-pod
+    path, so decisions are bit-identical."""
+    alloc_b = node_cfg["alloc"][b]                                 # [R]
+    fits = jnp.all(cls["class_req"] + used_b[None, :]
+                   <= alloc_b[None, :], axis=1)                    # [C]
+    fits &= cnt_b + 1.0 <= node_cfg["max_pods"][b]
+    fits &= ~(cls["class_blocked"] & node_cfg["mem_pressure"][b])
+    fits &= node_cfg["node_ok"][b] & node_cfg["valid"][b]
+    fits &= unique_masks[cls["class_mask_idx"], b]
+    score = _class_resource_score(
+        alloc_b[COL_CPU], alloc_b[COL_MEM],
+        nz_b[0] + cls["class_nz"][:, 0],
+        nz_b[1] + cls["class_nz"][:, 1], rw) \
+        + unique_scores[cls["class_score_idx"], b]
+    return jnp.where(fits, score, NEG)
+
+
+def _class_ms_init(node_cfg: dict, usage: dict, cls: dict,
+                   unique_masks, unique_scores, rw):
+    """[C, N] masked-score table at batch start — the same arithmetic as
+    _class_col, vectorized over the node axis (computed once per batch;
+    the scan then refreshes one COLUMN per winner instead of recomputing
+    [N, R] feasibility + scores per pod)."""
+    used = usage["used"]                                           # [N, R]
+    nz = usage["nonzero_used"]                                     # [N, 2]
+    cnt = usage["pod_count"]                                       # [N]
+    alloc = node_cfg["alloc"]
+    C = cls["class_req"].shape[0]
+    R = alloc.shape[1]
+    fits = jnp.ones((C, alloc.shape[0]), bool)
+    for r in range(R):  # static unroll: no [C, N, R] intermediate
+        fits &= cls["class_req"][:, r][:, None] + used[None, :, r] \
+            <= alloc[None, :, r]
+    fits &= (cnt + 1.0 <= node_cfg["max_pods"])[None, :]
+    fits &= ~(cls["class_blocked"][:, None]
+              & node_cfg["mem_pressure"][None, :])
+    fits &= (node_cfg["node_ok"] & node_cfg["valid"])[None, :]
+    fits &= unique_masks[cls["class_mask_idx"]]
+    score = _class_resource_score(
+        alloc[:, COL_CPU][None, :], alloc[:, COL_MEM][None, :],
+        nz[:, 0][None, :] + cls["class_nz"][:, 0][:, None],
+        nz[:, 1][None, :] + cls["class_nz"][:, 1][:, None], rw) \
+        + unique_scores[cls["class_score_idx"]]
+    return jnp.where(fits, score, NEG)
+
+
+def _term_hits(anti_dom, table, tids):
+    """[K,N] bool: node's domain holds an in-batch hit for term tids[k]
+    in `table` (-1 = padding, never hits)."""
+    t = jnp.maximum(tids, 0)                          # [K]
+    drow = anti_dom[t]                                # [K,N]
+    at = jnp.take_along_axis(
+        table[t], jnp.maximum(drow, 0), axis=1)       # [K,N]
+    return (tids[:, None] >= 0) & (drow >= 0) & (at > 0.0)
+
+
+def _topo_bad(anti_dom, carry, pod, has_dir2):
+    """[N] bool: nodes this pod may NOT take because of in-batch winners'
+    required (anti-)affinity — direction 1 (pod CARRIES an anti term, a
+    winner MATCHES it in the domain), direction 2 (pod MATCHES a term a
+    winner CARRIES, when the carry table ships), and waived required
+    affinity (once ANY winner matches the term, later carriers must
+    co-locate into its domain). ONE copy for the classic and
+    class-indexed kernels: their contract is bit-identical decisions, so
+    this mask arithmetic must never diverge between them."""
+    bad = _term_hits(anti_dom, carry["topo_cnt"],
+                     pod["anti_tids"]).any(axis=0)
+    if has_dir2:
+        bad = bad | _term_hits(anti_dom, carry["topo_carry"],
+                               pod["cmatch_tids"]).any(axis=0)
+    atids = pod["aff_tids"]
+    need = (atids >= 0) & (carry["topo_tot"][jnp.maximum(atids, 0)] > 0.0)
+    bad = bad | (need[:, None] & ~_term_hits(
+        anti_dom, carry["topo_cnt"], atids)).any(axis=0)
+    return bad
+
+
+def _topo_scatter(anti_dom, carry, pod, best, ok, has_dir2):
+    """The winner's (term, domain) counter updates: one [K]-vector
+    scatter-add per table instead of K chained scatters (duplicate padded
+    indices add 0, .at accumulates safely). Shared by both kernels for
+    the same bit-identity reason as _topo_bad."""
+    mtids = pod["match_tids"]                         # [K]
+    mt = jnp.maximum(mtids, 0)
+    md = anti_dom[mt, best]                           # [K]
+    val = ((mtids >= 0) & (md >= 0) & ok).astype(jnp.float32)
+    out = {"topo_cnt": carry["topo_cnt"].at[
+               mt, jnp.maximum(md, 0)].add(val),
+           "topo_tot": carry["topo_tot"].at[mt].add(val)}
+    if has_dir2:
+        atids2 = pod["canti_tids"]
+        at2 = jnp.maximum(atids2, 0)
+        ad = anti_dom[at2, best]
+        aval = ((atids2 >= 0) & (ad >= 0) & ok).astype(jnp.float32)
+        out["topo_carry"] = carry["topo_carry"].at[
+            at2, jnp.maximum(ad, 0)].add(aval)
+    return out
+
+
+#: "was feasible" threshold for the class path: real masked scores are
+#: small-magnitude; NEG marks infeasible. Strictly between them.
+_NEG_THRESHOLD = jnp.float32(-1e29)
+
+
+def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
+    """The class-indexed incremental scan: pods sharing a (template,
+    score-row) class share a precomputed masked-score ROW; a scan step
+    gathers its pod's row, argmaxes, and refreshes only the winner's
+    COLUMN across all classes (the single node whose usage changed).
+    Per-step cost drops from O(N*R) feasibility+score recompute to
+    O(N + C*R) — the change that lets topology batches run fat scan
+    steps instead of the r05 alignment-split workaround.
+
+    Semantics and f32 arithmetic are bit-identical to the classic path
+    (tests/test_topo_cache.py pins decisions); routed only for batches
+    without nominated reservations, spread groups, or in-scan soft
+    credits (those keep per-pod state the column refresh can't share)."""
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
+    N = node_cfg["alloc"].shape[0]
+    cls = {k: pod_batch[k] for k in ("class_req", "class_nz",
+                                     "class_blocked", "class_mask_idx",
+                                     "class_score_idx")}
+    anti_dom = pod_batch.get("anti_dom")
+    has_topo = anti_dom is not None
+    has_dir2 = has_topo and "cmatch_tids" in pod_batch
+    rows = jnp.arange(N, dtype=jnp.int32)
+    ms0 = _class_ms_init(node_cfg, usage, cls, unique_masks,
+                         unique_scores, rw)
+
+    def one_pod(carry, pod):
+        u = pod["class_idx"]
+        masked = carry["ms"][u]                                    # [N]
+        if has_topo:
+            # both (anti-)affinity directions + waived co-location, from
+            # the running counters (_topo_bad — shared with the classic
+            # kernel so the mask arithmetic can't diverge)
+            masked = jnp.where(_topo_bad(anti_dom, carry, pod, has_dir2),
+                               NEG, masked)
+        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
+                            pod["seq"] * jnp.int32(40503), 0xFFFF)
+        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
+        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        chosen = masked[best]
+        ok = (chosen > _NEG_THRESHOLD) & pod["active"]
+        ok_f = jnp.where(ok, 1.0, 0.0)
+        used = carry["used"].at[best].add(ok_f * cls["class_req"][u])
+        nz_used = carry["nz_used"].at[best].add(ok_f * cls["class_nz"][u])
+        pod_count = carry["pod_count"].at[best].add(ok_f)
+        col = _class_col(node_cfg, cls, unique_masks, unique_scores, rw,
+                         used[best], nz_used[best], pod_count[best], best)
+        out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
+               "ms": carry["ms"].at[:, best].set(col)}
+        if has_topo:
+            out.update(_topo_scatter(anti_dom, carry, pod, best, ok,
+                                     has_dir2))
+        assign = jnp.where(ok, best, jnp.int32(-1))
+        return out, (assign, chosen)
+
+    carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
+              "pod_count": usage["pod_count"], "ms": ms0}
+    if has_topo:
+        carry0["topo_cnt"] = pod_batch["anti_cnt0"]
+        carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
+        if has_dir2:
+            carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    P = per_pod["seq"].shape[0]
+    want = max(1, _STEP_GROUP)
+    G = min(1 << (want.bit_length() - 1), P)
+
+    def step(carry, podg):
+        outs = []
+        for g in range(G):
+            pod = {k: v[g] for k, v in podg.items()}
+            carry, out = one_pod(carry, pod)
+            outs.append(out)
+        return carry, (jnp.stack([o[0] for o in outs]),
+                       jnp.stack([o[1] for o in outs]))
+
+    per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
+                 for k, v in per_pod.items()}
+    final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
+    return (assign_g.reshape(P), scores_g.reshape(P),
+            {"used": final["used"],
+             "nonzero_used": final["nz_used"],
+             "pod_count": final["pod_count"]})
+
+
 @jax.jit
 def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
                    nom: dict = None):
@@ -232,16 +491,29 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     other pods, not just lower-priority ones — strictly more conservative;
     a higher-priority pod pushed off a full nominated node preempts
     instead. Scores stay on real usage (matching PrioritizeNodes, which
-    ranks against the snapshot)."""
+    ranks against the snapshot).
+
+    Dispatch (trace-time, by pytree structure): batches carrying class
+    tables (tensorize.PodBatchTensors.enable_class_scan) and no nominated
+    reservations route to the incremental class-indexed scan; everything
+    else — spread groups, soft in-scan credits, nominations — keeps the
+    classic per-pod recompute."""
+    if "class_req" in pod_batch and nom is None:
+        return _schedule_batch_classes(node_cfg, usage, pod_batch)
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    soft = _soft_tables(pod_batch)
+    has_soft = soft is not None
+    if has_soft:
+        soft_dom, soft_cnt0, soft_base, soft_w = soft
     #: in-scan required (anti-)affinity: per-term node->domain rows plus
     #: running (term, domain) match counters — the BatchOverlay's
     #: serial-winner visibility, ON DEVICE, so the kernel's picks already
     #: respect earlier same-batch winners instead of being repaired after
     anti_dom = pod_batch.get("anti_dom")        # [T, N] int32, -1=no label
     has_topo = anti_dom is not None
+    has_dir2 = has_topo and "cmatch_tids" in pod_batch
     rows = jnp.arange(N, dtype=jnp.int32)
     if nom is None:
         nom = {"used": jnp.zeros_like(usage["used"]),
@@ -263,30 +535,19 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
             # The K axis is VECTORIZED — one [K,N] gather + one reduce —
             # not a Python loop: K unrolled iterations serialize K
             # dependent gathers in the scan's HLO (the r04 anti-affinity
-            # regression, 2.5k -> 1.7k pods/s)
-            cnt = carry["topo_cnt"]
-            tot = carry["topo_tot"]
-
-            def term_hits(tids):
-                """[K,N] bool: node's domain holds an in-batch winner
-                matching term tids[k] (-1 = padding, never hits)."""
-                t = jnp.maximum(tids, 0)                      # [K]
-                drow = anti_dom[t]                            # [K,N]
-                at = jnp.take_along_axis(
-                    cnt[t], jnp.maximum(drow, 0), axis=1)     # [K,N]
-                return (tids[:, None] >= 0) & (drow >= 0) & (at > 0.0)
-
-            # required anti-affinity: a carried term with a winner in
-            # the node's domain forbids the node
-            bad = term_hits(pod["anti_tids"]).any(axis=0)
-            # waived required affinity: once ANY winner matches the
-            # term, later carriers must co-locate into its domain
-            atids = pod["aff_tids"]
-            need = (atids >= 0) & (tot[jnp.maximum(atids, 0)] > 0.0)
-            bad = bad | (need[:, None]
-                         & ~term_hits(atids)).any(axis=0)
-            fits = fits & ~bad
+            # regression, 2.5k -> 1.7k pods/s). _topo_bad is shared with
+            # the class-indexed kernel (bit-identity contract).
+            fits = fits & ~_topo_bad(anti_dom, carry, pod, has_dir2)
         score = _pod_score(node_cfg, carry["nz_used"], pod, static, rw)
+        if has_soft:
+            # preferred inter-pod (anti-)affinity runs IN-SCAN from running
+            # per-(term, domain) credit accumulators — the serial
+            # reference's per-pod re-score via assume-between-iterations,
+            # which SOFT_SCORE_CHUNK sub-batching used to approximate
+            raw = _soft_raw(soft_dom, carry["soft_cnt"], soft_base, pod)
+            score = score + jnp.where(
+                pod["soft_base_idx"] >= 0,
+                _soft_score(raw, fits, soft_w), 0.0)
         # SelectorSpread runs IN-SCAN from running group counts — the
         # serial reference recounts per pod via assume-between-iterations
         # (selector_spreading.go:277); a frozen batch-start score would
@@ -323,15 +584,18 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
             "spread": carry["spread"].at[:, best].add(sm * ok_f),
         }
         if has_topo:
-            # one [K]-vector scatter-add instead of K chained scatters
-            # (duplicate padded indices add 0, .at accumulates safely)
-            mtids = pod["match_tids"]                         # [K]
-            mt = jnp.maximum(mtids, 0)
-            md = anti_dom[mt, best]                           # [K]
-            val = ((mtids >= 0) & (md >= 0) & ok).astype(jnp.float32)
-            out["topo_cnt"] = carry["topo_cnt"].at[
-                mt, jnp.maximum(md, 0)].add(val)
-            out["topo_tot"] = carry["topo_tot"].at[mt].add(val)
+            out.update(_topo_scatter(anti_dom, carry, pod, best, ok,
+                                     has_dir2))
+        if has_soft:
+            # the winner's credit writes: +1 per matched read channel,
+            # +weight per carried preferred/required-affinity channel
+            wtids = pod["soft_write_tids"]                    # [Ks]
+            wt = jnp.maximum(wtids, 0)
+            wd = soft_dom[wt, best]                           # [Ks]
+            wval = jnp.where((wtids >= 0) & (wd >= 0) & ok,
+                             pod["soft_write_w"], 0.0)
+            out["soft_cnt"] = carry["soft_cnt"].at[
+                wt, jnp.maximum(wd, 0)].add(wval)
         assign = jnp.where(ok, best, jnp.int32(-1))
         return out, (assign, masked[best])
 
@@ -340,6 +604,10 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     if has_topo:
         carry0["topo_cnt"] = pod_batch["anti_cnt0"]
         carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
+        if has_dir2:
+            carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    if has_soft:
+        carry0["soft_cnt"] = soft_cnt0
     # STEP GROUPING: the scan is latency-bound — each step's compute
     # ([N]-vector ops) is tiny next to the per-step sequencing overhead,
     # so a P-step scan costs ~P * step_latency regardless of N. Packing G

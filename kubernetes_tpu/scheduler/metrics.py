@@ -68,6 +68,14 @@ class SchedulerMetrics:
             "Pods decided per batch cycle",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                      4096))
+        # in-scan (anti-)affinity fallbacks, by reason {term_cap, kmax,
+        # soft_terms, soft_kmax, soft_gang}: batches the kernel tables
+        # could not cover take the repair-overlay / sub-chunked path
+        # instead — a capped code path must be visible, never silent
+        self.topo_inscan_fallbacks = r.counter(
+            "scheduler_topo_inscan_fallbacks_total",
+            "Batches that fell back from the in-scan topology/soft-credit "
+            "tables, by reason")
 
     def observe_queue(self, queue) -> None:
         """Sample the three sub-queue depths (PendingPods gauges)."""

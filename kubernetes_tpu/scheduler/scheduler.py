@@ -114,6 +114,8 @@ class Scheduler:
             nominated=self.queue.nominated,
             pdb_lister=lambda: pdb_informer.indexer.list(),
             extenders=self.extenders, mesh=mesh)
+        #: in-scan fallback counters (scheduler_topo_inscan_fallbacks_total)
+        self.algorithm.sched_metrics = self.metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
@@ -246,10 +248,15 @@ class Scheduler:
             on_delete=self._on_node_delete))
         # services/controllers affect SelectorSpread; their events may make
         # parked pods schedulable-where-preferred (ref: eventhandlers.go
-        # onServiceAdd -> MoveAllToActiveQueue)
+        # onServiceAdd -> MoveAllToActiveQueue) — and they invalidate the
+        # scorer's per-template selector memo, which node epochs alone
+        # would never refresh on a node-quiet cluster
         from ..api.apps import ReplicaSet, StatefulSet
         from ..api.core import ReplicationController, Service
-        move = lambda *args: self.queue.move_all_to_active_queue()
+
+        def move(*args):
+            self.algorithm.scorer.invalidate_spread_selectors()
+            self.queue.move_all_to_active_queue()
         for cls in (Service, ReplicationController, ReplicaSet, StatefulSet):
             self.informers.informer_for(cls).add_event_handlers(
                 EventHandlers(on_add=move, on_update=move, on_delete=move))
@@ -368,7 +375,12 @@ class Scheduler:
                 # spread-carrying pods sub-chunk so soft scores refresh
                 # between chunks (core.soft_batch_limit)
                 limit = self.algorithm.soft_batch_limit(pods)
-                chunk, pods = pods[:limit], pods[limit:]
+                if limit < len(pods):
+                    chunk, pods = pods[:limit], pods[limit:]
+                else:
+                    # keep the list object: soft_batch_limit's channel plan
+                    # is memoized by list identity (core._soft_plan_cached)
+                    chunk, pods = pods, []
                 results.extend(self._schedule_batch_locked(chunk, cycle))
         finally:
             self._in_flight = 0
@@ -531,14 +543,15 @@ class Scheduler:
                         pods, carry = pods[:limit], pods[limit:]
                 if pods and self._align_split and \
                         self.algorithm.topo_scan_likely(pods):
-                    # bucket alignment for TOPOLOGY scans only: in-scan
-                    # (anti-)affinity runs ungrouped (GT=1), so the scan
-                    # pads to the next power of two at full per-step cost
-                    # — 5000 pods pay an 8192-step scan (measured +33%
-                    # anti throughput from splitting). Plain batches keep
-                    # the padded single launch: their G=8 grouped steps
-                    # amortize padding better than a second launch costs
-                    # (measured: splitting LOSES ~20% on node-affinity)
+                    # bucket alignment for TOPOLOGY scans only: the
+                    # class-indexed scan cut the per-step cost ~6x (r06),
+                    # but topology steps still pay the [K, N] counter
+                    # gathers per pad step, so trimming a 5000-pod pop to
+                    # 4096+904 still beats one padded 8192-step scan
+                    # (measured r06: +24%, down from +33% at r05). Plain
+                    # batches keep the padded single launch: their grouped
+                    # steps amortize padding better than a second launch
+                    # costs (measured: splitting LOSES ~20% node-affinity)
                     P = len(pods)
                     aligned = 1 << (P.bit_length() - 1)
                     if aligned >= 4096 and P != aligned and \

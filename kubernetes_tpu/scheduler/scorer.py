@@ -134,6 +134,7 @@ class ScoreCompiler:
             return
         self._epoch = self.mirror.epoch
         self._vec_cache.clear()
+        self._spread_sel_memo: Dict[Tuple, bool] = {}
         cap = self.mirror.t.capacity
         zone_ids = np.zeros((cap,), np.int32)
         zones: Dict[str, int] = {"": 0}
@@ -248,7 +249,8 @@ class ScoreCompiler:
             else:
                 parts.append(None)
         spread_or_interpod = False
-        if w.get("SelectorSpreadPriority") and self.listers is not None:
+        if w.get("SelectorSpreadPriority") and self.listers is not None \
+                and self._pod_has_spread_selectors(pod):
             spread_or_interpod = True
         if w.get("InterPodAffinityPriority") and (
                 _has_preferred_pod_affinity(pod) or
@@ -262,6 +264,34 @@ class ScoreCompiler:
         if not contributes:
             return None
         return tuple(parts)
+
+    def invalidate_spread_selectors(self) -> None:
+        """Drop the per-template spread-selector memo. The scheduler shell
+        calls this on Service/RC/RS/StatefulSet informer events (the same
+        events that move parked pods back to active): mirror.epoch only
+        moves on node changes, so without this a Service created mid-run
+        on a node-quiet cluster would leave its templates memoized as
+        selector-less and silently skip spread scoring."""
+        self._spread_sel_memo = {}
+
+    def _pod_has_spread_selectors(self, pod: Pod) -> bool:
+        """SelectorSpread contributes only when some service/controller
+        selector matches the pod; without one, the whole (ns, labels)
+        score-key component — and its per-template fits_row +
+        PriorityMetadata work — is dead weight. Memoized per template,
+        invalidated by node epoch AND selector-source events
+        (invalidate_spread_selectors), so a selector-less 16k-pod burst
+        skips static scoring entirely."""
+        memo = getattr(self, "_spread_sel_memo", None)
+        if memo is None:
+            memo = self._spread_sel_memo = {}
+        key = (pod.metadata.namespace,
+               tuple(sorted(pod.metadata.labels.items())))
+        hit = memo.get(key)
+        if hit is None:
+            hit = bool(self.listers.selectors_for_pod(pod))
+            memo[key] = hit
+        return hit
 
     def static_scores(self, pods: List[Pod], batch
                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -287,16 +317,20 @@ class ScoreCompiler:
                 continue
             # pods in an in-scan spread group get their spread component
             # from the kernel's running counts — the static row must not
-            # double-count it
+            # double-count it; same for inter-pod affinity when the batch
+            # carries in-scan soft credit tables (core._assign_soft_terms)
             kernel_spread = bool(batch.spread_gidx[i] >= 0)
+            kernel_interpod = getattr(batch, "soft_dom", None) is not None
             # the feasible set (normalization domain) depends on the mask
             # row, the request columns, and the pressure flag
             key = (skey, int(batch.mask_idx[i]), batch.req[i].tobytes(),
-                   bool(batch.mem_pressure_blocked[i]), kernel_spread)
+                   bool(batch.mem_pressure_blocked[i]), kernel_spread,
+                   kernel_interpod)
             u = row_of.get(key)
             if u is None:
                 row = self._compute_row(pod, batch.fits_row(i),
-                                        skip_spread=kernel_spread)
+                                        skip_spread=kernel_spread,
+                                        skip_interpod=kernel_interpod)
                 if row is None:
                     u = 0
                 else:
@@ -311,7 +345,8 @@ class ScoreCompiler:
         return score_idx, np.stack(rows)
 
     def _compute_row(self, pod: Pod, fits: np.ndarray,
-                     skip_spread: bool = False) -> Optional[np.ndarray]:
+                     skip_spread: bool = False,
+                     skip_interpod: bool = False) -> Optional[np.ndarray]:
         """One pod's weighted static score row [N] (None = all-constant)."""
         w = self.weights
         meta = prios.PriorityMetadata(pod, self.listers)
@@ -353,7 +388,7 @@ class ScoreCompiler:
             if counts is not None and counts.any():
                 acc(self._spread_reduce(counts, fits),
                     w["SelectorSpreadPriority"])
-        if w.get("InterPodAffinityPriority"):
+        if w.get("InterPodAffinityPriority") and not skip_interpod:
             raw = self._interpod_raw(pod)
             if raw is not None:
                 mn = float(raw[fits].min()) if fits.any() else 0.0

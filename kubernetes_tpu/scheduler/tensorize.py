@@ -534,7 +534,8 @@ class PodBatchTensors:
 
     def __init__(self, pods: List[Pod], mirror: TensorMirror,
                  terms: TermCompiler, extra_mask: Optional[np.ndarray] = None,
-                 min_bucket: int = 8, seq_base: int = 0):
+                 min_bucket: int = 8, seq_base: int = 0,
+                 extra_group: Optional[np.ndarray] = None):
         self.pods = pods
         P = _bucket(len(pods), min_bucket)
         vocab = mirror.vocab
@@ -588,8 +589,18 @@ class PodBatchTensors:
         tmpl_idx = np.zeros((P,), np.int32)
         for i, pod in enumerate(pods):
             reqs, reqs_key, qos_be, blocked_sig, ckey0 = sigs[i]
-            has_extra = extra_mask is not None and not extra_mask[i].all()
-            ckey = ckey0 + (extra_mask[i].tobytes() if has_extra else None,)
+            if extra_group is not None and extra_mask is not None:
+                # the caller's residual group id names the extra row's
+                # template: dedupe by id instead of hashing 8K of mask
+                # bytes per pod (-1 = no extra row)
+                g = int(extra_group[i])
+                has_extra = g != -1  # >= 0: template row; -2: all-False
+                ckey = ckey0 + (("eg", g) if has_extra else None,)
+            else:
+                has_extra = extra_mask is not None \
+                    and not extra_mask[i].all()
+                ckey = ckey0 + (extra_mask[i].tobytes()
+                                if has_extra else None,)
             # the QoS class itself is a template key component (aggregate
             # request maps can't distinguish init-container-only
             # BestEffort pods)
@@ -642,6 +653,15 @@ class PodBatchTensors:
                 np.asarray(tmpl_blocked, bool)[idx]
             self.mask_idx[:n] = np.asarray(tmpl_mask, np.int32)[idx]
         self.active[:n] = True
+        # template tables retained for the class-indexed incremental scan
+        # (enable_class_scan): pods sharing a template share every
+        # batch-varying row the scan would otherwise recompute per pod
+        self.tmpl_idx = tmpl_idx                       # [P] (pads -> 0)
+        self._tmpl_req = tmpl_req
+        self._tmpl_nz = tmpl_nz
+        self._tmpl_blocked = tmpl_blocked
+        self._tmpl_mask = tmpl_mask
+        self._class_tables: Optional[Dict[str, np.ndarray]] = None
         U = _bucket(len(rows), minimum=1)
         self.unique_masks = np.zeros((U, N), bool)
         if rows:
@@ -670,13 +690,32 @@ class PodBatchTensors:
         self.anti_tids: Optional[np.ndarray] = None     # [P, K] int32 (-1 pad)
         self.aff_tids: Optional[np.ndarray] = None      # [P, K] int32
         self.match_tids: Optional[np.ndarray] = None    # [P, K] int32
+        self.cmatch_tids: Optional[np.ndarray] = None   # [P, K] int32
+        self.canti_tids: Optional[np.ndarray] = None    # [P, K] int32
+
+        # in-scan preferred (anti-)affinity credit tables
+        # (core._assign_soft_terms)
+        self.soft_dom: Optional[np.ndarray] = None       # [Ts, N] int32
+        self.soft_cnt0: Optional[np.ndarray] = None      # [Ts, Ds] f32 zeros
+        self.soft_base: Optional[np.ndarray] = None      # [Sb, N] f32
+        self.soft_base_idx: Optional[np.ndarray] = None  # [P] int32 (-1 off)
+        self.soft_read_tids: Optional[np.ndarray] = None   # [P, Ks] int32
+        self.soft_read_w: Optional[np.ndarray] = None      # [P, Ks] f32
+        self.soft_write_tids: Optional[np.ndarray] = None  # [P, Ks] int32
+        self.soft_write_w: Optional[np.ndarray] = None     # [P, Ks] f32
+        self.soft_weight = 0.0
 
     def set_topology_terms(self, dom: np.ndarray, n_domains: int,
                            anti_tids: np.ndarray, aff_tids: np.ndarray,
-                           match_tids: np.ndarray) -> None:
-        """Install in-scan term tables; T and D bucketed (padded term rows
-        carry dom=-1 everywhere: never conflict, never bump). The per-pod
-        [K]-term lists keep the scan O(K*N) per step."""
+                           match_tids: np.ndarray,
+                           cmatch_tids: Optional[np.ndarray] = None,
+                           canti_tids: Optional[np.ndarray] = None) -> None:
+        """Install in-scan term tables; T, D, and the per-pod K axis all
+        bucketed to powers of two (padded term rows carry dom=-1
+        everywhere: never conflict, never bump) so consecutive batches
+        with drifting term fan-outs share one compiled kernel instead of
+        recompiling per batch. The per-pod [K]-term lists keep the scan
+        O(K*N) per step."""
         T = _bucket(dom.shape[0], minimum=8)
         P = self.req.shape[0]
         dom_p = np.full((T, dom.shape[1]), -1, np.int32)
@@ -684,14 +723,99 @@ class PodBatchTensors:
         self.anti_dom = dom_p
         self.anti_cnt0 = np.zeros((T, _bucket(max(n_domains, 1),
                                               minimum=64)), np.float32)
+        K = _bucket(max(anti_tids.shape[1], aff_tids.shape[1],
+                        match_tids.shape[1], 1), minimum=1)
 
         def pad(m):
-            out = np.full((P, m.shape[1]), -1, np.int32)
-            out[:m.shape[0]] = m
+            out = np.full((P, K), -1, np.int32)
+            out[:m.shape[0], :m.shape[1]] = m
             return out
         self.anti_tids = pad(anti_tids)
         self.aff_tids = pad(aff_tids)
         self.match_tids = pad(match_tids)
+        # direction-2 lists (winner carries / pod matches), present only
+        # when some pure matcher in the batch needs them — their absence
+        # drops the whole carry-counter table from the kernel trace
+        self.cmatch_tids = pad(cmatch_tids) if cmatch_tids is not None \
+            else None
+        self.canti_tids = pad(canti_tids) if canti_tids is not None \
+            else None
+
+    def set_soft_terms(self, dom: np.ndarray, n_domains: int,
+                       base: np.ndarray, base_idx: np.ndarray,
+                       read_tids: np.ndarray, read_w: np.ndarray,
+                       write_tids: np.ndarray, write_w: np.ndarray,
+                       weight: float) -> None:
+        """Install in-scan preferred inter-pod (anti-)affinity credit
+        tables (core._assign_soft_terms): per-(term slot, domain) weight
+        accumulators start at zero (pre-batch credits live in the per-class
+        `base` raw rows); each pod reads its slot list at its nodes'
+        domains (signed weights) and a winner writes its slot list at the
+        chosen node's domain. Ts/Ds/Ks/Sb bucketed like the required-term
+        tables."""
+        Ts = _bucket(dom.shape[0], minimum=8)
+        P = self.req.shape[0]
+        dom_p = np.full((Ts, dom.shape[1]), -1, np.int32)
+        dom_p[:dom.shape[0]] = dom
+        self.soft_dom = dom_p
+        self.soft_cnt0 = np.zeros((Ts, _bucket(max(n_domains, 1),
+                                               minimum=64)), np.float32)
+        Sb = _bucket(base.shape[0], minimum=1)
+        base_p = np.zeros((Sb, base.shape[1]), np.float32)
+        base_p[:base.shape[0]] = base
+        self.soft_base = base_p
+        self.soft_base_idx = np.full((P,), -1, np.int32)
+        self.soft_base_idx[:len(base_idx)] = base_idx
+        Ks = _bucket(max(read_tids.shape[1], write_tids.shape[1], 1),
+                     minimum=1)
+
+        def pad_i(m):
+            out = np.full((P, Ks), -1, np.int32)
+            out[:m.shape[0], :m.shape[1]] = m
+            return out
+
+        def pad_f(m):
+            out = np.zeros((P, Ks), np.float32)
+            out[:m.shape[0], :m.shape[1]] = m
+            return out
+        self.soft_read_tids = pad_i(read_tids)
+        self.soft_read_w = pad_f(read_w)
+        self.soft_write_tids = pad_i(write_tids)
+        self.soft_write_w = pad_f(write_w)
+        self.soft_weight = float(weight)
+
+    def enable_class_scan(self) -> None:
+        """Build the (template, score-row) class tables for the kernel's
+        incremental class-indexed scan (kernels/batch.py
+        _schedule_batch_classes). Called AFTER static scores are set —
+        score_idx is part of the class key. The caller guarantees the
+        batch carries no spread groups, soft credits, or nominated
+        reservations (those keep per-pod state the class path can't
+        share)."""
+        if not self._tmpl_req:
+            return
+        P = self.req.shape[0]
+        S = max(1, self.unique_scores.shape[0])
+        pair = self.tmpl_idx.astype(np.int64) * S \
+            + self.score_idx.astype(np.int64)
+        uniq, class_idx = np.unique(pair, return_inverse=True)
+        C = _bucket(len(uniq), minimum=1)
+        t_of = (uniq // S).astype(np.int64)
+        s_of = (uniq % S).astype(np.int64)
+        req = np.zeros((C, self.req.shape[1]), np.float32)
+        nz = np.zeros((C, 2), np.float32)
+        blocked = np.zeros((C,), bool)
+        mask_idx = np.zeros((C,), np.int32)
+        score_idx = np.zeros((C,), np.int32)
+        req[:len(uniq)] = np.stack(self._tmpl_req)[t_of]
+        nz[:len(uniq)] = np.asarray(self._tmpl_nz, np.float32)[t_of]
+        blocked[:len(uniq)] = np.asarray(self._tmpl_blocked, bool)[t_of]
+        mask_idx[:len(uniq)] = np.asarray(self._tmpl_mask, np.int32)[t_of]
+        score_idx[:len(uniq)] = s_of
+        self._class_tables = {
+            "class_req": req, "class_nz": nz, "class_blocked": blocked,
+            "class_mask_idx": mask_idx, "class_score_idx": score_idx,
+            "class_idx": class_idx.astype(np.int32)[:P]}
 
     def set_spread(self, base: np.ndarray, zone_of: np.ndarray,
                    n_zones: int, weight: float,
@@ -783,4 +907,24 @@ class PodBatchTensors:
             out["anti_tids"] = put(self.anti_tids)
             out["aff_tids"] = put(self.aff_tids)
             out["match_tids"] = put(self.match_tids)
+            if self.cmatch_tids is not None:
+                out["cmatch_tids"] = put(self.cmatch_tids)
+                out["canti_tids"] = put(self.canti_tids)
+        if self.soft_dom is not None:
+            import jax.numpy as jnp
+            out["soft_dom"] = mask_put(self.soft_dom)
+            out["soft_cnt0"] = put(self.soft_cnt0)
+            out["soft_base"] = mask_put(self.soft_base)
+            out["soft_base_idx"] = put(self.soft_base_idx)
+            out["soft_read_tids"] = put(self.soft_read_tids)
+            out["soft_read_w"] = put(self.soft_read_w)
+            out["soft_write_tids"] = put(self.soft_write_tids)
+            out["soft_write_w"] = put(self.soft_write_w)
+            out["soft_weight"] = jnp.float32(self.soft_weight)
+        if self._class_tables is not None:
+            ct = self._class_tables
+            for k in ("class_req", "class_nz", "class_blocked",
+                      "class_mask_idx", "class_score_idx"):
+                out[k] = put(ct[k])
+            out["class_idx"] = put(ct["class_idx"])
         return out

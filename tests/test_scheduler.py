@@ -577,7 +577,11 @@ class TestResidualPredicates:
         b = make_pod("b", labels={"app": "x"})
         ra, rb = sched.schedule([a, b])
         assert ra.node_name == "n1"
-        assert rb.node_name is None and rb.retry
+        # the in-scan carry counters (direction 2: winner CARRIES the anti
+        # term, b merely matches it) block b inside the kernel itself —
+        # the serial semantics directly, with no repair demotion, so b
+        # parks as unschedulable instead of burning a retry round
+        assert rb.node_name is None and not rb.retry
 
     def test_disk_conflict(self):
         n1 = make_node("n1")

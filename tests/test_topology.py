@@ -296,3 +296,254 @@ class TestScoreBoundaryParity:
         mf = 6442450944.0 / float(2 ** 35)
         want = int((1.0 - abs(cf - mf)) * 10.0)
         assert got == want == 4
+
+
+class TestInScanEpochChurnParity:
+    """Satellite of ISSUE 5: randomized parity pinning the kernel's
+    in-scan topology counters (both anti-affinity directions + waived
+    co-location) against a serial replay at bench-scale term shapes —
+    >= 100 anti-affinity colors — with the term-table cache's
+    epoch-invalidation boundary straddled between batches (node add,
+    delete, AND relabel), so a stale cached [T, N] table or profile
+    flips a decision here instead of only skewing bench parity."""
+
+    WEIGHTS = {"LeastRequestedPriority": 1, "BalancedResourceAllocation": 1}
+
+    def _mk_node(self, i, zone):
+        return api.Node(
+            metadata=api.ObjectMeta(
+                name=f"n{i}",
+                labels={api.wellknown.LABEL_HOSTNAME: f"n{i}",
+                        api.wellknown.LABEL_ZONE: zone}),
+            status=api.NodeStatus(
+                capacity={"cpu": Quantity("16"), "memory": Quantity("32Gi"),
+                          "pods": Quantity(110)},
+                allocatable={"cpu": Quantity("16"),
+                             "memory": Quantity("32Gi"),
+                             "pods": Quantity(110)},
+                conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+    def _mk_pod(self, rng, i):
+        color = f"c{i % 110}"   # >= 100 distinct anti colors
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name=f"p{i}", namespace="default",
+                                    labels={"color": color}),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("100m"),
+                              "memory": Quantity("64Mi")}))]))
+        kind = rng.random()
+        term = api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels={"color": color}),
+            topology_key=api.wellknown.LABEL_HOSTNAME)
+        if kind < 0.55:
+            # carrier + matcher (direction 1)
+            pod.spec.affinity = api.Affinity(
+                pod_anti_affinity=api.PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        term]))
+        elif kind < 0.7:
+            # zone-topology anti: exercises the relabel invalidation
+            pod.spec.affinity = api.Affinity(
+                pod_anti_affinity=api.PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"color": color}),
+                            topology_key=api.wellknown.LABEL_ZONE)]))
+        elif kind < 0.85:
+            # pure matcher (direction 2: blocked by in-batch carriers)
+            pass
+        else:
+            # self-affine (waived-term activation + co-location)
+            pod.spec.affinity = api.Affinity(
+                pod_affinity=api.PodAffinity(
+                    required_during_scheduling_ignored_during_execution=[
+                        term]))
+        return pod
+
+    def test_serial_replay_across_epoch_boundaries(self):
+        from kubernetes_tpu.scheduler.core import BatchScheduler
+        from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+        rng = random.Random(1234)
+        cache = Cache()
+        infos = {}
+        for i in range(36):
+            n = self._mk_node(i, f"z{i % 5}")
+            cache.add_node(n)
+            infos[n.metadata.name] = NodeInfo(n)
+        sched = BatchScheduler(cache, weights=dict(self.WEIGHTS))
+        next_i = [0]
+
+        def one_batch(n_pods):
+            base = sched._seq_base
+            pods = [self._mk_pod(rng, next_i[0] + j) for j in range(n_pods)]
+            next_i[0] += n_pods
+            results = sched.schedule(pods)
+            row_of = dict(sched.mirror.row_of)
+            for j, res in enumerate(results):
+                pod = res.pod
+                meta = preds.PredicateMetadata(pod, infos)
+                feasible = {nm: ni for nm, ni in infos.items()
+                            if preds.pod_fits_on_node(pod, meta, ni)[0]}
+                if not feasible:
+                    assert res.node_name is None, pod.metadata.name
+                    continue
+                pmeta = prios.PriorityMetadata(pod)
+                scores = prios.prioritize_nodes(
+                    pod, pmeta, feasible, self.WEIGHTS,
+                    all_node_infos=infos)
+                seq = (base + j) & 0x7FFFFFFF
+
+                def penalty(nm):
+                    h = (row_of[nm] * -1640531527 + seq * 40503) & 0xFFFF
+                    return float(h) * (0.5 / 65536.0)
+                best = max(feasible,
+                           key=lambda nm: scores.get(nm, 0) - penalty(nm))
+                assert res.node_name == best, (
+                    pod.metadata.name, res.node_name, best)
+                bound = api.serde.deepcopy_obj(pod)
+                bound.spec.node_name = best
+                cache.add_pod(bound)
+                infos[best].add_pod(bound)
+
+        one_batch(130)
+        one_batch(90)   # steady state: cached tables must still be right
+        # epoch boundary: add two nodes, delete one, relabel one's zone
+        for i in (50, 51):
+            n = self._mk_node(i, f"z{i % 5}")
+            cache.add_node(n)
+            infos[n.metadata.name] = NodeInfo(n)
+        gone = infos.pop("n7").node
+        cache.remove_node(gone)
+        old = infos["n11"].node
+        relabeled = api.serde.deepcopy_obj(old)
+        relabeled.metadata.labels[api.wellknown.LABEL_ZONE] = "z0"
+        cache.update_node(old, relabeled)
+        moved = infos.pop("n11")
+        infos["n11"] = NodeInfo(relabeled)
+        for p in moved.pods:
+            infos["n11"].add_pod(p)
+        one_batch(130)
+
+
+class TestInScanSoftCredits:
+    """Preferred inter-pod (anti-)affinity in-scan (ISSUE 5 tentpole #3):
+    running per-(term, domain) credit accumulators in the kernel carry
+    must reproduce the serial oracle's per-pod re-score — the drift the
+    SOFT_SCORE_CHUNK sub-batching only approximated."""
+
+    WEIGHTS = {"LeastRequestedPriority": 1, "BalancedResourceAllocation": 1,
+               "InterPodAffinityPriority": 1}
+
+    def _mk_node(self, i):
+        return api.Node(
+            metadata=api.ObjectMeta(
+                name=f"n{i}",
+                labels={api.wellknown.LABEL_HOSTNAME: f"n{i}"}),
+            status=api.NodeStatus(
+                capacity={"cpu": Quantity("16"), "memory": Quantity("32Gi"),
+                          "pods": Quantity(110)},
+                allocatable={"cpu": Quantity("16"),
+                             "memory": Quantity("32Gi"),
+                             "pods": Quantity(110)},
+                conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+    def _mk_pod(self, i):
+        group = f"g{i % 3}"
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name=f"p{i}", namespace="default",
+                                    labels={"grp": group}),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("100m"),
+                              "memory": Quantity("64Mi")}))]))
+        pod.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    api.WeightedPodAffinityTerm(
+                        weight=10,
+                        pod_affinity_term=api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"grp": group}),
+                            topology_key=api.wellknown.LABEL_HOSTNAME))]))
+        return pod
+
+    def test_preferred_anti_matches_serial_oracle(self):
+        """Identical requests across pods leave the soft credit as the
+        only score differentiator — frozen batch-start credits would
+        clump one group's pods; the in-scan accumulators must spread
+        them exactly as the serial replay does."""
+        from kubernetes_tpu.scheduler.core import BatchScheduler
+        from kubernetes_tpu.scheduler.nodeinfo import NodeInfo
+        cache = Cache()
+        infos = {}
+        for i in range(6):
+            n = self._mk_node(i)
+            cache.add_node(n)
+            infos[n.metadata.name] = NodeInfo(n)
+        sched = BatchScheduler(cache, weights=dict(self.WEIGHTS))
+        pods = [self._mk_pod(i) for i in range(15)]
+        results = sched.schedule(pods)
+        # the in-scan soft tables must actually have engaged
+        assert sched.phase_stats is not None
+        row_of = dict(sched.mirror.row_of)
+        for j, res in enumerate(results):
+            pod = res.pod
+            meta = preds.PredicateMetadata(pod, infos)
+            feasible = {nm: ni for nm, ni in infos.items()
+                        if preds.pod_fits_on_node(pod, meta, ni)[0]}
+            pmeta = prios.PriorityMetadata(pod)
+            scores = prios.prioritize_nodes(pod, pmeta, feasible,
+                                            self.WEIGHTS,
+                                            all_node_infos=infos)
+
+            def penalty(nm):
+                h = (row_of[nm] * -1640531527 + (j & 0x7FFFFFFF)
+                     * 40503) & 0xFFFF
+                return float(h) * (0.5 / 65536.0)
+            best = max(feasible,
+                       key=lambda nm: scores.get(nm, 0) - penalty(nm))
+            assert res.node_name == best, (pod.metadata.name,
+                                           res.node_name, best)
+            bound = api.serde.deepcopy_obj(pod)
+            bound.spec.node_name = best
+            cache.add_pod(bound)
+            infos[best].add_pod(bound)
+
+    def test_soft_batch_limit_lifted_for_small_unions(self):
+        from kubernetes_tpu.scheduler.core import BatchScheduler
+        cache = Cache()
+        for i in range(4):
+            cache.add_node(self._mk_node(i))
+        sched = BatchScheduler(cache, weights=dict(self.WEIGHTS))
+        sched.soft_score_chunk = 8
+        pods = [self._mk_pod(i) for i in range(24)]
+        # 3 distinct preferred terms: the in-scan tables cover the batch,
+        # so the old 256-style sub-chunking is lifted
+        assert sched.soft_batch_limit(pods) == 24
+
+    def test_soft_term_union_overflow_falls_back_chunked(self):
+        from kubernetes_tpu.scheduler.core import BatchScheduler
+        from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+        cache = Cache()
+        for i in range(4):
+            cache.add_node(self._mk_node(i))
+        sched = BatchScheduler(cache, weights=dict(self.WEIGHTS))
+        sched.sched_metrics = SchedulerMetrics()
+        sched.soft_score_chunk = 8
+        pods = []
+        for i in range(sched.SOFT_TERM_CAP + 8):
+            p = self._mk_pod(i)
+            # a distinct selector per pod blows the channel-union cap
+            p.spec.affinity.pod_anti_affinity \
+                .preferred_during_scheduling_ignored_during_execution[0] \
+                .pod_affinity_term.label_selector = api.LabelSelector(
+                    match_labels={"grp": f"u{i}"})
+            p.metadata.labels = {"grp": f"u{i}"}
+            pods.append(p)
+        assert sched.soft_batch_limit(pods) == 8
+        assert sched.sched_metrics.topo_inscan_fallbacks.value(
+            reason="soft_terms") >= 1
