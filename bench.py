@@ -582,6 +582,181 @@ def run_density_config(n_nodes, pods_per_node):
                     pass
 
 
+SERVING_NODES = int(os.environ.get("BENCH_SERVING_NODES", "200"))
+SERVING_RATES = tuple(
+    float(r) for r in
+    os.environ.get("BENCH_SERVING_RATES", "50,150").split(",") if r)
+SERVING_DURATION_S = float(os.environ.get("BENCH_SERVING_DURATION_S", "15"))
+SERVING_BATCH = int(os.environ.get("BENCH_SERVING_BATCH", "1024"))
+SERVING_CONFIG_DESC = ("apiserver + WAL + HTTP watch + hollow kubelets + "
+                       "controller manager; adaptive drain + priority "
+                       "lanes + bind backpressure")
+
+
+def serving_curve():
+    """One open-loop run per configured arrival rate — the serving
+    section both `python bench.py` and `python bench.py serving` report."""
+    import gc
+    curve = []
+    for r_ev in SERVING_RATES:
+        try:
+            curve.append(run_serving_config(SERVING_NODES, r_ev,
+                                            SERVING_DURATION_S))
+        except Exception as e:  # one rate's failure must not sink the rest
+            curve.append({"rate_events_per_s": r_ev, "error": str(e)})
+        gc.collect()
+    return {
+        "nodes": SERVING_NODES,
+        "duration_s": SERVING_DURATION_S,
+        "batch_cap": SERVING_BATCH,
+        "curve": curve,
+        "config": SERVING_CONFIG_DESC,
+    }
+
+
+def run_serving_config(n_nodes, rate, duration_s):
+    """Serving mode (ISSUE 7): open-loop Poisson churn on the WIRE config
+    — a real kube-apiserver process, hollow kubelets, the full controller
+    manager materializing Deployments/Jobs/CronJobs, and the scheduler in
+    ADAPTIVE drain mode (batch cap follows queue depth, priority lanes,
+    hub backpressure). The SLO tracker stamps created->bound->running
+    from watch events using the OBJECTS' own timestamps (observer lag is
+    never charged to the cluster) and reports per-class p50/p95/p99 at a
+    sustained arrival rate — the regime scheduler_perf's one-shot drain
+    never measures. `rate` is loadgen EVENTS/s; gangs, jobs and scale
+    deltas fan each event into 1-8 pods."""
+    from kubernetes_tpu.apiserver import HTTPClient
+    from kubernetes_tpu.controllers import ControllerManager
+    from kubernetes_tpu.node.hollow import HollowCluster
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.serving import LoadGen, SLOTracker
+    from kubernetes_tpu.utils.metrics import ServingMetrics
+
+    hollow = mgr = sched = None
+    with _SpawnedAPIServer() as hub:
+      try:
+        client = HTTPClient(hub.base)
+        hollow = HollowCluster(
+            client, n_nodes,
+            capacity={"cpu": "16", "memory": "64Gi", "pods": "110"},
+            heartbeat_period=10.0, pleg_period=0.25).start()
+        mgr = ControllerManager(client)
+        mgr.start()
+        t_setup = time.time()
+        sched = Scheduler(client, batch_size=SERVING_BATCH,
+                          adaptive_batch=True, min_batch=64)
+        sched.informers.start()
+        sched.informers.wait_for_cache_sync()
+        deadline = time.time() + 120
+        while len(sched.cache.node_names()) < n_nodes:
+            if time.time() > deadline:
+                raise RuntimeError("hollow nodes never registered")
+            time.sleep(0.25)
+        # warm every pow2 bucket the adaptive drain can pop, with
+        # spread-carrying pods (the Deployment-owned arrivals are RS
+        # spread carriers — a different kernel trace; density's lesson)
+        client.services("default").create(api.Service(
+            metadata=api.ObjectMeta(name="warm-serving",
+                                    namespace="default"),
+            spec=api.ServiceSpec(selector={"bench-warm": "serving"})))
+        from kubernetes_tpu.api.core import Service as _Svc
+        svc_inf = sched.informers.informer_for(_Svc)
+        deadline = time.time() + 30
+        while svc_inf.indexer.get_by_key("default/warm-serving") is None \
+                and time.time() < deadline:
+            time.sleep(0.05)
+
+        def warm_pod(i):
+            p = make_pod(2_000_000 + i)
+            p.metadata.labels["bench-warm"] = "serving"
+            return p
+        sched.algorithm.refresh()
+        sz = SERVING_BATCH
+        while sz >= 1:
+            sched.algorithm.schedule([warm_pod(i) for i in range(sz)])
+            sched.algorithm.mirror.invalidate_usage()
+            sz //= 2
+        _warm_dirty_scatter(sched)
+        # watch-driven SLO observation off the scheduler's own pod
+        # informer (the production watch stream)
+        serving_metrics = ServingMetrics()
+        tracker = SLOTracker(metrics=serving_metrics,
+                             use_object_timestamps=True)
+        from kubernetes_tpu.api.core import Pod as _Pod
+        sched.informers.informer_for(_Pod).add_event_handlers(
+            tracker.handlers())
+        sched.start()
+        serving_metrics.arrival_rate.set(rate)
+
+        gen = LoadGen(client, seed=int(rate), rate=rate)
+        n_events = max(1, int(rate * duration_s))
+        gen.begin(gen.make_schedule(n_events))
+        t0 = time.time()
+        while not gen.done:
+            gen.step()
+            time.sleep(0.002)
+        gen.suspend_cronjobs()
+        # convergence: the backlog drains and controller-materialized
+        # pods stop arriving — bound count stable with nothing pending
+        stable_since = None
+        last = (-1, -1)
+        deadline = time.time() + duration_s + 120
+        while time.time() < deadline:
+            cur = (len(tracker._created), len(tracker._bound))
+            if cur == last and cur[0] == cur[1] \
+                    and sched.queue.num_pending() == 0:
+                if stable_since is None:
+                    stable_since = time.time()
+                elif time.time() - stable_since >= 2.0:
+                    break
+            else:
+                stable_since = None
+                last = cur
+            time.sleep(0.1)
+        elapsed = time.time() - t0
+        report = tracker.report()
+        caps = list(sched.batch_cap_log)
+        bulk = [c for d, l, p, c in caps if l == 0 and p == 0 and d > 0]
+        classes = {}
+        for cls, entry in report["classes"].items():
+            classes[cls] = {
+                "bind_p50_s": entry["bind"]["p50_s"],
+                "bind_p99_s": entry["bind"]["p99_s"],
+                "startup_p50_s": entry.get("startup", {}).get("p50_s"),
+                "startup_p95_s": entry.get("startup", {}).get("p95_s"),
+                "startup_p99_s": entry.get("startup", {}).get("p99_s"),
+                "count": entry["bind"]["count"],
+            }
+        return {
+            "rate_events_per_s": rate,
+            "nodes": n_nodes, "events": n_events,
+            "pods_created": report["created"],
+            "pods_bound": report["bound"],
+            "pods_running": report["running"],
+            "unbound": len(tracker.unfinished()),
+            "sustained_bound_per_s": round(
+                report["bound"] / elapsed, 1) if elapsed else 0.0,
+            "window_s": round(elapsed, 2),
+            "setup_s": round(t0 - t_setup, 2),
+            "classes": classes,
+            "adaptive": {
+                "cycles": len(caps),
+                "bulk_cap_min": min(bulk) if bulk else None,
+                "bulk_cap_max": max(bulk) if bulk else None,
+                "lane_batches": sched.metrics.lane_batches.value(),
+                "backpressure_shrinks":
+                    sched.metrics.backpressure_shrinks.value(),
+            },
+        }
+      finally:
+        for comp in (sched, mgr, hollow):
+            if comp is not None:
+                try:
+                    comp.stop()
+                except Exception:
+                    pass
+
+
 def measure_device_profile(n_nodes=None, n_pods=16384, batch=16384):
     """Attribute ONE isolated batch's wall time: host launch (tensorize
     assembly + dispatch), device compute (dispatch -> packed results
@@ -970,6 +1145,11 @@ def main():
                                          DENSITY_PODS_PER_NODE)
         except Exception as e:
             density = {"error": str(e)}
+    serving = None
+    if SERVING_DURATION_S > 0 and SERVING_RATES \
+            and os.environ.get("BENCH_SERVING", "1") != "0":
+        # the p50/p99-vs-arrival-rate curve: one open-loop run per rate
+        serving = serving_curve()
     wire = None
     if WIRE_PODS > 0:
         wire_runs = []
@@ -1025,6 +1205,7 @@ def main():
                    "affinity": affinity,
                    "wire": wire,
                    "density": density,
+                   "serving": serving,
                    "parity_rate": parity_rate,
                    "parity": parity,
                    "parity_fixture": f"{PARITY_PODS}x{PARITY_NODES}",
@@ -1035,5 +1216,23 @@ def main():
     }))
 
 
+def serving_main():
+    """`bench.py serving` — just the churn section: the p50/p95/p99
+    pod-startup-latency-vs-arrival-rate curve on the wire config."""
+    detail = serving_curve()
+    curve = detail["curve"]
+    print(json.dumps({
+        "metric": "serving p50/p99 pod-startup latency vs arrival rate "
+                  f"({SERVING_NODES} nodes, {SERVING_DURATION_S}s/rate)",
+        "value": curve[-1].get("sustained_bound_per_s", 0.0)
+        if curve else 0.0,
+        "unit": "pods/s",
+        "detail": detail,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        serving_main()
+    else:
+        main()

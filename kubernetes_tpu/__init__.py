@@ -13,6 +13,7 @@ Layer map (mirrors SURVEY.md section 1):
   state/       versioned store, watch, informers, workqueue       (ref: etcd3/store.go, client-go/tools/cache)
   apiserver/   REST + watch HTTP surface, admission, registry     (ref: staging/src/k8s.io/apiserver)
   scheduler/   batched TPU scheduler: queue, cache, kernels       (ref: pkg/scheduler)
+  serving/     open-loop churn loadgen + latency-SLO harness      (ref: perf-tests/clusterloader2 shape)
   controllers/ async reconcilers                                  (ref: pkg/controller)
   nodeagent/   kubelet-equivalent node agent (hollow-capable)     (ref: pkg/kubelet, pkg/kubemark)
   cli/         kubectl-subset command line                        (ref: pkg/kubectl)

@@ -24,8 +24,9 @@ from ..api import labels as labelsmod
 from ..api import serde
 from ..api.meta import LabelSelector
 from ..runtime.scheme import SCHEME, Scheme
-from ..state.store import (AlreadyExistsError, ConflictError, ExpiredError,
-                           NotFoundError, SlimBindRef, WatchEvent)
+from ..state.store import (BOOKMARK, AlreadyExistsError, ConflictError,
+                           ExpiredError, NotFoundError, SlimBindRef,
+                           WatchEvent)
 from ..utils.metrics import Counter
 
 #: terminal watch-stream errors by (resource, reason) — the TRANSPORT
@@ -135,12 +136,24 @@ class _HTTPWatch:
                 line = line.strip()
                 if not line:
                     continue
+                frame = json.loads(line)
+                if frame.get("type") == "BOOKMARK":
+                    # negotiated heartbeat carrying the server's current
+                    # rv: advances the consumer's resume point through
+                    # quiet periods. NOT an object event — it bypasses
+                    # the injected drop budget (wire-chaos watch plans
+                    # are keyed to real event counts, and a wall-clock-
+                    # timed heartbeat must not perturb them).
+                    rv = int(frame.get("rv") or 0)
+                    if rv:
+                        self.last_rv = rv
+                        self.events.put(WatchEvent(BOOKMARK, None, rv))
+                    continue
                 if self._drop_after is not None \
                         and delivered >= self._drop_after:
                     raise ConnectionResetError(
                         "injected watch drop "
                         f"(after {delivered} events)")
-                frame = json.loads(line)
                 slim = frame.get("slim")
                 if slim == "bind" or slim == "binds":
                     # negotiated compact bind frame(s): the informer
@@ -437,13 +450,20 @@ class HTTPResourceClient:
     _SLIM_WATCH = False
 
     def watch(self, namespace: Optional[str] = None,
-              resource_version: Optional[int] = None) -> _HTTPWatch:
+              resource_version: Optional[int] = None,
+              bookmarks: bool = False) -> _HTTPWatch:
         ns = namespace if namespace is not None else (self._ns or None)
         query = "watch=true"
         if resource_version is not None:
             query += f"&resourceVersion={resource_version}"
         if self._SLIM_WATCH:
             query += "&slimBind=true"
+        if bookmarks:
+            # opt-in BOOKMARK heartbeats (the reference's
+            # allowWatchBookmarks): raw consumers that iterate events
+            # must be ready for object-less frames, so informers — which
+            # track last_sync_rv — are the ones that ask
+            query += "&allowWatchBookmarks=true"
         url = self._url(namespace=ns or "", query=query)
         drop_after = None
         if self._wire_hook is not None:

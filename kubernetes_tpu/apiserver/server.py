@@ -37,8 +37,8 @@ from .admission import QuotaExceeded
 from ..api.validation import ValidationError
 from ..runtime.scheme import SCHEME, Scheme
 from ..state.client import Client, TooManyDisruptions
-from ..state.store import (MODIFIED, AlreadyExistsError, ConflictError,
-                           ExpiredError, NotFoundError, Store)
+from ..state.store import (BOOKMARK, MODIFIED, AlreadyExistsError,
+                           ConflictError, ExpiredError, NotFoundError, Store)
 
 
 class AdmissionDenied(Exception):
@@ -1193,6 +1193,13 @@ class APIServer:
         # {"slim":"bind", ...} frames it applies to its cached copy —
         # no full-object encode here, no full decode there
         slim_ok = req.query.get("slimBind") in ("true", "1")
+        # negotiated watch bookmarks (ref: allowWatchBookmarks): opted-in
+        # clients receive the heartbeat as a BOOKMARK frame carrying the
+        # store's CURRENT resourceVersion, so an idle consumer's resume
+        # point keeps pace with other resources' churn instead of aging
+        # out of the bounded history window (the 410-relist after a quiet
+        # period). Non-negotiating clients keep the bare-line heartbeat.
+        bookmarks_ok = req.query.get("allowWatchBookmarks") in ("true", "1")
         watch = self.store.watch(req.resource, req.namespace or None,
                                  int(rv) if rv else None)
         h.send_response(200)
@@ -1208,14 +1215,29 @@ class APIServer:
         import queue as queue_mod
         try:
             while True:
+                # bookmark rv snapshot BEFORE the blocking get: the store
+                # assigns rv and enqueues the event in one locked section,
+                # so every event with rv <= this snapshot is already in
+                # the queue — an Empty after the wait proves the client
+                # has (been sent) all of them and the snapshot is a safe
+                # resume point. Reading the rv AFTER the timeout could
+                # advertise an rv whose event is still queued here; a
+                # resume at that rv would skip the event forever.
+                bm_rv = self.store.resource_version if bookmarks_ok else 0
                 try:
                     ev = watch.events.get(timeout=1.0)
                 except queue_mod.Empty:
-                    # heartbeat (the reference's watch BOOKMARK): keeps the
-                    # client's blocking read turning over so a stopped
-                    # client can notice and close from its OWN thread —
-                    # closing an http response cross-thread deadlocks
-                    write_chunk(b"\n")
+                    # heartbeat: keeps the client's blocking read turning
+                    # over so a stopped client can notice and close from
+                    # its OWN thread — closing an http response
+                    # cross-thread deadlocks. Bookmark-negotiated streams
+                    # ride the pre-wait rv snapshot on it.
+                    if bookmarks_ok:
+                        write_chunk(
+                            json.dumps({"type": BOOKMARK, "rv": bm_rv})
+                            .encode() + b"\n")
+                    else:
+                        write_chunk(b"\n")
                     continue
                 if ev is None:
                     break

@@ -83,6 +83,59 @@ _RESTART_ACTIONS = (("restart_scheduler", 0.05),
                     ("restart_store", 0.03))
 
 
+def informers_current(admin, factories, classes) -> bool:
+    """True when every ALREADY-CREATED informer for `classes` in each
+    factory mirrors the store exactly. Informers a factory never created
+    are skipped — probing with informer_for would lazily CREATE and
+    START streams the owning component never reads (and re-create them
+    after every restart), enlarging the wire fault surface."""
+    store = admin.store
+    for fac in factories:
+        with fac._lock:
+            informers = dict(fac._informers)
+        for cls in classes:
+            inf = informers.get(cls)
+            if inf is None:
+                continue
+            resource = admin.scheme.resource_for(cls)
+            items, _ = store.list(resource)
+            want = {o.metadata.key(): o.metadata.resource_version
+                    for o in items}
+            have = {o.metadata.key(): o.metadata.resource_version
+                    for o in inf.indexer.list()}
+            if want != have:
+                return False
+    return True
+
+
+def settle_informers(admin, factories, classes, injector,
+                     timeout: float = 10.0, logger_name: str = "chaos",
+                     step=None) -> bool:
+    """Wait (REAL time) until informers_current holds twice in a row —
+    the second check lets the last event's handler dispatch finish, so
+    control-loop inputs are identical across same-seed runs. On timeout
+    the next control loop runs on stale indexers and the run's event log
+    may diverge; the log is stamped so a determinism failure points at
+    the starved informer thread, not the harness logic."""
+    deadline = time.time() + timeout
+    streak = 0
+    while time.time() < deadline:
+        if informers_current(admin, factories, classes):
+            streak += 1
+            if streak >= 2:
+                return True
+            time.sleep(0.002)
+        else:
+            streak = 0
+            time.sleep(0.002)
+    import logging
+    logging.getLogger(logger_name).warning(
+        "informers failed to settle within %.1fs at step %s",
+        timeout, step)
+    injector.record("settle_timeout")
+    return False
+
+
 @dataclass
 class ChaosReport:
     seed: int
@@ -514,44 +567,13 @@ class ChaosHarness:
 
     # ------------------------------------------------------------ settle
 
-    def _informers_current(self) -> bool:
-        from ..api.core import Node as NodeCls, Pod as PodCls
-        store = self.admin.store
-        for cls in (PodCls, NodeCls, PodGroup):
-            resource = self.admin.scheme.resource_for(cls)
-            items, _ = store.list(resource)
-            want = {o.metadata.key(): o.metadata.resource_version
-                    for o in items}
-            for fac in self._factories():
-                inf = fac.informer_for(cls)
-                have = {o.metadata.key(): o.metadata.resource_version
-                        for o in inf.indexer.list()}
-                if want != have:
-                    return False
-        return True
-
     def _settle(self, timeout: float = 10.0) -> None:
-        """Wait (REAL time) until every informer indexer mirrors the
-        store, twice in a row — the second check lets the last event's
-        handler dispatch finish, so control-loop inputs are identical
-        across runs and the fault oracle sees identical call streams."""
-        deadline = time.time() + timeout
-        streak = 0
-        while time.time() < deadline:
-            if self._informers_current():
-                streak += 1
-                if streak >= 2:
-                    return
-                time.sleep(0.002)
-            else:
-                streak = 0
-                time.sleep(0.002)
-        # timed out: the next control loop runs on stale indexers, so
-        # this run's call stream — and event log — may diverge from a
-        # same-seed run. Stamp the log so a determinism failure points
-        # HERE (starved informer thread) and not at the harness logic.
-        import logging
-        logging.getLogger("chaos").warning(
-            "informers failed to settle within %.1fs at step %d",
-            timeout, self.injector.step)
-        self.injector.record("settle_timeout")
+        """The shared settling contract (see settle_informers) over the
+        chaos harness's resource classes — control-loop inputs must be
+        identical across runs so the fault oracle sees identical call
+        streams."""
+        from ..api.core import Node as NodeCls, Pod as PodCls
+        settle_informers(self.admin, self._factories(),
+                         (PodCls, NodeCls, PodGroup), self.injector,
+                         timeout=timeout, logger_name="chaos",
+                         step=self.injector.step)

@@ -76,6 +76,25 @@ class SchedulerMetrics:
             "scheduler_topo_inscan_fallbacks_total",
             "Batches that fell back from the in-scan topology/soft-credit "
             "tables, by reason")
+        # serving-mode adaptive drain: the batch cap the sizing policy
+        # chose per cycle (grows with queue depth, shrinks under commit/
+        # bind backpressure or a priority-lane express batch)
+        self.adaptive_batch_cap = r.histogram(
+            "scheduler_adaptive_batch_cap",
+            "Adaptive drain batch cap chosen per cycle (serving mode)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                     4096, 8192, 16384))
+        # express batches popped for the high-priority lane, and cycles
+        # shrunk because the hub-side commit/bind stages were backed up
+        self.lane_batches = r.counter(
+            "scheduler_priority_lane_batches_total",
+            "Drain cycles sized to the high-priority lane cohort's "
+            "bucket (floored at min_batch, so a tiny lane pops with "
+            "bulk pods behind it)")
+        self.backpressure_shrinks = r.counter(
+            "scheduler_backpressure_shrinks_total",
+            "Drain cycles whose batch cap was shrunk by bind/commit "
+            "backpressure")
 
     def observe_queue(self, queue) -> None:
         """Sample the three sub-queue depths (PendingPods gauges)."""

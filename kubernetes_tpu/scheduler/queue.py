@@ -26,6 +26,17 @@ parked member inside the same add() critical section, so the next
 pop_batch drains the whole gang as one batch. Parked members older than
 the park timeout cycle through the unschedulable/backoff machinery (the
 slow-path re-evaluation for PodGroups whose spec changed).
+
+Release ordering contract: EVERY path that returns a held pod to the
+active heap — backoff expiry, unschedulable flush, gang park release,
+move-all events — re-sorts it by (priority, arrival) at release time
+(`_push_active` recomputes the pod's CURRENT priority and keeps its
+original arrival timestamp), so a released gang can never pop ahead of a
+newer higher-priority singleton, and a priority raised while a pod was
+held is honored the moment it re-enters the heap. The serving-mode
+priority lane reads the same invariant: `lane_depth`/`top_priority` are
+maintained per-priority counts of the live heap, so the drain can size an
+express batch as exactly the high-priority cohort at the heap's top.
 """
 
 from __future__ import annotations
@@ -71,11 +82,16 @@ class PodBackoffMap:
 
 
 class _PodInfo:
-    __slots__ = ("pod", "timestamp", "attempts")
+    __slots__ = ("pod", "timestamp", "unsched_since")
 
     def __init__(self, pod: Pod, timestamp: float):
         self.pod = pod
         self.timestamp = timestamp
+        #: when the pod entered unschedulableQ (None while elsewhere);
+        #: the flush-leftover timer measures THIS stay, not queue age —
+        #: keying it to the original enqueue time released long-queued
+        #: pods instantly instead of parking them the full interval
+        self.unsched_since: Optional[float] = None
 
 
 class NominatedPodMap:
@@ -147,6 +163,9 @@ class SchedulingQueue:
         # repoints this, turning the old tuple into a skipped stale entry
         # (ref: activeQ.Update reorders the heap, scheduling_queue.go:268)
         self._active_entry: Dict[str, Tuple[int, float, int, str]] = {}
+        #: live-heap census by priority (stale heap entries excluded):
+        #: the serving drain reads it to size priority-lane batches
+        self._prio_counts: Dict[int, int] = {}
         self._in_backoff: set = set()
         #: gang-parked pods: pending (in _pod_info) but held off the active
         #: heap until their PodGroup reaches minMember (scheduler/gang.py)
@@ -157,6 +176,8 @@ class SchedulingQueue:
         self.nominated = NominatedPodMap()
         self._scheduling_cycle = 0
         self._move_request_cycle = -1
+        #: last clock instant the lazy flush ran (see _flush_locked)
+        self._last_flush_now: Optional[float] = None
         self._closed = False
 
     # ----------------------------------------------------------- feeding
@@ -229,7 +250,7 @@ class SchedulingQueue:
                         helpers.pod_priority(new) != old_prio:
                     # re-heapify: stale entry is invalidated by repointing
                     # _active_entry (ref: activeQ.Update reorders the heap)
-                    self._in_active.discard(key)
+                    self._drop_active(key)
                     self._push_active(key, info)
                     self._cond.notify_all()
             else:
@@ -240,8 +261,7 @@ class SchedulingQueue:
             key = pod.metadata.key()
             self._pod_info.pop(key, None)
             self._unschedulable.pop(key, None)
-            self._in_active.discard(key)
-            self._active_entry.pop(key, None)
+            self._drop_active(key)
             self._in_backoff.discard(key)
             self._parked.pop(key, None)
             if self.gang is not None:
@@ -250,13 +270,35 @@ class SchedulingQueue:
             self.backoff_map.clear(key)
 
     def _push_active(self, key: str, info: _PodInfo) -> None:
+        """(Re)enter the active heap sorted by (priority, arrival): the
+        pod's CURRENT priority is read here — at release time, for held
+        pods — and its arrival timestamp is preserved, so backoff/park
+        release can never order a stale cohort ahead of a newer
+        higher-priority pod."""
         if key in self._in_active:
             return
+        info.unsched_since = None
         prio = helpers.pod_priority(info.pod)
         entry = (-prio, info.timestamp, next(self._seq), key)
         heapq.heappush(self._active, entry)
         self._active_entry[key] = entry
         self._in_active.add(key)
+        self._prio_counts[prio] = self._prio_counts.get(prio, 0) + 1
+
+    def _drop_active(self, key: str) -> None:
+        """Remove a pod from the live-heap census; its heap entry goes
+        stale (skipped at pop by the _active_entry identity check)."""
+        if key not in self._in_active:
+            return
+        self._in_active.discard(key)
+        entry = self._active_entry.pop(key, None)
+        if entry is not None:
+            prio = -entry[0]
+            n = self._prio_counts.get(prio, 0) - 1
+            if n > 0:
+                self._prio_counts[prio] = n
+            else:
+                self._prio_counts.pop(prio, None)
 
     # ----------------------------------------------------------- popping
 
@@ -302,8 +344,7 @@ class SchedulingQueue:
                 if key not in self._in_active or \
                         self._active_entry.get(key) is not entry:
                     continue  # stale entry (pod deleted or re-prioritized)
-                self._in_active.discard(key)
-                del self._active_entry[key]
+                self._drop_active(key)
                 info = self._pod_info.get(key)
                 if info is None:
                     continue
@@ -352,6 +393,7 @@ class SchedulingQueue:
             if self._move_request_cycle >= pod_scheduling_cycle:
                 self._push_backoff(key)
             else:
+                info.unsched_since = self._clock.now()
                 self._unschedulable[key] = info
             self._gang_notify_locked(pod)
             self._cond.notify_all()
@@ -381,8 +423,16 @@ class SchedulingQueue:
 
     def _flush_locked(self) -> None:
         """flushBackoffQCompleted (1s ticker) + flushUnschedulableQLeftover
-        (30s ticker) collapsed into lazy flushing at pop time."""
+        (30s ticker) collapsed into lazy flushing at pop time. Idempotent
+        per clock instant: every hold created at time T expires strictly
+        after T (backoff >= +1s, unschedulable +60s, park +PARK_TIMEOUT),
+        so a repeat flush at the same `now` can release nothing — skipped,
+        which spares the adaptive drain's drain_stats+pop_batch pair the
+        second O(unschedulable) scan per cycle."""
         now = self._clock.now()
+        if now == self._last_flush_now:
+            return
+        self._last_flush_now = now
         while self._backoff and self._backoff[0][0] <= now:
             _, _, key = heapq.heappop(self._backoff)
             if key not in self._in_backoff:
@@ -392,7 +442,9 @@ class SchedulingQueue:
             if info is not None:
                 self._push_active(key, info)
         for key, info in list(self._unschedulable.items()):
-            if now - info.timestamp >= DEFAULT_UNSCHEDULABLE_DURATION:
+            since = info.unsched_since if info.unsched_since is not None \
+                else info.timestamp
+            if now - since >= DEFAULT_UNSCHEDULABLE_DURATION:
                 del self._unschedulable[key]
                 if self.backoff_map.backoff_time(key) > now:
                     self._push_backoff(key)
@@ -417,6 +469,44 @@ class SchedulingQueue:
     def num_pending(self) -> int:
         with self._lock:
             return len(self._pod_info)
+
+    # ------------------------------------------------ lane introspection
+
+    def active_depth(self) -> int:
+        """Pods poppable RIGHT NOW (expired backoff/unschedulable holds
+        are flushed first) — the queue-depth signal the serving drain's
+        adaptive batch sizing reads."""
+        with self._lock:
+            self._flush_locked()
+            return len(self._in_active)
+
+    def lane_depth(self, min_priority: int) -> int:
+        """How many poppable pods sit at/above `min_priority` — the
+        express-lane cohort size. They are by construction the heap's
+        top, so a pop of at least this many always drains the whole
+        lane first (a cap floored above the cohort pops bulk pods
+        behind it in the same batch)."""
+        with self._lock:
+            self._flush_locked()
+            return sum(n for p, n in self._prio_counts.items()
+                       if p >= min_priority)
+
+    def drain_stats(self, min_priority: int) -> Tuple[int, int]:
+        """(active_depth, lane_depth) under ONE lock with ONE lazy
+        flush — the adaptive drain reads both every cycle, and separate
+        calls would repeat the O(unschedulable) flush scan on the hot
+        path."""
+        with self._lock:
+            self._flush_locked()
+            lane = sum(n for p, n in self._prio_counts.items()
+                       if p >= min_priority)
+            return len(self._in_active), lane
+
+    def top_priority(self) -> Optional[int]:
+        """Highest priority among poppable pods (None when idle)."""
+        with self._lock:
+            self._flush_locked()
+            return max(self._prio_counts) if self._prio_counts else None
 
     def close(self) -> None:
         with self._cond:

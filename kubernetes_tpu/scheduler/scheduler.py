@@ -34,6 +34,16 @@ from .core import BatchScheduler, ScheduleResult
 from .queue import SchedulingQueue
 
 DEFAULT_BATCH_SIZE = 1024
+#: pods at/above this priority ride the serving drain's express lane
+#: (ref: the reference's PriorityClass values; user classes sit well
+#: below the 2e9 system band — 1000 marks "interactive" by convention)
+DEFAULT_LANE_PRIORITY = 1000
+#: adaptive sizing never shrinks the drain below this (tiny batches
+#: thrash the launch/commit fixed costs without helping latency)
+MIN_ADAPTIVE_BATCH = 64
+#: bulk-bind POSTs allowed in flight before the drain blocks on the
+#: oldest — the bounded hub<->scheduler bind pipeline (serving mode)
+MAX_INFLIGHT_BINDS = 2
 
 
 class Scheduler:
@@ -44,7 +54,11 @@ class Scheduler:
                  clock: Clock = REAL_CLOCK,
                  disable_preemption: bool = False,
                  framework=None, extenders=None, metrics=None,
-                 mesh=None, async_bind: Optional[bool] = None):
+                 mesh=None, async_bind: Optional[bool] = None,
+                 adaptive_batch: Optional[bool] = None,
+                 min_batch: int = MIN_ADAPTIVE_BATCH,
+                 lane_priority: int = DEFAULT_LANE_PRIORITY,
+                 max_inflight_binds: int = MAX_INFLIGHT_BINDS):
         from .framework import Framework
         from .metrics import SchedulerMetrics
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
@@ -97,6 +111,33 @@ class Scheduler:
         #: split pops at power-of-two boundaries when the scan pad would
         #: exceed 25% (see drain_pipelined); KTPU_ALIGN_SPLIT=0 disables
         self._align_split = _os.environ.get("KTPU_ALIGN_SPLIT", "1") != "0"
+        # ---- serving-mode drain policy (adaptive batching + lanes) ----
+        #: adaptive sizing: batch cap follows queue depth (small when
+        #: shallow so interactive pods never wait out a mega-drain, full
+        #: batch_size when deep), priority-lane cohorts pop as their own
+        #: express batch, and hub backpressure halves the cap. OFF by
+        #: default: one-shot drains keep the fixed batch_size (decision
+        #: parity with the oracle benches). KTPU_ADAPTIVE_BATCH overrides.
+        if adaptive_batch is None:
+            adaptive_batch = _os.environ.get(
+                "KTPU_ADAPTIVE_BATCH", "0") != "0"
+        self.adaptive_batch = bool(adaptive_batch)
+        self.min_batch = max(1, min(min_batch, batch_size))
+        self.lane_priority = lane_priority
+        self.max_inflight_binds = max(1, max_inflight_binds)
+        #: (queue_depth, lane_depth, pressure, cap) per sized cycle —
+        #: the serving smoke asserts caps are monotone in depth off this
+        from collections import deque as _dq
+        self.batch_cap_log = _dq(maxlen=4096)
+        #: bulk-bind POSTs currently in flight (binder threads); beyond
+        #: max_inflight_binds the drain BLOCKS on the oldest instead of
+        #: queueing unboundedly — and the count is the backpressure
+        #: signal the adaptive cap reads
+        self._binds_inflight = 0
+        #: True while the pipelined commit stage was still running when
+        #: its successor batch finished the device scan — the commit
+        #: thread's shrink signal to the drain
+        self._commit_lagging = False
         self.cache = Cache(clock=clock)
         self.queue = SchedulingQueue(clock=clock)
         self.informers = informer_factory or SharedInformerFactory(client)
@@ -357,6 +398,62 @@ class Scheduler:
 
     # ------------------------------------------------------ scheduling
 
+    def _backpressure(self) -> int:
+        """Units of downstream backlog the drain should respond to: each
+        unit halves the adaptive batch cap. Sources: bulk-bind POSTs in
+        flight beyond the first (the hub is chewing older transactions),
+        and a pipelined commit stage that was still running when its
+        successor's device scan finished."""
+        with self._count_lock:
+            p = max(0, self._binds_inflight - 1)
+        if self._commit_lagging:
+            p += 1
+        return p
+
+    def _drain_cap(self) -> int:
+        """The serving drain's per-cycle batch cap (fixed batch_size when
+        adaptive sizing is off — the one-shot-drain default):
+
+          - grows with queue depth, rounded UP to the next power of two
+            (reusing compiled kernel buckets), clamped to
+            [min_batch, batch_size] — a shallow queue gets a small batch
+            whose commit an interactive pod never waits long on, a deep
+            one gets the full throughput batch;
+          - when ANY pods at/above lane_priority are queued, the cap is
+            the LANE cohort's bucket: the heap's top is exactly those
+            pods, so the next pop is an express batch and high-priority
+            arrivals jump ahead of the bulk drain instead of riding a
+            16k batch's tail (an all-priority queue is one big express
+            cohort — sized by its depth, never split by pressure);
+          - each unit of bind/commit backpressure halves a bulk cap
+            (never an express cap — urgency wins over pacing)."""
+        if not self.adaptive_batch:
+            return self.batch_size
+        depth, lane = self.queue.drain_stats(self.lane_priority)
+        if depth == 0:
+            # idle wakeup (or a blocking pop about to wait): nothing to
+            # size — return the floor WITHOUT recording, so idle polls
+            # don't pollute the cap histogram/log. A burst landing during
+            # the blocking wait drains its head as this small batch
+            # (lowest latency for the first arrivals, by design) and the
+            # next cycle sizes against the now-visible depth.
+            return self.min_batch
+        pressure = self._backpressure()
+        is_lane = lane > 0
+        cap = lane if is_lane else depth
+        cap = 1 << max(0, cap - 1).bit_length()
+        cap = max(self.min_batch, min(self.batch_size, cap))
+        if is_lane:
+            self.metrics.lane_batches.inc()
+        elif pressure:
+            shrunk = max(self.min_batch, cap >> pressure)
+            if shrunk < cap:
+                self.metrics.backpressure_shrinks.inc()
+            cap = shrunk
+        self.metrics.adaptive_batch_cap.observe(cap)
+        self.batch_cap_log.append((depth, lane, pressure, cap))
+        return cap
+
     def schedule_pending(self, max_pods: Optional[int] = None,
                          timeout: float = 0.0) -> List[ScheduleResult]:
         """One scheduling cycle: drain a batch and decide it. Returns the
@@ -365,7 +462,8 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle
         def _mark_in_flight(n: int) -> None:
             self._in_flight = n
-        pods = self.queue.pop_batch(max_pods or self.batch_size, timeout=timeout,
+        pods = self.queue.pop_batch(max_pods or self._drain_cap(),
+                                    timeout=timeout,
                                     on_pop=_mark_in_flight)
         if not pods:
             return []
@@ -533,7 +631,7 @@ class Scheduler:
                 if carry:
                     pods, carry = carry, []
                 else:
-                    pods = self.queue.pop_batch(self.batch_size, timeout=0,
+                    pods = self.queue.pop_batch(self._drain_cap(), timeout=0,
                                                 on_pop=_mark)
                 if pods:
                     # spread-carrying pods schedule in sub-chunks so their
@@ -602,6 +700,7 @@ class Scheduler:
                     commit_fut.result()
                 except Exception:
                     pass
+            self._commit_lagging = False
             with self._count_lock:
                 self._in_flight = 0
         with self._count_lock:
@@ -613,6 +712,12 @@ class Scheduler:
         PREDECESSOR's commit is joined first: this batch's repair
         validates against its final winners and losses."""
         import time as _time
+        # commit thread -> drain signal: a stage still running when its
+        # successor's scan finished means the hub side is the bottleneck
+        # — the adaptive cap halves the next bulk batch until it catches
+        # up (cleared here on a caught-up stage and on drain exit)
+        self._commit_lagging = commit_fut is not None \
+            and not commit_fut.done()
         if commit_fut is not None:
             commit_fut.result()
         if pending.chained:
@@ -928,15 +1033,29 @@ class Scheduler:
 
         def job():
             t0 = _time.perf_counter()
-            outs = self._bind_items_with_retry(items)
-            self.metrics.binding_duration.observe(_time.perf_counter() - t0)
-            self._reconcile_bind_outcomes(pairs, outs)
-        fut = self._bind_pool.submit(job)
-        # prune settled futures so the service-mode run loop (which never
-        # calls _flush_binds between cycles) doesn't grow this unboundedly
+            try:
+                outs = self._bind_items_with_retry(items)
+                self.metrics.binding_duration.observe(
+                    _time.perf_counter() - t0)
+                self._reconcile_bind_outcomes(pairs, outs)
+            finally:
+                with self._count_lock:
+                    self._binds_inflight -= 1
+        # prune settled futures, then BOUND the in-flight POSTs: at the
+        # bound the drain blocks on the oldest transaction instead of
+        # queueing binds unboundedly in the pool — the hub's backlog
+        # becomes the drain's pacing (and _backpressure's shrink signal)
         self._bind_futures = [f for f in self._bind_futures
                               if not f.done()]
-        self._bind_futures.append(fut)
+        while len(self._bind_futures) >= self.max_inflight_binds:
+            oldest = self._bind_futures.pop(0)
+            try:
+                oldest.result()
+            except Exception:
+                pass
+        with self._count_lock:
+            self._binds_inflight += 1
+        self._bind_futures.append(self._bind_pool.submit(job))
         return n_assumed
 
     def _bind_items_with_retry(self, items) -> list:

@@ -1,0 +1,372 @@
+"""ServingHarness — the open-loop churn soak over the live control plane.
+
+Stands up scheduler + workload controllers (Deployment, ReplicaSet, Job,
+CronJob) + virtual kubelets against one store on a shared FakeClock, and
+drives a seeded LoadGen schedule through them synchronously — the chaos
+harness's determinism recipe (settle informers between steps, every
+control loop stepped from the single driver thread) applied to sustained
+load instead of faults. Two runs with one seed produce the identical
+arrival log AND bind event log, which is what makes a latency SLO
+assertable in tier-1.
+
+The scheduler runs the SERVING drain policy: adaptive batch sizing
+(`adaptive_batch=True` — cap follows queue depth), priority lanes
+(`priority`-class arrivals pop as small express batches), and hub
+backpressure. `batch_cap_log` lands in the report so tests can assert the
+sizing policy's shape.
+
+Chaos composition (the `-m slow` soak): the same FaultInjector the chaos
+harness uses rides the control plane's client — API error rates in-process,
+or wire latency/resets/watch-drops in `http=True` mode — plus
+`restart_scheduler()` mid-run. The InvariantChecker sweeps the settled end
+state, and `stuck` lists any arrived pod that never bound and never went
+terminal: under churn + faults the liveness bar is "every pod eventually
+binds or terminally fails", not a latency number.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.core import Node, NodeCondition, Pod
+from ..api.batch import CronJob, Job
+from ..api.apps import Deployment, ReplicaSet
+from ..api.meta import ObjectMeta
+from ..api.quantity import Quantity
+from ..api.scheduling import PodGroup
+from ..chaos.harness import settle_informers
+from ..chaos.injector import ChaosClient, ChaosHTTPClient, FaultInjector
+from ..chaos.invariants import InvariantChecker
+from ..controllers.cronjob import CronJobController
+from ..controllers.deployment import DeploymentController
+from ..controllers.job import JobController
+from ..controllers.replicaset import ReplicaSetController
+from ..scheduler.scheduler import DEFAULT_LANE_PRIORITY, Scheduler
+from ..state.client import Client
+from ..state.informer import SharedInformerFactory
+from ..state.store import NotFoundError, Store
+from ..utils.clock import FakeClock, now_iso
+from ..utils.metrics import RobustnessMetrics, ServingMetrics
+from .loadgen import CLASS_LABEL, LoadGen
+from .slo import SLOTracker
+
+
+@dataclass
+class ServingReport:
+    seed: int
+    ticks: int = 0
+    #: the loadgen's applied-arrival log — identical across same-seed runs
+    arrival_log: List[Tuple] = field(default_factory=list)
+    #: the SLO tracker's bind observations — same determinism contract
+    bind_log: List[Tuple] = field(default_factory=list)
+    #: (queue_depth, lane_depth, pressure, cap) per sized drain cycle
+    batch_caps: List[Tuple] = field(default_factory=list)
+    slo: dict = field(default_factory=dict)
+    violations: List[str] = field(default_factory=list)
+    #: arrived-but-never-bound, non-terminal pods after quiescence
+    stuck: List[str] = field(default_factory=list)
+    pods_bound: int = 0
+    scheduler_restarts: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.stuck
+
+
+class ServingHarness:
+    def __init__(self, seed: int = 0, nodes: int = 8, rate: float = 20.0,
+                 mix=None, tick_s: float = 1.0,
+                 batch_size: int = 256, min_batch: int = 8,
+                 lane_priority: int = DEFAULT_LANE_PRIORITY,
+                 job_run_ticks: int = 2,
+                 node_cpu: str = "8", node_mem: str = "32Gi",
+                 http: bool = False,
+                 error_rate: float = 0.0,
+                 reset_rate: float = 0.0,
+                 latency_rate: float = 0.0,
+                 latency_max: float = 0.002,
+                 watch_drop_rate: float = 0.0):
+        self.seed = seed
+        self.n_nodes = nodes
+        self.tick_s = tick_s
+        self.job_run_ticks = job_run_ticks
+        self.node_cpu = node_cpu
+        self.node_mem = node_mem
+        self.http = http
+        self.clock = FakeClock()
+        self.metrics = RobustnessMetrics()
+        self.serving_metrics = ServingMetrics()
+        self.injector = FaultInjector(
+            seed=seed, error_rate=error_rate, metrics=self.metrics,
+            reset_rate=reset_rate, latency_rate=latency_rate,
+            latency_max=latency_max, watch_drop_rate=watch_drop_rate)
+        store = Store()
+        #: fault-free admin view: workload creation (the loadgen) and
+        #: virtual-kubelet writes stay stable so the run's INPUT is a
+        #: pure function of the seed; only the control plane's handling
+        #: of load (and faults) is under test
+        self.admin = Client(store)
+        self._server = None
+        if http:
+            from ..apiserver.httpclient import HTTPClient
+            from ..apiserver.server import APIServer
+            self._server = APIServer(store=store).start()
+            self.client = ChaosHTTPClient(
+                self.injector,
+                HTTPClient(self._server.address,
+                           wire_hook=self.injector.make_wire_hook()))
+        else:
+            self.client = ChaosClient(self.injector, store=store)
+        self.factory = SharedInformerFactory(self.client)
+        self._sched_factory = SharedInformerFactory(self.client)
+        self.batch_size = batch_size
+        self.min_batch = min_batch
+        self.lane_priority = lane_priority
+        self.scheduler = self._build_scheduler(self._sched_factory)
+        self._build_controllers(self.factory)
+        self.loadgen = LoadGen(self.admin, seed=seed, rate=rate, mix=mix,
+                               clock=self.clock,
+                               lane_priority=lane_priority)
+        self.serving_metrics.arrival_rate.set(rate)
+        self.tracker = SLOTracker(clock=self.clock,
+                                  metrics=self.serving_metrics)
+        self._running_since: Dict[str, int] = {}
+        self._tick_idx = 0
+        self._started = False
+        #: swallow control-loop exceptions only when the run actually
+        #: injects faults (or rides a real wire) — a FAULT-FREE in-process
+        #: run must fail fast at the real error, not minutes later as
+        #: "stuck pods" with no traceback
+        self._swallow_errors = http or any(
+            r > 0 for r in (error_rate, reset_rate, latency_rate,
+                            watch_drop_rate))
+        #: carried across scheduler restarts (the log lives on the shell)
+        self._batch_caps: List[Tuple] = []
+
+    # ------------------------------------------------------------ build
+
+    def _build_scheduler(self, factory: SharedInformerFactory) -> Scheduler:
+        # async_bind=False: the driver steps synchronously — binder-thread
+        # timing would break the identical-bind-log contract
+        return Scheduler(self.client, informer_factory=factory,
+                         batch_size=self.batch_size, clock=self.clock,
+                         async_bind=False, adaptive_batch=True,
+                         min_batch=self.min_batch,
+                         lane_priority=self.lane_priority)
+
+    def _build_controllers(self, factory: SharedInformerFactory) -> None:
+        self.deployments = DeploymentController(self.client, factory)
+        self.replicasets = ReplicaSetController(self.client, factory)
+        self.jobs = JobController(self.client, factory)
+        self.cronjobs = CronJobController(self.client, factory,
+                                          clock=self.clock)
+
+    def _factories(self) -> List[SharedInformerFactory]:
+        return [self.factory, self._sched_factory]
+
+    def start(self) -> None:
+        if self._started:
+            return
+        for i in range(self.n_nodes):
+            alloc = {"cpu": Quantity(self.node_cpu),
+                     "memory": Quantity(self.node_mem),
+                     "pods": Quantity("110")}
+            node = Node(metadata=ObjectMeta(name=f"node-{i}"))
+            node.status.capacity = dict(alloc)
+            node.status.allocatable = dict(alloc)
+            node.status.conditions = [NodeCondition(
+                type="Ready", status="True", reason="KubeletReady",
+                last_heartbeat_time=now_iso(self.clock))]
+            self.admin.nodes().create(node)
+        for fac in self._factories():
+            fac.start()
+            fac.wait_for_cache_sync()
+        self._settle()
+        self._started = True
+
+    def close(self) -> None:
+        for fac in self._factories():
+            fac.stop()
+        if self._server is not None:
+            self._server.stop()
+        self.admin.store.close()
+
+    def restart_scheduler(self) -> None:
+        """Crash-replace the scheduler mid-churn: cache, assumed pods and
+        adaptive-drain state die with it; the replacement rebuilds from a
+        fresh informer sync while arrivals keep coming."""
+        self.injector.record("restart_scheduler")
+        self._batch_caps.extend(self.scheduler.batch_cap_log)
+        self._sched_factory.stop()
+        self.scheduler.crash()
+        self._sched_factory = SharedInformerFactory(self.client)
+        self.scheduler = self._build_scheduler(self._sched_factory)
+        self._sched_factory.start()
+        self._sched_factory.wait_for_cache_sync()
+        self._settle()
+
+    # -------------------------------------------------------------- run
+
+    def run(self, n_events: int = 200, max_ticks: int = 600,
+            quiesce_ticks: int = 40,
+            restart_scheduler_at: Optional[int] = None) -> ServingReport:
+        """Drive the full schedule, then quiesce (cronjobs suspended,
+        faults off) until every arrived pod is bound or terminal (or
+        max_ticks). Returns the report with the determinism surfaces and
+        the settled SLO."""
+        self.start()
+        report = ServingReport(seed=self.seed)
+        self.loadgen.begin(self.loadgen.make_schedule(n_events))
+        quiesced = False
+        quiesce_left = quiesce_ticks
+        while self._tick_idx < max_ticks:
+            self.injector.advance(self._tick_idx)
+            if restart_scheduler_at is not None \
+                    and self._tick_idx == restart_scheduler_at:
+                self.restart_scheduler()
+                report.scheduler_restarts += 1
+            self._tick()
+            if self.loadgen.done and not quiesced:
+                # quiesce: no new arrivals, future cron firings off,
+                # faults off — the backlog must now converge on its own
+                quiesced = True
+                self.loadgen.suspend_cronjobs()
+                self.injector.error_rate = 0.0
+                self.injector.reset_rate = 0.0
+                self.injector.latency_rate = 0.0
+                self.injector.watch_drop_rate = 0.0
+            elif quiesced:
+                quiesce_left -= 1
+                if quiesce_left <= 0 and not self._unconverged():
+                    break
+        report.ticks = self._tick_idx
+        report.arrival_log = list(self.loadgen.log)
+        report.bind_log = list(self.tracker.bind_log)
+        report.batch_caps = self._batch_caps + \
+            list(self.scheduler.batch_cap_log)
+        report.slo = self.tracker.report()
+        report.stuck = self._stuck_pods()
+        report.pods_bound = sum(
+            1 for p in self.admin.pods().list(namespace=None)
+            if p.spec.node_name)
+        checker = InvariantChecker(self.admin, scheduler=self.scheduler)
+        report.violations = checker.check()
+        return report
+
+    def _unconverged(self) -> bool:
+        return bool(self._stuck_pods())
+
+    def _stuck_pods(self) -> List[str]:
+        return sorted(
+            p.metadata.key()
+            for p in self.admin.pods().list(namespace=None)
+            if not p.spec.node_name
+            and p.status.phase not in ("Succeeded", "Failed")
+            and p.metadata.deletion_timestamp is None)
+
+    # ------------------------------------------------------------- tick
+
+    def _tick(self) -> None:
+        """One serving step: arrivals land, controllers reconcile,
+        the scheduler drains one adaptive cycle, kubelets report, the
+        tracker observes — each stage settled so the next reads a
+        deterministic view."""
+        self.loadgen.step()
+        self._settle()
+        self._controllers_pass()
+        try:
+            self.scheduler.schedule_pending(timeout=0)
+        except Exception:
+            if not self._swallow_errors:
+                raise
+            # an injected fault mid-cycle: retries next tick
+        self.scheduler.cache.cleanup_expired_assumed_pods()
+        self._settle()
+        self._virtual_kubelets()
+        self._settle()
+        # deterministic SLO observation: the settled store, sorted keys
+        self.tracker.scan(self.admin.pods().list(namespace=None))
+        self.clock.step(self.tick_s)
+        self._tick_idx += 1
+
+    def _controllers_pass(self) -> None:
+        """Run every workload control loop once, synchronously, in
+        sorted-key order (their workqueue worker threads are never
+        started — the driver thread IS the worker, which is what makes
+        the pass deterministic). Cron fires before Job so a new minute's
+        Job is acted on this tick."""
+        try:
+            self.cronjobs.sync_all()
+        except Exception:
+            if not self._swallow_errors:
+                raise
+        self._settle()
+        for ctrl, cls in ((self.deployments, Deployment),
+                          (self.replicasets, ReplicaSet),
+                          (self.jobs, Job)):
+            informer = self.factory.informer_for(cls)
+            for key in sorted(o.metadata.key()
+                              for o in informer.indexer.list()):
+                try:
+                    ctrl.sync(key)
+                except Exception:
+                    if not self._swallow_errors:
+                        raise
+                    # chaos mid-sync: the next tick re-syncs
+            self._settle()
+
+    def _virtual_kubelets(self) -> None:
+        """Bound pods go Running; finite workloads (job/cronjob class)
+        Succeed after job_run_ticks so Jobs complete and churn includes
+        COMPLETIONS, not just arrivals."""
+        for pod in sorted(self.admin.pods().list(namespace=None),
+                          key=lambda p: p.metadata.key()):
+            key = pod.metadata.key()
+            if not pod.spec.node_name or \
+                    pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            cls = pod.metadata.labels.get(CLASS_LABEL, "")
+            if pod.status.phase != "Running":
+                def run_status(cur):
+                    if cur.status.phase in ("Succeeded", "Failed"):
+                        return cur
+                    cur.status.phase = "Running"
+                    if not cur.status.start_time:
+                        cur.status.start_time = now_iso(self.clock)
+                    return cur
+                try:
+                    self.admin.pods(pod.metadata.namespace).patch(
+                        pod.metadata.name, run_status)
+                except NotFoundError:
+                    continue
+                self._running_since[key] = self._tick_idx
+            elif cls in ("job", "cronjob") and \
+                    self._tick_idx - self._running_since.get(
+                        key, self._tick_idx) >= self.job_run_ticks:
+                def done_status(cur):
+                    if cur.status.phase == "Running":
+                        cur.status.phase = "Succeeded"
+                    return cur
+                try:
+                    self.admin.pods(pod.metadata.namespace).patch(
+                        pod.metadata.name, done_status)
+                except NotFoundError:
+                    pass
+
+    # ------------------------------------------------------------ settle
+
+    #: resource classes the settling contract gates on — everything a
+    #: serving control loop reads (only informers a factory actually
+    #: created are compared; see chaos.harness.informers_current)
+    _SETTLE_CLASSES = (Pod, Node, PodGroup, Deployment, ReplicaSet, Job,
+                       CronJob)
+
+    def _settle(self, timeout: float = 10.0) -> None:
+        """The chaos harness's settling contract over the serving
+        resource classes — control-loop inputs identical across
+        same-seed runs."""
+        settle_informers(self.admin, self._factories(),
+                         self._SETTLE_CLASSES, self.injector,
+                         timeout=timeout, logger_name="serving",
+                         step=self._tick_idx)

@@ -1,0 +1,297 @@
+"""Open-loop churn load generator — seeded Poisson arrivals of mixed
+workload classes through the real client/controller stack.
+
+The reference's scheduler_perf measures a one-shot batch drain; a
+production control plane is judged on SUSTAINED pod-startup latency under
+continuous churn. This generator drives that regime: arrivals follow a
+Poisson process (exponential inter-arrival gaps) whose schedule is a PURE
+FUNCTION of (seed, rate, mix, n_events) — the chaos harness's determinism
+contract applied to load. Applying the schedule consumes no randomness,
+so two runs with one seed issue the identical create/patch stream and
+(on the FakeClock harness) produce identical arrival and bind event logs.
+
+Workload classes, each exercising a different controller path:
+
+  singleton    a plain pod, straight into the scheduling queue
+  priority     a singleton at/above the scheduler's lane priority — rides
+               the serving drain's express lane
+  gang         a PodGroup + minMember member pods (the coscheduling path)
+  deployment   the FIRST event creates a Deployment; every later one is a
+               SCALE event (replicas += delta) — the Deployment/ReplicaSet
+               controllers materialize the pods
+  job          a Job (parallelism == completions) — the Job controller
+               creates the pods, and completions retire them
+  cronjob      up to `max_cronjobs` CronJobs on a every-minute schedule —
+               the CronJob controller fires Jobs as virtual time crosses
+               minute boundaries
+
+Open-loop means arrivals never wait on the system: a saturated scheduler
+faces a growing queue, exactly the regime adaptive batch sizing and
+backpressure are judged in.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.apps import Deployment, DeploymentSpec
+from ..api.batch import CronJob, CronJobSpec, Job, JobSpec
+from ..api.core import Container, Pod, PodSpec, PodTemplateSpec, \
+    ResourceRequirements
+from ..api.meta import LabelSelector, ObjectMeta
+from ..api.quantity import Quantity
+from ..api.scheduling import PodGroup, PodGroupSpec
+from ..api.wellknown import LABEL_POD_GROUP
+from ..utils.clock import Clock, REAL_CLOCK
+
+#: the label every generated pod (template) carries; the SLO tracker
+#: buckets its latency percentiles by this
+CLASS_LABEL = "serving.ktpu/class"
+
+#: default class mix (weights; renormalized by random.choices)
+DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
+    ("singleton", 0.40), ("deployment", 0.20), ("job", 0.15),
+    ("gang", 0.12), ("priority", 0.08), ("cronjob", 0.05))
+
+
+@dataclass
+class ArrivalEvent:
+    """One scheduled arrival: `t` is the offset (seconds) from run start;
+    `params` carries every random draw the event needs, so applying it is
+    deterministic."""
+    idx: int
+    t: float
+    cls: str
+    params: Dict[str, int] = field(default_factory=dict)
+
+
+class LoadGen:
+    """Seeded open-loop generator. Usage:
+
+        gen = LoadGen(client, seed=7, rate=50.0)
+        gen.begin(gen.make_schedule(500))
+        while not gen.done:
+            gen.step()          # applies every event due at clock.now()
+            ...                 # tick the control plane / sleep
+    """
+
+    def __init__(self, client, seed: int = 0, rate: float = 50.0,
+                 mix=None, clock: Clock = REAL_CLOCK,
+                 namespace: str = "default",
+                 lane_priority: int = 1000,
+                 cpu_m: int = 100, memory: str = "64Mi",
+                 gang_sizes: Tuple[int, int] = (2, 4),
+                 deploy_step: Tuple[int, int] = (1, 8),
+                 job_sizes: Tuple[int, int] = (1, 4),
+                 max_cronjobs: int = 2):
+        self.client = client
+        self.seed = seed
+        self.rate = float(rate)
+        self.mix = tuple(mix) if mix is not None else DEFAULT_MIX
+        self.clock = clock
+        self.namespace = namespace
+        self.lane_priority = lane_priority
+        self.cpu_m = cpu_m
+        self.memory = memory
+        self.gang_sizes = gang_sizes
+        self.deploy_step = deploy_step
+        self.job_sizes = job_sizes
+        self.max_cronjobs = max_cronjobs
+        #: the applied-arrival log — (idx, cls, object name) in apply
+        #: order; identical across same-seed runs (the determinism
+        #: surface the serving smoke asserts on)
+        self.log: List[Tuple[int, str, str]] = []
+        #: direct pod arrivals by class (controller-materialized pods are
+        #: counted by the SLO tracker at observation instead)
+        self.arrivals: Dict[str, int] = {}
+        self._schedule: List[ArrivalEvent] = []
+        self._next = 0
+        self._start: Optional[float] = None
+        self._counters: Dict[str, int] = {}
+        self._deploy_name: Optional[str] = None
+        self._cronjobs: List[str] = []
+
+    # --------------------------------------------------------- schedule
+
+    def make_schedule(self, n_events: int) -> List[ArrivalEvent]:
+        """The run's arrival script: a pure function of
+        (seed, rate, mix, n_events). String seeding is process-stable."""
+        rng = random.Random(
+            f"serving-loadgen:{self.seed}:{self.rate}:{n_events}")
+        names = [c for c, _ in self.mix]
+        weights = [w for _, w in self.mix]
+        t = 0.0
+        out: List[ArrivalEvent] = []
+        for i in range(n_events):
+            t += rng.expovariate(self.rate)
+            cls = rng.choices(names, weights=weights)[0]
+            out.append(ArrivalEvent(
+                idx=i, t=t, cls=cls,
+                params={"size": rng.randint(*self.gang_sizes),
+                        "delta": rng.randint(*self.deploy_step),
+                        "par": rng.randint(*self.job_sizes)}))
+        return out
+
+    def begin(self, schedule: Optional[List[ArrivalEvent]] = None,
+              n_events: int = 200) -> None:
+        self._schedule = schedule if schedule is not None \
+            else self.make_schedule(n_events)
+        self._next = 0
+        self._start = self.clock.now()
+
+    @property
+    def done(self) -> bool:
+        return self._start is not None and \
+            self._next >= len(self._schedule)
+
+    @property
+    def horizon(self) -> float:
+        """The last scheduled arrival's offset (seconds)."""
+        return self._schedule[-1].t if self._schedule else 0.0
+
+    def step(self) -> int:
+        """Apply every event whose offset has passed. Returns the number
+        applied (0 while the clock sits between arrivals)."""
+        if self._start is None:
+            raise RuntimeError("begin() first")
+        elapsed = self.clock.now() - self._start
+        applied = 0
+        while self._next < len(self._schedule) \
+                and self._schedule[self._next].t <= elapsed:
+            ev = self._schedule[self._next]
+            self._next += 1
+            name = self._apply(ev)
+            self.log.append((ev.idx, ev.cls, name))
+            applied += 1
+        return applied
+
+    # --------------------------------------------------------- appliers
+
+    def _apply(self, ev: ArrivalEvent) -> str:
+        fn = getattr(self, f"_do_{ev.cls}")
+        return fn(ev)
+
+    def _name(self, prefix: str) -> str:
+        n = self._counters.get(prefix, 0) + 1
+        self._counters[prefix] = n
+        return f"srv-{prefix}-{n}"
+
+    def _pod_template(self, cls: str, extra_labels=None) -> PodTemplateSpec:
+        labels = {CLASS_LABEL: cls, "app": f"srv-{cls}"}
+        if extra_labels:
+            labels.update(extra_labels)
+        return PodTemplateSpec(
+            metadata=ObjectMeta(labels=labels),
+            spec=PodSpec(containers=[Container(
+                name="c", image="pause",
+                resources=ResourceRequirements(requests={
+                    "cpu": Quantity(f"{self.cpu_m}m"),
+                    "memory": Quantity(self.memory)}))]))
+
+    def _make_pod(self, name: str, cls: str, priority=None,
+                  extra_labels=None) -> Pod:
+        tmpl = self._pod_template(cls, extra_labels)
+        pod = Pod(metadata=ObjectMeta(
+            name=name, namespace=self.namespace,
+            labels=dict(tmpl.metadata.labels)), spec=tmpl.spec)
+        if priority is not None:
+            pod.spec.priority = priority
+        return pod
+
+    def _count(self, cls: str, n: int = 1) -> None:
+        self.arrivals[cls] = self.arrivals.get(cls, 0) + n
+
+    def _do_singleton(self, ev: ArrivalEvent) -> str:
+        name = self._name("solo")
+        self.client.pods(self.namespace).create(
+            self._make_pod(name, "singleton"))
+        self._count("singleton")
+        return name
+
+    def _do_priority(self, ev: ArrivalEvent) -> str:
+        name = self._name("pri")
+        self.client.pods(self.namespace).create(self._make_pod(
+            name, "priority", priority=self.lane_priority))
+        self._count("priority")
+        return name
+
+    def _do_gang(self, ev: ArrivalEvent) -> str:
+        size = ev.params["size"]
+        gname = self._name("gang")
+        self.client.pod_groups(self.namespace).create(PodGroup(
+            metadata=ObjectMeta(name=gname, namespace=self.namespace),
+            spec=PodGroupSpec(min_member=size)))
+        for i in range(size):
+            self.client.pods(self.namespace).create(self._make_pod(
+                f"{gname}-w{i}", "gang",
+                extra_labels={LABEL_POD_GROUP: gname}))
+        self._count("gang", size)
+        return gname
+
+    def _do_deployment(self, ev: ArrivalEvent) -> str:
+        delta = ev.params["delta"]
+        if self._deploy_name is None:
+            # first event creates the deployment; every later one scales
+            self._deploy_name = self._name("deploy")
+            self.client.deployments(self.namespace).create(Deployment(
+                metadata=ObjectMeta(name=self._deploy_name,
+                                    namespace=self.namespace),
+                spec=DeploymentSpec(
+                    replicas=delta,
+                    selector=LabelSelector(
+                        match_labels={"app": "srv-deployment"}),
+                    template=self._pod_template("deployment"))))
+            return self._deploy_name
+
+        def scale(cur):
+            cur.spec.replicas = (cur.spec.replicas or 0) + delta
+            return cur
+        self.client.deployments(self.namespace).patch(
+            self._deploy_name, scale)
+        return f"{self._deploy_name}+{delta}"
+
+    def _do_job(self, ev: ArrivalEvent) -> str:
+        par = ev.params["par"]
+        name = self._name("job")
+        self.client.jobs(self.namespace).create(Job(
+            metadata=ObjectMeta(name=name, namespace=self.namespace),
+            spec=JobSpec(parallelism=par, completions=par,
+                         template=self._pod_template("job"))))
+        return name
+
+    def _do_cronjob(self, ev: ArrivalEvent) -> str:
+        if len(self._cronjobs) >= self.max_cronjobs:
+            return "cron-cap"  # deterministic noop beyond the cap
+        name = self._name("cron")
+        # job_template is the serde dict form (the CronJob controller
+        # decodes it per firing); round-trip a real Job for field parity
+        from ..api import serde
+        tmpl_job = Job(spec=JobSpec(parallelism=1, completions=1,
+                                    template=self._pod_template("cronjob")))
+        job_tmpl = {"spec": json.loads(
+            serde.to_json_str(tmpl_job)).get("spec", {})}
+        self.client.resource(CronJob, self.namespace).create(CronJob(
+            metadata=ObjectMeta(name=name, namespace=self.namespace),
+            spec=CronJobSpec(schedule="* * * * *",
+                             job_template=job_tmpl)))
+        self._cronjobs.append(name)
+        return name
+
+    # -------------------------------------------------------- lifecycle
+
+    def suspend_cronjobs(self) -> None:
+        """Quiesce helper: stop future firings (a cron on an every-minute
+        schedule would otherwise generate churn forever and the run could
+        never converge)."""
+        def suspend(cur):
+            cur.spec.suspend = True
+            return cur
+        for name in self._cronjobs:
+            try:
+                self.client.resource(CronJob, self.namespace).patch(
+                    name, suspend)
+            except Exception:
+                pass

@@ -1,0 +1,193 @@
+"""SLO tracker — pod creation→bound→running latency, per workload class.
+
+Stamps the three lifecycle transitions every serving-mode pod makes:
+
+    created   the pod object exists (arrival)
+    bound     spec.nodeName set (the scheduler's decision landed)
+    running   status.phase == Running (the kubelet started it)
+
+and reports exact per-class percentiles (p50/p95/p99) of bind latency
+(created→bound) and startup latency (created→running), plus the sustained
+bound-pods/s rate — the serving analog of the reference's density-e2e
+pod-startup SLO (its p99 ≤ 5s gate is judged on exactly this transition).
+
+Two observation modes:
+
+  - watch-driven (wall clock, the bench): attach `handlers()` to a pod
+    informer; timestamps prefer the OBJECT's own stamps
+    (metadata.creationTimestamp, the PodScheduled condition,
+    status.startTime) so an observer thread lagging a burst's event
+    backlog charges nothing to the cluster — the lesson the density
+    bench's latency phase already encodes.
+  - scan-driven (FakeClock, tier-1 determinism): call `scan(pods)` at a
+    settled point each tick; transitions are stamped with the shared
+    virtual clock and pods are visited in sorted-key order, so the bind
+    log is identical across same-seed runs (object timestamps are wall
+    clock and would break that).
+
+Percentiles are EXACT (nearest-rank over the stored samples, not
+histogram-bucket approximations) so a scalar replay of the samples must
+reproduce them bit-for-bit — pinned by the serving smoke test.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..state.informer import EventHandlers
+from ..utils.clock import Clock, REAL_CLOCK, parse_iso
+from .loadgen import CLASS_LABEL
+
+#: transition kinds report() summarizes
+BIND = "bind"        # created -> bound
+STARTUP = "startup"  # created -> running
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile over a SORTED sample list — the scalar
+    definition the smoke test replays against report()."""
+    if not samples:
+        return 0.0
+    rank = max(1, math.ceil(q * len(samples)))
+    return samples[rank - 1]
+
+
+class SLOTracker:
+    def __init__(self, clock: Clock = REAL_CLOCK, metrics=None,
+                 class_label: str = CLASS_LABEL,
+                 use_object_timestamps: bool = False):
+        self.clock = clock
+        self.metrics = metrics
+        self.class_label = class_label
+        self.use_object_timestamps = use_object_timestamps
+        self._lock = threading.Lock()
+        self._created: Dict[str, float] = {}
+        self._bound: Dict[str, float] = {}
+        self._running: Dict[str, float] = {}
+        self._cls: Dict[str, str] = {}
+        #: (pod key, node) in first-observation order — with scan-driven
+        #: observation this is the run's deterministic bind event log
+        self.bind_log: List[Tuple[str, str]] = []
+        self._t0: Optional[float] = None
+
+    # ------------------------------------------------------ observation
+
+    def handlers(self) -> EventHandlers:
+        """Informer wiring for the watch-driven (wall-clock) mode."""
+        return EventHandlers(on_add=self.observe,
+                             on_update=lambda old, new: self.observe(new))
+
+    def scan(self, pods) -> None:
+        """Deterministic observation: visit a settled pod listing in
+        sorted-key order (FakeClock mode)."""
+        for pod in sorted(pods, key=lambda p: p.metadata.key()):
+            self.observe(pod)
+
+    def observe(self, pod) -> None:
+        """Record any transition this pod object evidences (idempotent
+        per phase; a pod is stamped once per transition, first sight
+        wins)."""
+        key = pod.metadata.key()
+        now = self.clock.now()
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = now
+            if key not in self._created:
+                self._created[key] = self._stamp_created(pod, now)
+                self._cls[key] = pod.metadata.labels.get(
+                    self.class_label, "other")
+                if self.metrics is not None:
+                    self.metrics.pods_observed.inc(
+                        cls=self._cls[key], phase="created")
+            cls = self._cls[key]
+            if pod.spec.node_name and key not in self._bound:
+                self._bound[key] = self._stamp_bound(pod, now)
+                self.bind_log.append((key, pod.spec.node_name))
+                if self.metrics is not None:
+                    self.metrics.pods_observed.inc(cls=cls, phase="bound")
+                    self.metrics.pod_bind_seconds.observe(
+                        max(0.0, self._bound[key] - self._created[key]),
+                        cls=cls)
+            if pod.status.phase == "Running" and key not in self._running:
+                self._running[key] = self._stamp_running(pod, now)
+                if self.metrics is not None:
+                    self.metrics.pods_observed.inc(cls=cls,
+                                                   phase="running")
+                    self.metrics.pod_startup_seconds.observe(
+                        max(0.0, self._running[key] - self._created[key]),
+                        cls=cls)
+
+    def _stamp_created(self, pod, now: float) -> float:
+        if self.use_object_timestamps:
+            t = parse_iso(pod.metadata.creation_timestamp or "")
+            if t is not None:
+                return t
+        return now
+
+    def _stamp_bound(self, pod, now: float) -> float:
+        if self.use_object_timestamps:
+            for cond in pod.status.conditions:
+                if cond.type == "PodScheduled" and cond.status == "True":
+                    t = parse_iso(cond.last_transition_time or "")
+                    if t is not None:
+                        return t
+        return now
+
+    def _stamp_running(self, pod, now: float) -> float:
+        if self.use_object_timestamps:
+            t = parse_iso(pod.status.start_time or "")
+            if t is not None:
+                return t
+        return now
+
+    # --------------------------------------------------------- reporting
+
+    def samples(self, kind: str) -> Dict[str, List[float]]:
+        """Per-class latency samples for one transition kind, each list
+        sorted ascending — the raw material report() summarizes (and the
+        smoke test's scalar-replay surface)."""
+        ends = self._bound if kind == BIND else self._running
+        with self._lock:
+            out: Dict[str, List[float]] = {}
+            for key, t_end in ends.items():
+                out.setdefault(self._cls[key], []).append(
+                    max(0.0, t_end - self._created[key]))
+            for v in out.values():
+                v.sort()
+            return out
+
+    def report(self) -> dict:
+        """Per-class p50/p95/p99 for bind and startup latency, counts,
+        and the sustained bound rate over the observation window."""
+        with self._lock:
+            elapsed = (self.clock.now() - self._t0) if self._t0 else 0.0
+            n_created = len(self._created)
+            n_bound = len(self._bound)
+            n_running = len(self._running)
+        classes: dict = {}
+        for kind in (BIND, STARTUP):
+            for cls, vals in self.samples(kind).items():
+                entry = classes.setdefault(cls, {})
+                entry[kind] = {
+                    "count": len(vals),
+                    "p50_s": round(percentile(vals, 0.50), 6),
+                    "p95_s": round(percentile(vals, 0.95), 6),
+                    "p99_s": round(percentile(vals, 0.99), 6),
+                    "mean_s": round(sum(vals) / len(vals), 6),
+                    "max_s": round(vals[-1], 6),
+                }
+        return {
+            "created": n_created, "bound": n_bound, "running": n_running,
+            "window_s": round(elapsed, 3),
+            "sustained_bound_per_s": round(n_bound / elapsed, 2)
+            if elapsed > 0 else 0.0,
+            "classes": classes,
+        }
+
+    def unfinished(self) -> List[str]:
+        """Pods observed created but never bound — the liveness surface
+        the chaos soak checks ('no pod permanently stuck')."""
+        with self._lock:
+            return sorted(k for k in self._created if k not in self._bound)

@@ -204,7 +204,11 @@ class ResourceClient:
                                   name, resource_version=resource_version)
 
     def watch(self, namespace: Optional[str] = None,
-              resource_version: Optional[int] = None) -> Watch:
+              resource_version: Optional[int] = None,
+              bookmarks: bool = False) -> Watch:
+        # `bookmarks` is accepted for signature parity with the HTTP
+        # client and ignored: an in-process watch queue has no heartbeat
+        # (and no wire to go quiet on), so there is nothing to bookmark
         ns = namespace if namespace is not None else (self._ns or None)
         return self._store.watch(self._resource,
                                  ns if self._namespaced else None,

@@ -19,7 +19,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Type
 from ..utils.backoff import BackoffPolicy
 from ..utils.metrics import InformerMetrics
 from .client import Client, ResourceClient, apply_bind_fields
-from .store import ADDED, DELETED, ExpiredError, MODIFIED, SlimBindRef
+from .store import (ADDED, BOOKMARK, DELETED, ExpiredError, MODIFIED,
+                    SlimBindRef)
 
 
 class Indexer:
@@ -148,6 +149,9 @@ class SharedInformer:
         #: rv of the last event processed (or the last LIST) — where a
         #: dropped watch resumes. None until the first sync.
         self.last_sync_rv: Optional[int] = None
+        #: whether the transport's watch() accepts `bookmarks=` — probed
+        #: from its signature on first connect (None = not yet probed)
+        self._bookmark_capable: Optional[bool] = None
         self.staleness_timeout = self.WATCH_STALENESS_TIMEOUT
 
     def add_event_handlers(self, handlers: EventHandlers) -> None:
@@ -291,7 +295,30 @@ class SharedInformer:
                 self._rc._SLIM_WATCH = True
             except AttributeError:
                 pass
-        watch = self._rc.watch(resource_version=self.last_sync_rv)
+        # negotiate BOOKMARK heartbeats (allowWatchBookmarks): the
+        # server rides its current rv on the idle heartbeat, so
+        # last_sync_rv keeps pace with OTHER resources' churn during
+        # quiet periods — without them, a long-idle informer's resume rv
+        # ages out of the bounded history window and the reconnect costs
+        # a full 410 relist. Capability is SIGNATURE-detected once (a
+        # transport without the kwarg — test fakes, older proxies — gets
+        # a plain watch): wrapping the call in `except TypeError` would
+        # misread a genuine TypeError inside watch() as "no bookmark
+        # support" and silently disable bookmarks fleet-wide.
+        if self._bookmark_capable is None:
+            import inspect
+            try:
+                params = inspect.signature(self._rc.watch).parameters
+                self._bookmark_capable = "bookmarks" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):
+                self._bookmark_capable = False
+        if self._bookmark_capable:
+            watch = self._rc.watch(resource_version=self.last_sync_rv,
+                                   bookmarks=True)
+        else:
+            watch = self._rc.watch(resource_version=self.last_sync_rv)
         with self._lock:
             self._watch = watch
             if self._stop.is_set():  # stop() raced the watch creation
@@ -351,6 +378,16 @@ class SharedInformer:
         """Apply one watch event to the indexer, advance last_sync_rv,
         and fan out to handlers. False if the event was dropped (a slim
         frame whose object could not be materialized)."""
+        if ev.type == BOOKMARK:
+            # object-less heartbeat frame: only the resume point moves.
+            # Counts as stream progress (the server is alive), so the
+            # caller's reconnect backoff resets like any delivery.
+            if ev.resource_version:
+                rv = int(ev.resource_version)
+                if self.last_sync_rv is None or rv > self.last_sync_rv:
+                    self.last_sync_rv = rv
+            self.metrics.watch_bookmarks.inc(resource=self._resource)
+            return True
         obj = ev.object
         if isinstance(obj, SlimBindRef):
             # negotiated slim bind frame: materialize the bound pod

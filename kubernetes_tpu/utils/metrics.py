@@ -265,6 +265,12 @@ class InformerMetrics:
         self.watch_stale_kills = r.counter(
             "informer_watch_stale_kills_total",
             "Watch streams killed after heartbeat staleness, by resource")
+        #: BOOKMARK heartbeat frames consumed (allowWatchBookmarks): each
+        #: advances last_sync_rv through a quiet period, shrinking the
+        #: window in which a reconnect would 410 into a full relist
+        self.watch_bookmarks = r.counter(
+            "informer_watch_bookmarks_total",
+            "Watch BOOKMARK frames that advanced last_sync_rv, by resource")
 
 
 class RobustnessMetrics:
@@ -307,6 +313,46 @@ class RobustnessMetrics:
             "scheduler_pipelined_commit_rollbacks_total",
             "Pipelined commit stages that lost winners and invalidated "
             "chained device usage")
+
+
+#: pod-startup latency buckets (seconds) — wider than the scheduler's
+#: per-batch buckets: startup rides controller sync + schedule + kubelet
+SERVING_LATENCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 8.0,
+                           13.0, 21.0, 34.0, 55.0)
+
+
+class ServingMetrics:
+    """Serving-mode (open-loop churn) metric families: per-class pod
+    lifecycle latencies the SLO tracker observes, and the arrival rate
+    the load generator sustains. Registered into the caller's registry so
+    they ride the same /metrics exposition as the scheduler's families."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: created -> Running, by workload class — the latency the SLO is
+        #: judged on (the density e2e's p99 <= 5s gate, sustained)
+        self.pod_startup_seconds = r.histogram(
+            "serving_pod_startup_seconds",
+            "Pod creation to Running latency under churn, by class",
+            buckets=SERVING_LATENCY_BUCKETS)
+        #: created -> bound (spec.nodeName set) — the scheduler's share
+        self.pod_bind_seconds = r.histogram(
+            "serving_pod_bind_seconds",
+            "Pod creation to bound latency under churn, by class",
+            buckets=SERVING_LATENCY_BUCKETS)
+        #: lifecycle transitions observed, by class and phase
+        #: {created, bound, running}
+        self.pods_observed = r.counter(
+            "serving_pods_observed_total",
+            "Pod lifecycle transitions the SLO tracker stamped, "
+            "by class and phase")
+        #: the open-loop generator's configured arrival rate (pods/s
+        #: equivalent; deployment scale deltas and gang members count as
+        #: their pod counts)
+        self.arrival_rate = r.gauge(
+            "serving_arrival_rate_events_per_s",
+            "Configured open-loop arrival rate (events/s)")
 
 
 class Registry:

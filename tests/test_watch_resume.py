@@ -352,6 +352,83 @@ class TestHTTPWatchWire:
             resource="pods", reason="ConnectionResetError") == before + 1
 
 
+class TestWatchBookmarks:
+    """allowWatchBookmarks (ISSUE 7 satellite): the hub's heartbeat
+    frames carry the current resourceVersion; the informer advances
+    last_sync_rv on them, so a QUIET resource's resume point keeps pace
+    with other resources' churn and a reconnect after the history window
+    overflowed costs a reconnect, not a 410 relist."""
+
+    @pytest.fixture()
+    def server(self):
+        from kubernetes_tpu.apiserver import APIServer
+        store = Store()
+        store.HISTORY_WINDOW = 16
+        srv = APIServer(store=store).start()
+        srv._test_store = store
+        yield srv
+        srv.stop()
+
+    def test_raw_watch_negotiates_bookmark_frames(self, server):
+        from kubernetes_tpu.apiserver import HTTPClient
+        from kubernetes_tpu.state.store import BOOKMARK
+        client = HTTPClient(server.address)
+        client.pods("default").create(make_pod("p0"))
+        w = client.pods().watch(resource_version=0, bookmarks=True)
+        ev = w.events.get(timeout=5)
+        assert ev.object.metadata.name == "p0"
+        bm = w.events.get(timeout=5)  # idle stream: next frame is the
+        assert bm.type == BOOKMARK    # rv-carrying heartbeat
+        assert bm.object is None
+        assert bm.resource_version >= ev.resource_version
+        assert w.last_rv == bm.resource_version
+        w.stop()
+        # non-negotiating streams keep the bare heartbeat: no BOOKMARK
+        # frames ever reach a raw consumer that didn't opt in
+        w2 = client.pods().watch(resource_version=0)
+        ev2 = w2.events.get(timeout=5)
+        assert ev2.object.metadata.name == "p0"
+        assert _wait(lambda: not w2.events.empty(), timeout=2.5) is False
+        w2.stop()
+
+    def test_bookmark_shrinks_410_relist_window(self, server):
+        """The informer sits quiet on pods while nodes churn the GLOBAL
+        rv past the bounded history window. A bookmark advances
+        last_sync_rv through the quiet period, so killing the stream
+        resumes with ZERO additional lists — where the pre-bookmark
+        resume point is provably ExpiredError."""
+        from kubernetes_tpu.apiserver import HTTPClient
+        admin = HTTPClient(server.address)
+        admin.pods("default").create(make_pod("p0"))
+        rc = CountingRC(HTTPClient(server.address).pods())
+        metrics = InformerMetrics()
+        inf = SharedInformer(rc, metrics=metrics)
+        inf.start()
+        try:
+            assert inf.wait_for_sync()
+            rv0 = inf.last_sync_rv
+            # other-resource churn: overflow the (global) history window
+            for i in range(24):
+                admin.nodes().create(api.Node(
+                    metadata=api.ObjectMeta(name=f"bm-n{i}")))
+            # the old resume point is now truly gone...
+            with pytest.raises(ExpiredError):
+                server._test_store.watch("pods", None,
+                                         resource_version=rv0)
+            # ...but the idle stream's bookmark advances past the churn
+            assert _wait(lambda: inf.last_sync_rv > rv0, timeout=5.0)
+            assert _wait(
+                lambda: metrics.watch_bookmarks.value(resource="pods") > 0)
+            assert _wait(lambda: inf._watch is not None)
+            inf._watch.kill("test-induced reset")
+            admin.pods("default").create(make_pod("p1"))
+            assert _wait(lambda: len(inf.indexer.list()) == 2, timeout=10)
+            assert rc.lists == 1, "bookmarked resume must not relist"
+            assert metrics.relists.value(resource="pods") == 1
+        finally:
+            inf.stop()
+
+
 class TestFactoryWiring:
     def test_factory_shares_metrics_and_removes_handlers(self):
         client = Client()
