@@ -82,6 +82,16 @@ _RESTART_ACTIONS = (("restart_scheduler", 0.05),
                     ("restart_controllers", 0.04),
                     ("restart_store", 0.03))
 
+#: appended when with_tears=True — durable-state loss: the store
+#: restarts having LOST the last N journal records (rv clock regresses)
+_TEAR_ACTIONS = (("tear_wal", 0.04),)
+
+#: appended when ha=True — control-plane failover faults: crash the
+#: current lease holder, or suppress Lease writes so the holder fences
+#: itself at renew_deadline and a standby takes over at lease expiry
+_HA_ACTIONS = (("kill_leader", 0.05), ("suppress_lease", 0.04),
+               ("resume_lease", 0.06))
+
 
 def informers_current(admin, factories, classes) -> bool:
     """True when every ALREADY-CREATED informer for `classes` in each
@@ -136,6 +146,61 @@ def settle_informers(admin, factories, classes, injector,
     return False
 
 
+class _BindStampingPods:
+    """Proxy over a PodClient that stamps every successful bind with the
+    owning scheduler replica's identity: the harness's double-bind
+    invariant needs to know WHO bound, not just that a bind landed. Bind
+    verbs report (identity, committed slots) to the harness — which
+    records them in the step-ordered event log against the current lease
+    holder — then everything else passes through untouched."""
+
+    _BIND_VERBS = frozenset({"bind", "bind_bulk", "bind_bulk_pairs"})
+
+    def __init__(self, inner, harness, identity: str):
+        self._inner = inner
+        self._harness = harness
+        self._identity = identity
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name not in self._BIND_VERBS or not callable(attr):
+            return attr
+        harness, identity = self._harness, self._identity
+
+        def wrapped(*args, **kwargs):
+            out = attr(*args, **kwargs)
+            if isinstance(out, list):
+                n = sum(1 for o in out if not isinstance(o, Exception))
+            else:
+                n = 1
+            if n:
+                harness._note_bind(identity, n)
+            return out
+        wrapped.__name__ = name
+        return wrapped
+
+
+class _HAClient:
+    """A scheduler replica's client in HA mode: pod bind verbs are
+    identity-stamped (see _BindStampingPods); every other accessor —
+    informer resource() handles, lease writes, node reads — delegates to
+    the shared (fault-injected) inner client. `inner` is mutable so a
+    replica-promote drill can fail every component over to the standby
+    store without rebuilding the components."""
+
+    def __init__(self, inner, harness, identity: str):
+        self.inner = inner
+        self._harness = harness
+        self.identity = identity
+
+    def pods(self, namespace=None):
+        return _BindStampingPods(self.inner.pods(namespace),
+                                 self._harness, self.identity)
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+
 @dataclass
 class ChaosReport:
     seed: int
@@ -151,6 +216,18 @@ class ChaosReport:
     scheduler_restarts: int = 0
     controller_restarts: int = 0
     store_restarts: int = 0
+    #: torn-WAL restarts (restart_store(torn=N)) and the records chopped
+    wal_tears: int = 0
+    records_torn: int = 0
+    #: HA failover accounting
+    leader_kills: int = 0
+    lease_suppressions: int = 0
+    #: (election, virtual seconds) per completed failover — lease loss
+    #: to the standby's first bind (scheduler) / first acquire (others)
+    failovers: List[Tuple] = field(default_factory=list)
+    #: containers virtual kubelets GCed for pods the store lost
+    orphans_gced: int = 0
+    promoted: bool = False
     #: the semantic end state — sorted (resource, namespace, name,
     #: phase, bound) tuples; node choice and resourceVersions excluded.
     #: Comparable between a faulted and a fault-free run of one schedule.
@@ -175,7 +252,10 @@ class ChaosHarness:
                  latency_max: float = 0.005,
                  watch_drop_rate: float = 0.0,
                  with_restarts: bool = False,
-                 enable_restarts: bool = True):
+                 enable_restarts: bool = True,
+                 with_tears: bool = False,
+                 ha: bool = False,
+                 replica: bool = False):
         self.seed = seed
         self.n_nodes = nodes
         self.nodes_per_slice = max(1, nodes_per_slice)
@@ -190,6 +270,13 @@ class ChaosHarness:
         #: keeps the identical schedule while skipping the restarts
         self.with_restarts = with_restarts
         self.enable_restarts = enable_restarts
+        #: with_tears adds torn-WAL restarts (durable-state LOSS, not
+        #: just a crash) to the schedule; requires wal_path
+        self.with_tears = with_tears
+        #: ha runs scheduler + controller-manager PAIRS gated by leader
+        #: election on the shared FakeClock; kill_leader/suppress_lease
+        #: join the schedule
+        self.ha = ha
         self.clock = FakeClock()
         self.metrics = RobustnessMetrics()
         self.injector = FaultInjector(
@@ -197,7 +284,7 @@ class ChaosHarness:
             reset_rate=reset_rate, latency_rate=latency_rate,
             latency_max=latency_max, watch_drop_rate=watch_drop_rate)
         self._base_error_rate = error_rate
-        store = Store(wal_path=wal_path)
+        store = Store(wal_path=wal_path, metrics=self.metrics)
         #: the control plane's (faulted) client vs the harness's own
         #: admin view of the same store — workload creation and virtual
         #: kubelet writes stay fault-free so the run's INPUT is stable
@@ -216,37 +303,239 @@ class ChaosHarness:
                            wire_hook=self.injector.make_wire_hook()))
         else:
             self.client = ChaosClient(self.injector, store=store)
-        #: controllers' factory; the scheduler runs its OWN factory so a
-        #: scheduler crash can take its informers down with it
-        self.factory = SharedInformerFactory(self.client)
-        self._sched_factory = SharedInformerFactory(self.client)
-        self.scheduler = self._build_scheduler(self._sched_factory)
-        self._build_controllers(self.factory)
+        #: virtual-kubelet container tracking: node -> set of pod keys a
+        #: kubelet "started". A container whose pod the store no longer
+        #: binds HERE (lost to a torn journal tail, or rescheduled away)
+        #: is orphan-GCed each tick — the kubelet half of torn-WAL
+        #: recovery.
+        self._containers = {}
+        self._orphans_gced = 0
+        #: replica-promote drill state (replica=True)
+        self._replica = None
+        self._promote_violations: List[str] = []
+        self._promoted = False
+        if replica:
+            if http:
+                raise ValueError("replica drill runs in-process; the wire "
+                                 "replica story is test_replication's")
+            if wal_path is None:
+                raise ValueError("replica drill needs wal_path (the "
+                                 "standby journals what it applies)")
+            from ..state.replication import ReadOnlyStore, StoreReplica
+            self._replica = StoreReplica(
+                Client(store),
+                store=ReadOnlyStore(wal_path=wal_path + ".replica",
+                                    metrics=self.metrics),
+                seed=seed)
         self._gang_counter = 0
         self._pod_counter = 0
         self._started = False
+        if ha:
+            # scheduler + controller-manager PAIRS, each replica with its
+            # own informer factory (a crash takes its caches with it),
+            # gated by step()-driven leader election on the FakeClock.
+            # Lease timing in clock_step units: an attempt every tick, a
+            # holder fences after missing ~2 ticks of renewals, a standby
+            # acquires once the lease expires ~5 ticks after the last
+            # renewal — the fencing window (expiry - deadline) is > 0,
+            # which is what the zero-double-bind invariant rests on.
+            self._lease_duration = 5.0 * clock_step
+            self._renew_deadline = 2.0 * clock_step
+            self._ha_gen = 0
+            self._sched_instances = {}   # identity -> (factory, Scheduler)
+            self._cm_instances = {}      # identity -> (factory, nlc, pg, gc)
+            self._electors = {}          # identity -> LeaderElector
+            self._sched_leader: Optional[str] = None
+            self._cm_leader: Optional[str] = None
+            #: election -> (clock time leadership was lost, lost holder)
+            self._failover_start = {}
+            #: the harness-side bind log: (step, identity, n, holder)
+            self.bind_log: List[Tuple] = []
+            for _ in range(2):
+                self._spawn_sched_instance()
+            for _ in range(2):
+                self._spawn_cm_instance()
+            # self.scheduler / controller attrs track the CURRENT leader
+            # (the invariant sweep's view); until the first election the
+            # first replica stands in
+            first_s = next(iter(self._sched_instances))
+            self._sched_factory, self.scheduler = \
+                self._sched_instances[first_s]
+            first_c = next(iter(self._cm_instances))
+            (self.factory, self.nodelifecycle, self.podgroups,
+             self.podgc) = self._cm_instances[first_c]
+        else:
+            #: controllers' factory; the scheduler runs its OWN factory
+            #: so a scheduler crash can take its informers down with it
+            self.factory = SharedInformerFactory(self.client)
+            self._sched_factory = SharedInformerFactory(self.client)
+            self.scheduler = self._build_scheduler(self._sched_factory)
+            self._build_controllers(self.factory)
 
-    def _build_scheduler(self, factory: SharedInformerFactory) -> Scheduler:
+    def _build_scheduler(self, factory: SharedInformerFactory,
+                         client=None) -> Scheduler:
         # async_bind=False: the driver steps everything synchronously —
         # a binder thread would commit binds at wall-clock-dependent
         # times and break the identical-event-log contract in wire mode
-        return Scheduler(self.client, informer_factory=factory,
+        return Scheduler(client if client is not None else self.client,
+                         informer_factory=factory,
                          batch_size=64, clock=self.clock,
                          async_bind=False)
 
-    def _build_controllers(self, factory: SharedInformerFactory) -> None:
-        self.nodelifecycle = NodeLifecycleController(
-            self.client, factory, grace_period=self.grace_period,
+    def _make_controllers(self, factory: SharedInformerFactory,
+                          client=None) -> Tuple:
+        client = client if client is not None else self.client
+        nlc = NodeLifecycleController(
+            client, factory, grace_period=self.grace_period,
             eviction_timeout=self.eviction_timeout, clock=self.clock,
             metrics=self.metrics)
-        self.podgroups = PodGroupController(
-            self.client, factory, metrics=self.metrics,
-            clock=self.clock)
-        self.podgc = PodGCController(self.client, factory,
-                                     clock=self.clock)
+        pg = PodGroupController(client, factory, metrics=self.metrics,
+                                clock=self.clock)
+        gc = PodGCController(client, factory, clock=self.clock)
+        return nlc, pg, gc
+
+    def _build_controllers(self, factory: SharedInformerFactory) -> None:
+        self.nodelifecycle, self.podgroups, self.podgc = \
+            self._make_controllers(factory)
 
     def _factories(self) -> List[SharedInformerFactory]:
+        if self.ha:
+            return [f for f, *_ in self._cm_instances.values()] + \
+                   [f for f, _ in self._sched_instances.values()]
         return [self.factory, self._sched_factory]
+
+    # --------------------------------------------------------- ha wiring
+
+    def _next_identity(self, base: str) -> str:
+        """Generation-suffixed replica identities: a crash-replaced
+        replica must NOT inherit its predecessor's identity, or it would
+        read the stale lease as its own and 'renew' straight back into
+        leadership without waiting out the expiry."""
+        self._ha_gen += 1
+        return f"{base}-g{self._ha_gen}"
+
+    def _make_elector(self, election: str, identity: str, client):
+        from ..state.leaderelection import LeaderElector
+        return LeaderElector(
+            client, election, identity,
+            lease_duration=self._lease_duration,
+            renew_deadline=self._renew_deadline,
+            retry_period=self.clock_step,
+            on_started_leading=lambda: self._on_leader_started(
+                election, identity),
+            on_stopped_leading=lambda: self._on_leader_stopped(
+                election, identity),
+            clock=self.clock, metrics=self.metrics)
+
+    def _spawn_sched_instance(self) -> str:
+        identity = self._next_identity("sched")
+        client = _HAClient(self.client, self, identity)
+        factory = SharedInformerFactory(client)
+        sched = self._build_scheduler(factory, client)
+        self._sched_instances[identity] = (factory, sched)
+        self._electors[identity] = self._make_elector(
+            "kube-scheduler", identity, client)
+        if self._started:
+            factory.start()
+            factory.wait_for_cache_sync()
+        return identity
+
+    def _spawn_cm_instance(self) -> str:
+        identity = self._next_identity("cm")
+        factory = SharedInformerFactory(self.client)
+        nlc, pg, gc = self._make_controllers(factory)
+        self._cm_instances[identity] = (factory, nlc, pg, gc)
+        self._electors[identity] = self._make_elector(
+            "kube-controller-manager", identity, self.client)
+        if self._started:
+            factory.start()
+            factory.wait_for_cache_sync()
+        return identity
+
+    def _on_leader_started(self, election: str, identity: str) -> None:
+        self.injector.record("leader_acquired", election, identity)
+        if election == "kube-scheduler":
+            self._sched_leader = identity
+            self._sched_factory, self.scheduler = \
+                self._sched_instances[identity]
+        else:
+            self._cm_leader = identity
+            (self.factory, self.nodelifecycle, self.podgroups,
+             self.podgc) = self._cm_instances[identity]
+        pending = self._failover_start.get(election)
+        if pending is not None:
+            lost_at, lost_holder = pending
+            if lost_holder == identity:
+                # the deposed holder re-acquired its own (never-expired)
+                # lease: leadership lapsed locally but never moved
+                self._failover_start.pop(election, None)
+            elif election != "kube-scheduler":
+                # controllers: failover completes at acquisition (there
+                # is no bind to anchor on)
+                self._complete_failover(election)
+
+    def _on_leader_stopped(self, election: str, identity: str) -> None:
+        """The holder fenced itself (renew deadline missed) or released.
+        This event PRECEDING the standby's leader_acquired in the
+        step-ordered log is the provable stop-before-takeover the
+        double-bind invariant asserts."""
+        self.injector.record("leader_deposed", election, identity)
+        # a NEW loss restarts the failover clock: a pending measurement
+        # that never saw a bind (nothing to schedule during the gap) must
+        # not inflate the next failover's timing
+        self._failover_start[election] = (self.clock.now(), identity)
+        if election == "kube-scheduler" and self._sched_leader == identity:
+            self._sched_leader = None
+        elif election == "kube-controller-manager" \
+                and self._cm_leader == identity:
+            self._cm_leader = None
+
+    def _complete_failover(self, election: str) -> None:
+        lost_at, _holder = self._failover_start.pop(election)
+        seconds = self.clock.now() - lost_at
+        self.injector.record("leader_failover", election, seconds)
+        self.metrics.leader_failover_seconds.observe(
+            seconds, name=election)
+
+    def _note_bind(self, identity: str, n: int) -> None:
+        """A scheduler replica committed `n` binds. Stamped into the
+        step-ordered event log with the CURRENT holder so the double-bind
+        sweep can prove no deposed replica ever bound after losing the
+        lease; the first bind by a NEW leader closes the pending
+        failover-timing measurement."""
+        holder = self._sched_leader
+        self.injector.record("bind", identity, n)
+        self.bind_log.append((self.injector.step, identity, n, holder))
+        if "kube-scheduler" in self._failover_start \
+                and identity == holder:
+            self._complete_failover("kube-scheduler")
+
+    def check_ha_binds(self) -> List[str]:
+        """The zero-double-bind sweep over the event log: every bind must
+        come from the identity holding the scheduler lease AT THAT POINT
+        IN THE LOG — a deposed leader binding after the standby acquired
+        (or after its own fencing) is the split-brain this invariant
+        exists to catch."""
+        out: List[str] = []
+        holder = None
+        for ev in self.injector.events:
+            kind = ev[1]
+            if kind == "leader_acquired" and ev[2] == "kube-scheduler":
+                holder = ev[3]
+            elif kind == "leader_deposed" and ev[2] == "kube-scheduler":
+                if holder == ev[3]:
+                    holder = None
+            elif kind == "kill_leader" and ev[2] == "kube-scheduler":
+                if holder == ev[3]:
+                    holder = None
+            elif kind == "bind":
+                identity = ev[2]
+                if identity != holder:
+                    out.append(
+                        f"ha-double-bind: step {ev[0]}: {identity} bound "
+                        f"{ev[3]} pod(s) while the scheduler lease "
+                        f"holder was {holder!r}")
+        return out
 
     # ------------------------------------------------------------- setup
 
@@ -261,6 +550,9 @@ class ChaosHarness:
         for fac in self._factories():
             fac.start()
             fac.wait_for_cache_sync()
+        if self._replica is not None:
+            self._replica.start()
+            self._replica.wait_synced()
         self._settle()
         self._started = True
 
@@ -277,6 +569,10 @@ class ChaosHarness:
         self.admin.nodes().create(node)
 
     def close(self) -> None:
+        if self._replica is not None:
+            self._replica.stop()
+            if not self._promoted:
+                self._replica.store.close()
         for fac in self._factories():
             fac.stop()
         if self._server is not None:
@@ -285,12 +581,18 @@ class ChaosHarness:
 
     # ---------------------------------------------------------- restarts
 
-    def restart_scheduler(self) -> None:
+    def restart_scheduler(self) -> bool:
         """Crash-replace the scheduler: its informers stop, and its
         cache, in-flight assumed pods, and gang permit-gate reservations
         die with the process. The replacement rebuilds every bit of that
         from a fresh informer sync — unbound members requeue, gangs
-        re-reserve — which is exactly the recovery under test."""
+        re-reserve — which is exactly the recovery under test. In HA
+        mode a scheduler 'restart' IS a leader kill: the holder crashes
+        and the standby takes over at lease expiry. Returns False only
+        when nothing was crashed (HA mid-failover: nobody holds the
+        lease, so there is no process to kill)."""
+        if self.ha:
+            return self.kill_leader("kube-scheduler") is not None
         self.injector.record("restart_scheduler")
         self._sched_factory.stop()
         self.scheduler.crash()
@@ -299,13 +601,16 @@ class ChaosHarness:
         self._sched_factory.start()
         self._sched_factory.wait_for_cache_sync()
         self._settle()
+        return True
 
-    def restart_controller_manager(self) -> None:
+    def restart_controller_manager(self) -> bool:
         """Crash-replace the controller manager's loops (nodelifecycle,
         podgroup, podgc) and their shared informers. Controller-side soft
         state — eviction timers, resubmission rate limits — is lost and
         re-derived from observations, so recovery may converge LATER but
         must still converge."""
+        if self.ha:
+            return self.kill_leader("kube-controller-manager") is not None
         self.injector.record("restart_controllers")
         self.factory.stop()
         self.factory = SharedInformerFactory(self.client)
@@ -313,18 +618,147 @@ class ChaosHarness:
         self.factory.start()
         self.factory.wait_for_cache_sync()
         self._settle()
+        return True
 
-    def restart_store(self) -> None:
+    def restart_store(self, torn: int = 0) -> int:
         """WAL-replay the store in place mid-run (the etcd/apiserver
         restart analog). Every live watch stream is severed; informers
         must resume or relist against the replayed state. No-op without
         a wal_path — a journal-less restart would be data loss, which is
-        a different (unrecoverable) fault class."""
+        a different (unrecoverable) fault class.
+
+        `torn=N` makes it durable-state LOSS, not just a crash: the last
+        N journal records vanish before the replay (state/wal.tear_wal),
+        the rv clock REGRESSES, and the recovery machinery under test is
+
+          - the store answering 410 to any resume at a now-future rv,
+          - informers relisting and pruning ghosts their caches hold but
+            the store lost,
+          - the scheduler forgetting/requeueing regressed binds (gangs
+            whole-group),
+          - virtual kubelets orphan-GCing containers for pods the store
+            no longer knows.
+
+        Returns the number of records actually torn (the journal may
+        hold fewer than requested; 0 for a plain restart)."""
         if self.wal_path is None:
-            return
+            return 0
+        actual = self.admin.store.restart(torn=torn)
+        if torn > 0:
+            # recorded with the ACTUAL count — the report's data-loss
+            # accounting must not overstate a tear the journal could
+            # only partially honor
+            self.injector.tear_wal(actual)
         self.injector.record("restart_store")
-        self.admin.store.restart()
         self._settle()
+        return actual
+
+    def kill_leader(self, election: str) -> Optional[str]:
+        """Crash the election's current holder WITHOUT a release — the
+        lease stays stamped with a dead identity and the standby must
+        wait out the full lease duration before acquiring (the crash
+        failover path, vs suppress_lease's fencing path). The crashed
+        replica is replaced by a fresh standby under a NEW identity.
+        Returns the killed identity, or None when nobody held the lease
+        (already mid-failover)."""
+        assert self.ha, "kill_leader requires ChaosHarness(ha=True)"
+        holder = self._sched_leader if election == "kube-scheduler" \
+            else self._cm_leader
+        if holder is None:
+            return None
+        self.injector.record("kill_leader", election, holder)
+        self._failover_start[election] = (self.clock.now(), holder)
+        self._electors.pop(holder, None)
+        if election == "kube-scheduler":
+            factory, sched = self._sched_instances.pop(holder)
+            factory.stop()
+            sched.crash()
+            self._sched_leader = None
+            self._spawn_sched_instance()
+        else:
+            factory, *_ = self._cm_instances.pop(holder)
+            factory.stop()
+            self._cm_leader = None
+            self._spawn_cm_instance()
+        self._settle()
+        return holder
+
+    # ----------------------------------------------------- promote drill
+
+    def promote_replica(self, timeout: float = 30.0) -> List[str]:
+        """The replica-promote drill (replica=True): kill the primary
+        store FOR GOOD, gate on the follower being fully synced, promote
+        it, and fail every client and informer over to the standby.
+        Components keep their caches — informers reconnect at
+        last_sync_rv against the standby (the StoreReplica preserved the
+        primary's rv timeline, so where the rvs allow, failover costs a
+        reconnect, not a relist).
+
+        Returns (and remembers, for the report) the drill's violations:
+        an rv timeline that regressed across the promote, or an
+        acknowledged write below the replication horizon that the
+        standby lost."""
+        assert self._replica is not None, "ChaosHarness(replica=True)"
+        assert not self._promoted, "promote is one-way"
+        primary = self.admin.store
+        primary.flush_wal()
+        target_rv = primary.resource_version
+        horizon = primary.contents()
+        self.injector.record("kill_primary", target_rv)
+        # barrier: an etcd learner refuses promotion until caught up —
+        # wait (REAL time; follower threads pump frames) for the standby
+        # to hold exactly the primary's final state
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._replica.store.contents() == horizon \
+                    and self._replica.store.resource_version >= target_rv:
+                break
+            time.sleep(0.01)
+        promoted = self._replica.promote()
+        violations: List[str] = []
+        if promoted.resource_version < target_rv:
+            violations.append(
+                f"promote: rv timeline regressed "
+                f"({promoted.resource_version} < {target_rv})")
+        got = promoted.contents()
+        for key, rv in sorted(horizon.items()):
+            if got.get(key) != rv:
+                violations.append(
+                    f"promote: acknowledged write {key}@{rv} below the "
+                    f"replication horizon lost (standby has "
+                    f"{got.get(key)})")
+        # the primary dies for good; every component fails over
+        primary.close()
+        new_client = ChaosClient(self.injector, store=promoted)
+        self.admin = Client(promoted)
+        self.client = new_client
+        if self.ha:
+            for identity, (factory, sched) in self._sched_instances.items():
+                sched.client.inner = new_client  # _HAClient
+                factory.repoint(sched.client)
+            for identity, (factory, nlc, pg, gc) in \
+                    self._cm_instances.items():
+                nlc.client = new_client
+                pg.client = new_client
+                gc.client = new_client
+                factory.repoint(new_client)
+            for el in self._electors.values():
+                el.client = new_client
+        else:
+            self.scheduler.client = new_client
+            self._sched_factory.repoint(new_client)
+            self.nodelifecycle.client = new_client
+            self.podgroups.client = new_client
+            self.podgc.client = new_client
+            self.factory.repoint(new_client)
+        # the standby journals what it applied: the WAL-replay invariant
+        # now checks the promoted store against ITS OWN journal
+        self.wal_path = self.wal_path + ".replica"
+        self._promoted = True
+        self._promote_violations = violations
+        self.injector.record("promote", promoted.resource_version)
+        self._settle()
+        return violations
 
     # ---------------------------------------------------------- schedule
 
@@ -336,28 +770,47 @@ class ChaosHarness:
         no-op) but never the script itself."""
         # string seeding is process-stable (sha512), tuple seeding is not
         rng = random.Random(f"chaos-schedule:{self.seed}")
-        table = _ACTIONS + _RESTART_ACTIONS if self.with_restarts \
-            else _ACTIONS
+        table = _ACTIONS
+        if self.with_restarts:
+            table = table + _RESTART_ACTIONS
+        if self.with_tears:
+            table = table + _TEAR_ACTIONS
+        if self.ha:
+            table = table + _HA_ACTIONS
         names = [a for a, _ in table]
         weights = [w for _, w in table]
         out = []
         for _ in range(n_events):
             action = rng.choices(names, weights=weights)[0]
+            # every event draws every parameter its flag set can consume
+            # (whether or not THIS action uses it), so the schedule is a
+            # pure function of (seed, n_events, flags) — and with the
+            # tear/ha flags off, byte-identical to earlier PRs' schedules
             ev = {"action": action,
                   "node": rng.randrange(self.n_nodes),
                   "size": rng.randint(2, self.nodes_per_slice),
                   "cpu_m": rng.choice((250, 500, 750, 1000))}
+            if self.with_tears:
+                ev["torn"] = rng.randint(1, 8)
+            if self.ha:
+                ev["election"] = rng.choice(("kube-scheduler",
+                                             "kube-controller-manager"))
             out.append(ev)
         return out
 
     # -------------------------------------------------------------- run
 
-    def run(self, n_events: int = 100, quiesce_steps: int = 30
-            ) -> ChaosReport:
+    def run(self, n_events: int = 100, quiesce_steps: int = 30,
+            promote_at_step: Optional[int] = None) -> ChaosReport:
         self.start()
         report = ChaosReport(seed=self.seed, steps=n_events)
         for step, ev in enumerate(self.make_schedule(n_events)):
             self.injector.advance(step)
+            if promote_at_step == step and self._replica is not None \
+                    and not self._promoted:
+                # the drill rides the schedule at a FIXED step, so the
+                # event log stays a pure function of (seed, args)
+                self.promote_replica()
             self._apply(ev, report)
             self._tick()
         # quiesce: faults stop, dead nodes STAY dead — eviction timeouts,
@@ -366,18 +819,35 @@ class ChaosHarness:
         self.injector.error_rate = 0.0
         if self.injector.partitioned:
             self.injector.partition(False)
+        if self.injector.lease_suppressed:
+            self.injector.suppress_lease(False)  # a leader must re-emerge
         for step in range(n_events, n_events + quiesce_steps):
             self.injector.advance(step)
             self._tick()
         # final housekeeping pass: the last tick's PodGroup syncs may have
         # orphaned permit reservations (resubmission deleting a waiting
         # member); one more scheduling cycle drains them before the sweep
-        self.scheduler.schedule_pending(timeout=0)
-        self.scheduler.cache.cleanup_expired_assumed_pods()
+        # (in HA mode only the lease holder may run it — and after the
+        # unsuppressed quiesce one always has re-emerged)
+        if not self.ha or self._sched_leader is not None:
+            self.scheduler.schedule_pending(timeout=0)
+            self.scheduler.cache.cleanup_expired_assumed_pods()
         self._settle()
+        from ..api.core import Node as NodeCls, Pod as PodCls
         checker = InvariantChecker(self.admin, scheduler=self.scheduler,
-                                   wal_path=self.wal_path)
+                                   wal_path=self.wal_path,
+                                   factories=self._factories(),
+                                   informer_classes=(PodCls, NodeCls,
+                                                     PodGroup))
         report.violations = checker.check()
+        if self.ha:
+            report.violations += self.check_ha_binds()
+            report.failovers = [
+                (ev[2], ev[3]) for ev in self.injector.events
+                if ev[1] == "leader_failover"]
+        report.violations += self._promote_violations
+        report.promoted = self._promoted
+        report.orphans_gced = self._orphans_gced
         report.events = list(self.injector.events)
         report.pods_bound = sum(
             1 for p in self.admin.pods().list(namespace=None)
@@ -441,17 +911,32 @@ class ChaosHarness:
             if self.injector.partitioned:
                 self.injector.partition(False)
         elif action == "restart_scheduler":
-            if self.enable_restarts:
-                self.restart_scheduler()
+            if self.enable_restarts and self.restart_scheduler():
                 report.scheduler_restarts += 1
         elif action == "restart_controllers":
-            if self.enable_restarts:
-                self.restart_controller_manager()
+            if self.enable_restarts and self.restart_controller_manager():
                 report.controller_restarts += 1
         elif action == "restart_store":
             if self.enable_restarts and self.wal_path is not None:
                 self.restart_store()
                 report.store_restarts += 1
+        elif action == "tear_wal":
+            if self.enable_restarts and self.wal_path is not None \
+                    and not self._promoted:
+                report.records_torn += self.restart_store(torn=ev["torn"])
+                report.store_restarts += 1
+                report.wal_tears += 1
+        elif action == "kill_leader":
+            if self.enable_restarts and self.ha:
+                if self.kill_leader(ev["election"]) is not None:
+                    report.leader_kills += 1
+        elif action == "suppress_lease":
+            if self.ha and not self.injector.lease_suppressed:
+                self.injector.suppress_lease(True)
+                report.lease_suppressions += 1
+        elif action == "resume_lease":
+            if self.ha and self.injector.lease_suppressed:
+                self.injector.suppress_lease(False)
 
     def _node_exists(self, name: str) -> bool:
         try:
@@ -496,39 +981,76 @@ class ChaosHarness:
 
     def _tick(self) -> None:
         """One control-plane step: virtual kubelets beat and report, each
-        control loop runs once, virtual time advances, informers settle."""
+        control loop runs once, virtual time advances, informers settle.
+        In HA mode the elections step first and only the CURRENT lease
+        holders' loops run — a replica that fenced itself (or never
+        acquired) is provably idle, which is the double-bind invariant's
+        mechanism under test."""
         self._virtual_kubelets()
         self._settle()
-        try:
-            self.nodelifecycle.monitor_once()
-        except Exception:
-            pass  # a partitioned monitor pass retries next tick
-        try:
-            self.podgc.gc_once()
-        except Exception:
-            pass
-        self._settle()
-        try:
-            self.scheduler.schedule_pending(timeout=0)
-        except Exception:
-            pass
-        self.scheduler.cache.cleanup_expired_assumed_pods()
-        self._settle()
-        for pg in self.admin.pod_groups().list(namespace=None):
+        if self.ha:
+            # sorted order: elector stepping must be deterministic
+            for identity in sorted(self._electors):
+                self._electors[identity].step()
+        cm_active = not self.ha or self._cm_leader is not None
+        sched_active = not self.ha or self._sched_leader is not None
+        if cm_active:
             try:
-                self.podgroups.sync(pg.metadata.key())
+                self.nodelifecycle.monitor_once()
             except Exception:
-                pass  # chaos mid-resubmit: the next tick re-syncs
+                pass  # a partitioned monitor pass retries next tick
+            try:
+                self.podgc.gc_once()
+            except Exception:
+                pass
             self._settle()
+        if sched_active:
+            try:
+                self.scheduler.schedule_pending(timeout=0)
+            except Exception:
+                pass
+            self.scheduler.cache.cleanup_expired_assumed_pods()
+            self._settle()
+        if cm_active:
+            for pg in self.admin.pod_groups().list(namespace=None):
+                try:
+                    self.podgroups.sync(pg.metadata.key())
+                except Exception:
+                    pass  # chaos mid-resubmit: the next tick re-syncs
+                self._settle()
         self.clock.step(self.clock_step)
 
     def _virtual_kubelets(self) -> None:
         """The hollow node fleet: every live node heartbeats (unless the
         injector silenced it) and reports its non-terminal bound pods
         Running — through the ADMIN client, so kubelet-side writes are
-        not part of the injected fault surface."""
+        not part of the injected fault surface.
+
+        Container tracking: a kubelet that marked a pod Running holds a
+        "container" for it. Each pass ORPHAN-GCs containers whose pod
+        the store no longer binds to this node — after a torn-WAL
+        restart the store may have forgotten a pod entirely (its create
+        was in the lost tail) while the kubelet still runs its workload;
+        a real kubelet's syncLoop kills exactly these."""
         nodes = sorted(n.metadata.name for n in self.admin.nodes().list())
         alive = {n for n in nodes if self.injector.node_alive(n)}
+        placed = {}
+        for pod in self.admin.pods().list(namespace=None):
+            if pod.spec.node_name:
+                placed.setdefault(pod.spec.node_name, set()).add(
+                    pod.metadata.key())
+        for node in sorted(self._containers):
+            if node in alive:
+                orphans = self._containers[node] - placed.get(node, set())
+                if not orphans:
+                    continue
+                self._containers[node] -= orphans
+                self._orphans_gced += len(orphans)
+                self.metrics.kubelet_orphans_gced.inc(len(orphans))
+                self.injector.record("kubelet_orphan_gc", node,
+                                     len(orphans))
+            if not self._containers[node]:
+                del self._containers[node]
         for name in nodes:
             if not self.injector.allow_heartbeat(name):
                 continue
@@ -562,6 +1084,8 @@ class ChaosHarness:
             try:
                 self.admin.pods(pod.metadata.namespace).patch(
                     pod.metadata.name, run_status)
+                self._containers.setdefault(
+                    pod.spec.node_name, set()).add(pod.metadata.key())
             except NotFoundError:
                 pass
 

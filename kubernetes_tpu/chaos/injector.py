@@ -94,6 +94,10 @@ class FaultInjector:
         self.metrics = metrics
         self.step = 0
         self.partitioned = False
+        #: while True, every mutating verb against Lease objects fails —
+        #: the renew-deadline fencing fault (a holder that cannot renew
+        #: must stop leading BEFORE the lease expires for a standby)
+        self.lease_suppressed = False
         self._lock = threading.Lock()
         #: resource -> number of watch streams opened (the per-resource
         #: connection index that keys drop decisions)
@@ -162,6 +166,23 @@ class FaultInjector:
             self._count("partition")
         self.record("partition" if on else "heal")
 
+    def suppress_lease(self, on: bool = True) -> None:
+        """Fail every Lease write until resumed — a partition scoped to
+        the election lock. The current holder misses renewals, fences
+        itself at renew_deadline, and (once resumed) a standby acquires
+        after lease expiry: the failover path without killing anyone."""
+        self.lease_suppressed = on
+        if on:
+            self._count("suppress_lease")
+        self.record("suppress_lease" if on else "resume_lease")
+
+    def tear_wal(self, n: int) -> None:
+        """Record + count a torn-tail fault: the harness chops the last
+        `n` journal records (state/wal.tear_wal) before a store restart.
+        The surgery itself is the harness's — it owns the wal_path."""
+        self._count("tear_wal")
+        self.record("tear_wal", n)
+
     def node_alive(self, name: str) -> bool:
         with self._lock:
             return name not in self._down
@@ -184,6 +205,11 @@ class FaultInjector:
             self._count("api_error")
             raise ChaosError(
                 f"injected partition: {op} {resource}/{name}")
+        if self.lease_suppressed and resource == "leases":
+            self.record("lease_write_drop", op, name)
+            self._count("api_error")
+            raise ChaosError(
+                f"injected lease suppression: {op} {resource}/{name}")
         if self.error_rate <= 0.0:
             return
         with self._lock:

@@ -69,10 +69,18 @@ def wal_digest(path: str) -> dict:
 
 class InvariantChecker:
     def __init__(self, client, scheduler=None,
-                 wal_path: Optional[str] = None):
+                 wal_path: Optional[str] = None,
+                 factories=None, informer_classes=None):
         self.client = client
         self.scheduler = scheduler
         self.wal_path = wal_path
+        #: SharedInformerFactory list + resource classes for the
+        #: post-settle convergence sweep (check_convergence) — the
+        #: torn-WAL recovery contract: after a regressed restart settles,
+        #: store == informer caches == scheduler cache and no pod is
+        #: invisible to the scheduler
+        self.factories = list(factories) if factories is not None else []
+        self.informer_classes = tuple(informer_classes or ())
 
     # ------------------------------------------------------------ sweeps
 
@@ -82,8 +90,98 @@ class InvariantChecker:
         if self.scheduler is not None:
             out += self.check_cache_assumes()
             out += self.check_gang_reservations()
+        if self.factories:
+            out += self.check_convergence()
         if self.wal_path is not None:
             out += self.check_wal_replay()
+        return out
+
+    def check_convergence(self) -> List[str]:
+        """The recovery convergence sweep: after quiescence, every layer
+        of derived state agrees with the store.
+
+          a. Informer caches mirror the store exactly — no ghost object a
+             relist should have pruned, no missing object, no stale rv.
+          b. The scheduler cache charges exactly the store's bound,
+             non-terminal pods (same node); phantom capacity from a
+             regressed bind must be gone.
+          c. No pod is INVISIBLE to the scheduler: every non-terminal,
+             unbound, undeleted pod it is responsible for sits in its
+             queue (active, backoff, unschedulable, or gang-parked) or is
+             assumed mid-bind — a pod in neither place would be stuck
+             Pending forever with nothing ever retrying it.
+        """
+        out: List[str] = []
+        store = self.client.store
+        scheme = self.client.scheme
+        for fac in self.factories:
+            with fac._lock:
+                informers = dict(fac._informers)
+            for cls in self.informer_classes:
+                inf = informers.get(cls)
+                if inf is None:
+                    continue  # this component never watched the class
+                resource = scheme.resource_for(cls)
+                items, _ = store.list(resource)
+                want = {o.metadata.key(): o.metadata.resource_version
+                        for o in items}
+                have = {o.metadata.key(): o.metadata.resource_version
+                        for o in inf.indexer.list()}
+                for key in sorted(set(want) | set(have)):
+                    if key not in have:
+                        out.append(f"convergence: {resource} {key} in the "
+                                   f"store but missing from an informer "
+                                   f"cache")
+                    elif key not in want:
+                        out.append(f"convergence: informer cache holds "
+                                   f"ghost {resource} {key} the store "
+                                   f"does not")
+                    elif want[key] != have[key]:
+                        out.append(f"convergence: {resource} {key} at rv "
+                                   f"{have[key]} in an informer cache vs "
+                                   f"{want[key]} in the store")
+        if self.scheduler is None:
+            return out
+        pods = self.client.pods().list(namespace=None)
+        bound = {p.metadata.key(): p.spec.node_name for p in pods
+                 if p.spec.node_name
+                 and p.status.phase not in ("Succeeded", "Failed")}
+        cache = self.scheduler.cache
+        with cache.lock:
+            cached = {k: p.spec.node_name
+                      for k, p in cache._pod_states.items()}
+            assumed = set(cache._assumed)
+        for key in sorted(set(bound) | set(cached)):
+            if key not in cached:
+                out.append(f"convergence: bound pod {key} (node "
+                           f"{bound[key]}) missing from the scheduler "
+                           f"cache")
+            elif key not in bound:
+                if key in assumed:
+                    continue  # in-flight assume; check_cache_assumes rules
+                out.append(f"convergence: scheduler cache charges {key} "
+                           f"to node {cached[key]} but the store has no "
+                           f"such bind")
+            elif bound[key] != cached[key]:
+                out.append(f"convergence: {key} bound to {bound[key]} in "
+                           f"the store vs {cached[key]} in the scheduler "
+                           f"cache")
+        queued = {p.metadata.key()
+                  for p in self.scheduler.queue.pending_pods()}
+        responsible = getattr(self.scheduler, "_responsible",
+                              lambda p: True)
+        for p in pods:
+            if p.spec.node_name or p.status.phase in ("Succeeded", "Failed"):
+                continue
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            if not responsible(p):
+                continue
+            key = p.metadata.key()
+            if key not in queued and key not in assumed:
+                out.append(f"convergence: pod {key} is Pending but "
+                           f"invisible to the scheduler (not queued, not "
+                           f"assumed) — permanently stuck")
         return out
 
     def _live_nodes(self) -> dict:

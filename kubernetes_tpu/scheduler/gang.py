@@ -352,6 +352,40 @@ class GangManager:
             if not g.waiting:
                 g.first_wait = None
 
+    def bind_regressed(self, pod: Pod
+                       ) -> Tuple[List[Tuple[Pod, Pod]], List[Pod]]:
+        """The store REGRESSED this member's bind (torn-WAL recovery:
+        the journal lost the bind transaction's tail and the pod is
+        Pending again). The member leaves the bound set — its re-add
+        flows through pod_pending like any requeue — and, per the PR 2
+        whole-group convention, every reservation the gang still holds
+        at the permit gate rolls back NOW: the group's placement
+        integrity is in doubt (sibling binds may be torn too, the
+        dom_pin may reference a placement the store no longer records),
+        and waiting out scheduleTimeoutSeconds just delays the retry.
+        Returns (rollbacks, requeue) in node_gone's shape."""
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return [], []
+        with self._lock:
+            g = self._gangs.get(gkey)
+            if g is None:
+                return [], []
+            g.bound.discard(pod.metadata.key())
+            rollbacks: List[Tuple[Pod, Pod]] = []
+            requeue: List[Pod] = []
+            now = self._clock.now()
+            for p, clone, _node, since in g.waiting.values():
+                rollbacks.append((p, clone))
+                requeue.append(p)
+                if self.metrics is not None:
+                    self.metrics.gang_permit_wait.observe(now - since)
+            g.waiting.clear()
+            g.first_wait = None
+            self._gc(g)  # clears dom_pin with the last reservation
+            self._observe_pending()
+            return rollbacks, requeue
+
     def pod_dropped(self, pod: Pod) -> None:
         """A member left the system for good: deleted in flight, deleted or
         terminal after binding, duplicate bind. Unlike pod_gone (queue

@@ -379,11 +379,50 @@ class Scheduler:
                 self.cache.add_pod(new)
                 self.queue.delete(new)
                 self.queue.assigned_pod_updated(new)
-        elif self._responsible(new):
+        else:
+            if old.spec.node_name:
+                # the store UN-bound this pod: its rv clock regressed
+                # (torn-WAL recovery) and a bind no longer exists. The
+                # cache charges bound pods regardless of schedulerName
+                # (_on_pod_add), so the cleanup must run BEFORE the
+                # responsibility gate or a foreign scheduler's regressed
+                # pod holds phantom capacity forever; only the requeue
+                # below is ours-only.
+                self._bind_regressed(old, new)
+            if not self._responsible(new):
+                return
             if new.metadata.deletion_timestamp is not None:
                 self.queue.delete(new)
                 return
             self.queue.update(old, new)
+
+    def _bind_regressed(self, old: Pod, new: Pod) -> None:
+        """A bound (or assumed) pod is Pending again in the store — the
+        recovery path after a regressed restart. The cache's copy holds
+        phantom capacity on a node the store no longer charges; chained
+        device usage counts a winner that never survived; a gang sibling
+        set may be torn mid-transaction. Roll all of it back (gangs
+        whole-group, the PR 2 convention) and let the pod reschedule."""
+        self.cache.remove_pod(old)  # drops the assumed flag too
+        self.algorithm.mirror.invalidate_usage()
+        self._pipe_phantom = True
+        self.volume_binder.forget_pod_volumes(old)
+        self._record_event(
+            new, "BindRegressed",
+            "bind lost with the store's journal tail; rescheduling")
+        if self.gang is None or not self.gang.is_member(old):
+            return
+        rollbacks, requeue = self.gang.bind_regressed(old)
+        if not rollbacks:
+            return
+        self.cache.forget_pods([clone for _, clone in rollbacks])
+        for pod in requeue:
+            self.volume_binder.forget_pod_volumes(pod)
+            self._record_event(
+                pod, "FailedScheduling",
+                "gang reservation rolled back: a sibling's bind "
+                "regressed with the store; rescheduling the whole gang")
+            self.queue.add(pod)
 
     def _on_pod_delete(self, pod: Pod) -> None:
         if pod.spec.node_name:

@@ -184,19 +184,32 @@ class SharedInformer:
             if self._watch is not None:
                 self._watch.stop()
 
+    def repoint(self, rc: ResourceClient) -> None:
+        """Fail this informer over to a new transport (a promoted standby
+        apiserver) WITHOUT a restart: the current watch stream is severed
+        and the next round reconnects through `rc` at last_sync_rv. When
+        the standby preserved the primary's resourceVersions (the
+        StoreReplica contract) and the resume rv is still inside its
+        history window, the failover costs one reconnect — no relist, no
+        indexer rebuild, and the component's caches stay warm."""
+        with self._lock:
+            self._rc = rc
+            self._resource = getattr(rc, "_resource", self._resource)
+            self._bookmark_capable = None  # re-probe the new transport
+            if self._watch is not None:
+                self._watch.stop()
+
     def _delays(self) -> Iterator[float]:
-        """The reconnect schedule: the shared policy's escalation, then
-        its cap forever (a reflector retries indefinitely — backoff
-        exhaustion must not strand the informer). Jitter is seeded per
-        INSTANCE: after a hub restart severs every replica's streams,
-        identically-seeded delays would reconnect the whole fleet at the
-        same instants — a synchronized herd against the recovering
-        server. The read path sits outside the chaos event-log
-        determinism contract, so instance-varying jitter breaks nothing."""
-        yield from self.BACKOFF.delays(seed=id(self) & 0xFFFFFFFF,
-                                       op=self._resource)
-        while True:
-            yield self.BACKOFF.cap
+        """The reconnect schedule: the shared retry-forever policy (a
+        reflector retries indefinitely — backoff exhaustion must not
+        strand the informer). Jitter is seeded per INSTANCE: after a hub
+        restart severs every replica's streams, identically-seeded delays
+        would reconnect the whole fleet at the same instants — a
+        synchronized herd against the recovering server. The read path
+        sits outside the chaos event-log determinism contract, so
+        instance-varying jitter breaks nothing."""
+        return self.BACKOFF.delays_forever(seed=id(self) & 0xFFFFFFFF,
+                                           op=self._resource)
 
     def _run(self) -> None:
         auth_error_logged = False
@@ -495,6 +508,16 @@ class SharedInformerFactory:
             self._started = True
         for inf in informers:
             inf.start()
+
+    def repoint(self, client: Client) -> None:
+        """Fail every informer over to a new client (promoted standby):
+        each reconnects at its last_sync_rv — see SharedInformer.repoint.
+        Informers created AFTER this call also ride the new client."""
+        with self._lock:
+            self._client = client
+            informers = dict(self._informers)
+        for cls, inf in informers.items():
+            inf.repoint(client.resource(cls))
 
     def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
         with self._lock:

@@ -33,7 +33,7 @@ class LeaderElector:
                  retry_period: float = DEFAULT_RETRY_PERIOD,
                  on_started_leading: Optional[Callable[[], None]] = None,
                  on_stopped_leading: Optional[Callable[[], None]] = None,
-                 clock: Clock = REAL_CLOCK):
+                 clock: Clock = REAL_CLOCK, metrics=None):
         self.client = client
         self.name = name
         self.identity = identity
@@ -44,10 +44,19 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.clock = clock
+        #: RobustnessMetrics (optional): leader_transitions_total rides
+        #: the owner's registry
+        self.metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.is_leader = False
         self._acquire_error_logged = False
+        self._release_error_logged = False
+        # step()-mode state (the chaos harness's synchronous election):
+        # next instant an acquire/renew attempt is due, and the last
+        # successful renew — both on the injected clock
+        self._next_attempt: Optional[float] = None
+        self._last_renew: float = 0.0
 
     # ------------------------------------------------------------ lease ops
 
@@ -134,9 +143,30 @@ class LeaderElector:
             return lease
         try:
             self._leases().patch(self.name, mutate)
-        except Exception:
-            pass
+            self._release_error_logged = False
+        except Exception as e:
+            # a failed release is not fatal (standbys wait out the lease
+            # duration instead of taking over immediately) but it IS an
+            # availability cost — say so once per streak and count it,
+            # never swallow it silently
+            if self.metrics is not None:
+                self.metrics.api_give_ups.inc(
+                    component="leaderelection", op="release")
+            if not self._release_error_logged:
+                self._release_error_logged = True
+                import logging
+                logging.getLogger("leaderelection").warning(
+                    "%s/%s: lease release failed — standbys must wait "
+                    "out the full lease duration: %r",
+                    self.name, self.identity, e)
         self.is_leader = False
+
+    def _became_leader(self) -> None:
+        self.is_leader = True
+        if self.metrics is not None:
+            self.metrics.leader_transitions.inc(name=self.name)
+        if self.on_started_leading:
+            self.on_started_leading()
 
     # -------------------------------------------------------------- loop
 
@@ -147,9 +177,7 @@ class LeaderElector:
             if not self._try_acquire_or_renew():
                 self._stop.wait(self.retry_period)
                 continue
-            self.is_leader = True
-            if self.on_started_leading:
-                self.on_started_leading()
+            self._became_leader()
             last_renew = self.clock.now()
             while not self._stop.is_set():
                 self._stop.wait(self.retry_period)
@@ -159,6 +187,31 @@ class LeaderElector:
                     last_renew = self.clock.now()
                 elif self.clock.now() - last_renew > self.renew_deadline:
                     break  # fencing: stop leading when renewal fails
+            self.is_leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def step(self) -> None:
+        """One synchronous election iteration on the injected clock — the
+        threadless form of run() the chaos harness drives from its single
+        driver thread (a FakeClock makes the whole election, renew
+        deadlines included, a deterministic function of the schedule).
+
+        Semantics match run() exactly: attempts are paced by retry_period;
+        a holder that cannot renew within renew_deadline FENCES ITSELF
+        (is_leader drops and on_stopped_leading fires) before any standby
+        can acquire — the lease_duration > renew_deadline gap is the
+        fencing guarantee the double-bind invariant rests on."""
+        now = self.clock.now()
+        if self._next_attempt is not None and now < self._next_attempt:
+            return
+        self._next_attempt = now + self.retry_period
+        if self._try_acquire_or_renew():
+            self._last_renew = now
+            if not self.is_leader:
+                self._became_leader()
+            return
+        if self.is_leader and now - self._last_renew > self.renew_deadline:
             self.is_leader = False
             if self.on_stopped_leading:
                 self.on_stopped_leading()

@@ -23,6 +23,8 @@ import threading
 from typing import Dict, List, Optional
 
 from ..runtime.scheme import SCHEME
+from ..utils.backoff import BackoffPolicy
+from ..utils.clock import Clock, REAL_CLOCK
 from .store import Store
 
 
@@ -76,12 +78,25 @@ class StoreReplica:
     registered resource, applying frames into a local store at the
     primary's revisions."""
 
+    #: relist/retry schedule after a follower error (primary down, 410):
+    #: escalates like the informer reflector's, resets on progress
+    BACKOFF = BackoffPolicy(base=0.05, factor=2.0, cap=2.0, attempts=8,
+                            jitter=0.2)
+
     def __init__(self, primary_client, store: Optional[Store] = None,
-                 resources: Optional[List[str]] = None):
+                 resources: Optional[List[str]] = None,
+                 clock: Clock = REAL_CLOCK, seed: int = 0):
         self.client = primary_client
         self.store = store if store is not None else ReadOnlyStore()
         self._resources = list(resources) if resources is not None \
             else list(SCHEME.resources())
+        #: injected clock: retry sleeps WAIT on it (see _sleep — a
+        #: FakeClock is stepped by the driver, never by follower
+        #: threads), so the follower's retry timing is steppable and
+        #: deterministic under a harness; the seed keys the backoff
+        #: jitter the same way the rest of the chaos subsystem is keyed
+        self.clock = clock
+        self.seed = seed
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         #: resource -> highest primary rv applied (lag observability)
@@ -99,9 +114,35 @@ class StoreReplica:
             self._threads.append(t)
         return self
 
+    def _retry_delays(self, resource: str):
+        """The follower's retry-forever schedule (exhaustion must never
+        strand replication). Jitter is deterministic per (seed,
+        resource): a harness replaying one seed sees identical retry
+        timing."""
+        return self.BACKOFF.delays_forever(seed=self.seed, op=resource)
+
+    def _sleep(self, seconds: float) -> None:
+        """Retry sleep: interruptible real wait on the default clock
+        (stop()/promote() must not hang on a sleeping follower). With an
+        INJECTED clock the follower WAITS for virtual time to pass —
+        polling until the driver steps the clock past the deadline — and
+        never advances it itself: FakeClock.sleep() is step(), and a
+        follower thread stepping the SHARED harness clock would move
+        lease/eviction deadlines at schedule-independent instants,
+        destroying the identical-event-log contract (and a zero-cost
+        virtual sleep would real-time busy-spin while the primary is
+        down). Driver steps the clock ⇒ the retry fires; stop() always
+        interrupts."""
+        if self.clock is REAL_CLOCK:
+            self._stop.wait(seconds)
+            return
+        deadline = self.clock.now() + seconds
+        while not self._stop.is_set() and self.clock.now() < deadline:
+            self._stop.wait(0.005)
+
     def _follow(self, resource: str, cls) -> None:
-        import time
         rc = self.client.resource(cls)
+        delays = None
         while not self._stop.is_set():
             try:
                 items, rv = rc.list_rv()
@@ -110,6 +151,7 @@ class StoreReplica:
                 # replica's rv/uid clocks past the primary's
                 self.store.replace_replicated(resource, items, int(rv))
                 self.applied_rv[resource] = int(rv)
+                delays = None  # the list landed: reset the backoff
                 w = rc.watch(resource_version=int(rv))
                 try:
                     import queue as qm
@@ -132,9 +174,13 @@ class StoreReplica:
                 finally:
                     w.stop()
             except Exception:
+                # primary down or 410: back off (escalating, seeded
+                # jitter), then relist — never a blind fixed sleep
                 if self._stop.is_set():
                     return
-                time.sleep(0.2)  # primary down or 410: relist
+                if delays is None:
+                    delays = self._retry_delays(resource)
+                self._sleep(next(delays))
 
     def caught_up(self, resource: str, rv: int) -> bool:
         return self.applied_rv.get(resource, 0) >= rv

@@ -118,7 +118,7 @@ class Store:
     HISTORY_WINDOW = 4096  # retained events for watch resume (watchCache capacity)
 
     def __init__(self, wal_path: Optional[str] = None,
-                 wal_sync: bool = False):
+                 wal_sync: bool = False, metrics=None):
         self._lock = threading.RLock()
         self._rv = 0
         # resource -> {(namespace, name) -> (obj, rv)}
@@ -130,6 +130,12 @@ class Store:
         self._next_watch_id = 0
         self._uid_counter = 0
         self._wal = None
+        #: RobustnessMetrics (optional): WAL append-error and replay
+        #: recovery accounting ride the owner's registry
+        self.metrics = metrics
+        #: the last replay's accounting (state/wal.WalRecovery), None
+        #: until a WAL-backed store has replayed at least once
+        self.wal_recovery = None
         if wal_path is not None:
             self._replay_wal(wal_path)
             from .wal import WalWriter
@@ -138,14 +144,24 @@ class Store:
             # keeps the synchronous writer so flush() can fdatasync per txn
             self._wal = WalWriter(wal_path, sync=wal_sync,
                                   deferred=not wal_sync,
-                                  encoder=serde.encode_cached)
+                                  encoder=serde.encode_cached,
+                                  metrics=metrics)
 
     # ---------------------------------------------------------------- wal
 
     def _replay_wal(self, path: str) -> None:
         from ..runtime.scheme import SCHEME
-        from .wal import load_wal
-        records, clean_offset = load_wal(path)
+        from .wal import load_wal_ex
+        recovery = load_wal_ex(path)
+        self.wal_recovery = recovery
+        if self.metrics is not None:
+            self.metrics.wal_recovery_records_replayed.inc(
+                recovery.records_replayed)
+            self.metrics.wal_recovery_records_dropped.inc(
+                recovery.records_dropped)
+            self.metrics.wal_recovery_truncated_bytes.inc(
+                recovery.truncated_bytes)
+        records, clean_offset = recovery.records, recovery.clean_offset
         for rec in records:
             if rec["op"] == "META":
                 # compaction high-water marker: restores the true _rv even
@@ -267,7 +283,8 @@ class Store:
             w.close()
             os.replace(tmp, path)
             self._wal = WalWriter(path, sync=sync, deferred=not sync,
-                                  encoder=serde.encode_cached)
+                                  encoder=serde.encode_cached,
+                                  metrics=self.metrics)
 
     def close(self) -> None:
         with self._lock:
@@ -276,7 +293,7 @@ class Store:
                 self._wal.close()
                 self._wal = None
 
-    def restart(self) -> None:
+    def restart(self, torn: int = 0) -> int:
         """Crash-restart the store process in place: drain and close the
         journal, drop ALL in-memory state (objects, watch history, live
         watch subscriptions), and rebuild by replaying the WAL — the
@@ -289,9 +306,14 @@ class Store:
         causes. Requires a wal_path'd store; a WAL-less restart would be
         data loss, not recovery, and raises instead.
 
-        The journal tail is drained before the crash point (the wal_sync
-        deployment's guarantee); testing torn-tail loss is wal.py's
-        domain, not this hook's."""
+        `torn=N` chops the last N journal records between the close and
+        the replay (state/wal.tear_wal) — the disk lost the tail, the
+        replayed rv clock REGRESSES below what watchers and caches have
+        observed, and any resume at a now-future rv answers ExpiredError
+        so clients relist and prune ghosts (watch() enforces this for
+        every regressed store). torn=0 keeps the drained-tail guarantee
+        of the wal_sync deployment. Returns the number of records
+        actually torn (the journal may hold fewer than requested)."""
         with self._lock:
             if self._wal is None:
                 raise RuntimeError(
@@ -302,6 +324,10 @@ class Store:
             self._wal.flush()
             self._wal.close()
             self._wal = None
+            actually_torn = 0
+            if torn > 0:
+                from .wal import tear_wal
+                actually_torn = tear_wal(path, torn)
             # sever every live stream: each watcher sees its queue end
             watches = list(self._watches.values())
             self._watches.clear()
@@ -315,7 +341,9 @@ class Store:
             self._replay_wal(path)
             from .wal import WalWriter
             self._wal = WalWriter(path, sync=sync, deferred=not sync,
-                                  encoder=serde.encode_cached)
+                                  encoder=serde.encode_cached,
+                                  metrics=self.metrics)
+            return actually_torn
 
     # ------------------------------------------------------------- writes
 
@@ -577,7 +605,18 @@ class Store:
         """Apply a full primary LIST as a replace (the reflector's
         Replace semantics): upsert every listed object and PRUNE local
         keys the primary no longer has — an object deleted during a
-        watch outage must not survive as a ghost on the replica."""
+        watch outage must not survive as a ghost on the replica.
+
+        A listed object at a rv BELOW the local copy's is accepted, not
+        skipped: the primary's consistent LIST is authoritative, and a
+        lower rv means the primary REGRESSED under the follower (torn-WAL
+        recovery truncated history the follower already applied). Keeping
+        the lost future would fork the replica from its primary forever —
+        the etcd-learner analog is a snapshot resync after leader log
+        truncation. Only an rv-identical copy is skipped (no change).
+        The replica's own rv clock never regresses (_follow_clock_locked
+        keeps the high-water mark), so a later promote still mints rvs
+        above anything EITHER timeline handed out."""
         with self._lock:
             bucket = self._data.setdefault(resource, {})
             listed = set()
@@ -586,7 +625,7 @@ class Store:
                 listed.add(key)
                 obj_rv = int(obj.metadata.resource_version or 0)
                 cur = bucket.get(key)
-                if cur is not None and cur[1] >= obj_rv:
+                if cur is not None and cur[1] == obj_rv:
                     continue
                 bucket[key] = (obj, obj_rv)
                 self._journal("PUT", resource, obj, obj_rv)
@@ -670,6 +709,16 @@ class Store:
         with self._lock:
             self._next_watch_id += 1
             w = Watch(self, self._next_watch_id)
+            if resource_version is not None and resource_version > self._rv:
+                # a FUTURE rv: no honest client can hold one, so the
+                # store's clock must have REGRESSED under this watcher
+                # (torn-WAL recovery). Answering "from now" would let the
+                # client keep ghost objects the store lost — force the
+                # 410 relist instead (ref: apiserver's invalid-rv watch
+                # handling; etcd answers ErrFutureRev)
+                raise ExpiredError(
+                    f"resourceVersion {resource_version} is ahead of the "
+                    f"store ({self._rv}): state regressed; relist")
             if resource_version is not None and resource_version < self._rv:
                 oldest = self._history[0][0] if self._history else self._rv + 1
                 if resource_version + 1 < oldest and resource_version < self._rv:

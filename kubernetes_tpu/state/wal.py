@@ -17,8 +17,22 @@ record per transaction (one encode + one append for the whole batch —
 at 16k binds/batch the per-record dumps were the hub's largest WAL
 cost); the legacy per-pod {"op": "BIND"} shape still replays.
 
+Integrity (ref: etcd wal records carry a per-record CRC): every record
+written since the checksum change is framed as
+
+    [len u32][payload]   payload = b"C" + crc32(body) u32 + body
+
+where `body` is the JSON bytes. Legacy records are bare JSON payloads
+(body[0] == "{") and still replay. The CRC lets `load_wal` stop at a
+CORRUPT record anywhere in the file — bit rot in the middle, not just a
+short tail — and report what it dropped (`load_wal_ex`); the store
+truncates to the last verified record on open, exactly like the torn
+tail path. `tear_wal` chops the last N complete records off a closed
+log — the chaos harness's "lose the journal tail" fault.
+
 The append hot path runs in C (native/walcore.cc) when the toolchain is
-available; the python fallback is behavior-identical.
+available; the python fallback is behavior-identical. The CRC rides
+INSIDE the payload, so both appenders produce it unchanged.
 """
 
 from __future__ import annotations
@@ -27,7 +41,12 @@ import ctypes
 import json
 import os
 import struct
-from typing import Iterator, Optional, Tuple
+import zlib
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
+
+#: payload magic for checksummed records; legacy JSON payloads begin "{"
+_CRC_MAGIC = b"C"
 
 
 class _FlushSentinel:
@@ -103,14 +122,24 @@ class WalWriter:
     crash loses the unflushed tail either way; etcd's guarantee needs
     wal_sync=True, where flush() drains the queue and fdatasyncs).
     `encoder` converts non-dict payloads (frozen store objects) to
-    JSON-able dicts, worker-side when deferred."""
+    JSON-able dicts, worker-side when deferred.
+
+    `metrics` (utils/metrics.RobustnessMetrics) counts worker-side append
+    failures as `wal_append_errors_total` — a record the worker could not
+    write is DATA LOSS at the next replay, and the old
+    traceback-to-stderr-and-keep-going left no machine-readable trace of
+    it (the PR 5 no-silent-failure convention)."""
 
     def __init__(self, path: str, sync: bool = False,
-                 deferred: bool = False, encoder=None):
+                 deferred: bool = False, encoder=None, metrics=None):
         self.path = path
         self.sync = sync
         self.native = False
         self._encoder = encoder
+        self.metrics = metrics
+        #: True while the worker is inside an append-failure streak —
+        #: logged once per streak, reset on the first clean append
+        self._append_error_streak = False
         from ..native import load
         lib = load("walcore")
         if lib is not None:
@@ -135,9 +164,10 @@ class WalWriter:
         if obj_data is not None and not isinstance(obj_data, dict) \
                 and self._encoder is not None:
             obj_data = self._encoder(obj_data)
-        return json.dumps(
+        body = json.dumps(
             {"op": op, "resource": resource, "rv": rv, "uc": uid_counter,
              "object": obj_data}, separators=(",", ":")).encode()
+        return _CRC_MAGIC + struct.pack("<I", zlib.crc32(body)) + body
 
     def _run(self) -> None:
         while True:
@@ -157,9 +187,19 @@ class WalWriter:
                 continue
             try:
                 self._a.append(self._encode_record(*item))
-            except Exception:
-                import traceback
-                traceback.print_exc()
+            except Exception as e:
+                # a dropped record is silent data loss at the next
+                # replay: COUNT every one, log once per failure streak
+                if self.metrics is not None:
+                    self.metrics.wal_append_errors.inc()
+                if not self._append_error_streak:
+                    self._append_error_streak = True
+                    import logging
+                    logging.getLogger("wal").error(
+                        "wal append failed — record(s) LOST from the "
+                        "journal until the streak clears: %r", e)
+            else:
+                self._append_error_streak = False
             if self._q.empty():
                 self._a.flush(False)
 
@@ -171,10 +211,17 @@ class WalWriter:
         self._a.append(self._encode_record(op, resource, rv, obj_data,
                                            uid_counter))
 
-    def drain(self, timeout: float = 30.0, sync: bool = False) -> bool:
+    #: how long flush()/close() wait for the worker to confirm the tail
+    #: is on disk (tests shrink it to drive the timeout path)
+    drain_timeout = 30.0
+
+    def drain(self, timeout: Optional[float] = None,
+              sync: bool = False) -> bool:
         """Wait until every record enqueued BEFORE this call hit the file
         (deferred mode). Returns False (and logs) on timeout — callers
         must not report durability the worker did not confirm."""
+        if timeout is None:
+            timeout = self.drain_timeout
         if self._q is None:
             return True
         sentinel = _FlushSentinel(sync)
@@ -208,34 +255,105 @@ class WalWriter:
         self._a.close()
 
 
-def load_wal(path: str) -> Tuple[list, int]:
-    """Replay-side: (records, clean_offset). Reading stops at a torn or
-    corrupt tail; clean_offset is the byte position of the last COMPLETE
-    record — the caller must truncate to it before appending, or records
-    written after a crash-recovery restart land behind the torn bytes and
-    the NEXT replay swallows them into one garbage payload (etcd's wal
-    does the same truncate-on-open)."""
-    records: list = []
-    offset = 0
+@dataclass
+class WalRecovery:
+    """What one replay pass found — the torn/corrupt accounting the
+    store surfaces as `wal_recovery_*` metrics after a restart."""
+    records: List[dict] = field(default_factory=list)
+    #: byte position of the last VERIFIED record; the caller truncates
+    #: here before appending (etcd's truncate-on-open)
+    clean_offset: int = 0
+    #: complete frames that failed verification (CRC mismatch or
+    #: unparseable body) and were discarded with everything after them
+    records_dropped: int = 0
+    #: bytes past clean_offset at open time — the torn/corrupt tail the
+    #: store cuts before serving
+    truncated_bytes: int = 0
+
+    @property
+    def records_replayed(self) -> int:
+        return len(self.records)
+
+
+def load_wal_ex(path: str) -> WalRecovery:
+    """Replay-side: verified records + recovery accounting. Reading stops
+    at the first record that fails verification — a short frame (torn
+    tail), a CRC mismatch (bit rot ANYWHERE in the file, not just the
+    tail), or an unparseable legacy body — because everything after an
+    unverified record is untrustworthy (etcd's wal does the same).
+    clean_offset is the byte position after the last verified record —
+    the caller must truncate to it before appending, or records written
+    after a crash-recovery restart land behind the torn bytes and the
+    NEXT replay swallows them into one garbage payload."""
+    out = WalRecovery()
     if not os.path.exists(path):
-        return records, offset
+        return out
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
         while True:
             hdr = f.read(4)
             if len(hdr) < 4:
-                return records, offset
+                break
             (n,) = struct.unpack("<I", hdr)
             payload = f.read(n)
             if len(payload) < n:
-                return records, offset  # torn tail
+                break  # torn tail
+            if payload[:1] == _CRC_MAGIC and n >= 5:
+                (want,) = struct.unpack("<I", payload[1:5])
+                body = payload[5:]
+                if zlib.crc32(body) != want:
+                    out.records_dropped += 1
+                    break  # corrupt record: stop, mid-file included
+            else:
+                body = payload  # legacy frame: JSON parse is the check
             try:
-                records.append(json.loads(payload))
+                out.records.append(json.loads(body))
             except ValueError:
-                return records, offset  # corrupt tail
-            offset += 4 + n
+                out.records_dropped += 1
+                break  # corrupt record
+            out.clean_offset += 4 + n
+    out.truncated_bytes = max(0, size - out.clean_offset)
+    return out
+
+
+def load_wal(path: str) -> Tuple[list, int]:
+    """(records, clean_offset) — the original compact form; load_wal_ex
+    carries the recovery accounting."""
+    rec = load_wal_ex(path)
+    return rec.records, rec.clean_offset
 
 
 def read_wal(path: str) -> Iterator[dict]:
     """Records only (tests/tools); Store uses load_wal for the offset."""
     records, _ = load_wal(path)
     return iter(records)
+
+
+def tear_wal(path: str, n: int) -> int:
+    """Chop the last `n` COMPLETE records off a closed log — the chaos
+    harness's durable-state-loss fault (`restart_store(torn=n)`): the
+    disk "loses" the journal tail and the replayed store's rv clock
+    regresses below what watchers and caches have already seen. Returns
+    the number of records actually removed (the file may hold fewer).
+    The caller must not hold the file open in a writer."""
+    if n <= 0 or not os.path.exists(path):
+        return 0
+    offsets: List[int] = []  # byte offset of each complete record
+    pos = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (length,) = struct.unpack("<I", hdr)
+            payload = f.read(length)
+            if len(payload) < length:
+                break
+            offsets.append(pos)
+            pos += 4 + length
+    torn = min(n, len(offsets))
+    if torn == 0:
+        return 0
+    with open(path, "rb+") as f:
+        f.truncate(offsets[len(offsets) - torn])
+    return torn

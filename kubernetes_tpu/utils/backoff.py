@@ -50,6 +50,15 @@ class BackoffPolicy:
             yield min(self.cap, delay) * jit
             delay *= self.factor
 
+    def delays_forever(self, seed: Optional[int] = None,
+                       op: str = "") -> Iterator[float]:
+        """The retry-forever schedule: the policy's escalation, then its
+        cap unjittered for good — for loops that must never exhaust
+        (informer reflectors, replication followers)."""
+        yield from self.delays(seed=seed, op=op)
+        while True:
+            yield self.cap
+
 
 #: the control-plane default (nodelifecycle patches, scheduler binds)
 DEFAULT_POLICY = BackoffPolicy()

@@ -313,6 +313,44 @@ class RobustnessMetrics:
             "scheduler_pipelined_commit_rollbacks_total",
             "Pipelined commit stages that lost winners and invalidated "
             "chained device usage")
+        #: records the deferred WAL worker could NOT write — silent data
+        #: loss at the next replay unless someone is watching this
+        self.wal_append_errors = r.counter(
+            "wal_append_errors_total",
+            "WAL records dropped by a failed append on the writer worker")
+        #: torn/corrupt-tail recovery accounting, accumulated across every
+        #: replay (store open + restart) this process performed
+        self.wal_recovery_records_replayed = r.counter(
+            "wal_recovery_records_replayed_total",
+            "Verified WAL records replayed across store opens/restarts")
+        self.wal_recovery_records_dropped = r.counter(
+            "wal_recovery_records_dropped_total",
+            "Complete-but-corrupt WAL records discarded at replay "
+            "(CRC mismatch or unparseable body)")
+        self.wal_recovery_truncated_bytes = r.counter(
+            "wal_recovery_truncated_bytes_total",
+            "Bytes cut off the journal tail by truncate-on-open")
+        #: leadership changes (a fresh acquire by a non-holder), by
+        #: election name — the reference's leader_election_master_status
+        #: flaps collapsed to a transition counter
+        self.leader_transitions = r.counter(
+            "leader_transitions_total",
+            "Leader elections won by a new holder, by election name")
+        #: lease-expiry -> standby's first effective action (first bind
+        #: for the scheduler election) — the availability gap a leader
+        #: kill actually costs, in (virtual) seconds
+        self.leader_failover_seconds = r.histogram(
+            "leader_failover_seconds",
+            "Seconds between losing a leader and the standby's first "
+            "bind, by election name",
+            buckets=(1.0, 2.0, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0,
+                     90.0, 120.0, 180.0))
+        #: containers a virtual kubelet garbage-collected because the
+        #: store no longer knows their pod (torn-WAL recovery: the pod's
+        #: create was lost with the journal tail)
+        self.kubelet_orphans_gced = r.counter(
+            "kubelet_orphan_containers_gced_total",
+            "Containers removed for pods the store no longer knows")
 
 
 #: pod-startup latency buckets (seconds) — wider than the scheduler's

@@ -1,0 +1,473 @@
+"""Torn-WAL recovery, chaos-driven leader failover, and the
+replica-promote drill (ISSUE 8).
+
+The acceptance invariants:
+  - same seed => byte-identical chaos event logs for every new fault
+    class, failover timing entries included
+  - after losing the last N journal records the cluster reconverges to
+    the semantic end state of a fault-free run of the same surviving
+    schedule (store-state parity), with the convergence sweep green:
+    store == informer caches == scheduler cache, no pod stuck
+  - zero double-binds across forced failovers: a deposed leader
+    provably stops (its leader_deposed precedes the standby's
+    leader_acquired in the step-ordered log) before the standby's
+    first bind
+  - a promoted replica continues the rv timeline monotonically, loses
+    no acknowledged write below the replication horizon, and informers
+    fail over with a reconnect, not a relist
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.core import Node, Pod
+from kubernetes_tpu.api.scheduling import PodGroup
+from kubernetes_tpu.chaos import ChaosHarness, InvariantChecker
+from kubernetes_tpu.state.store import ExpiredError, NotFoundError, Store
+from kubernetes_tpu.state.wal import load_wal
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.metrics import RobustnessMetrics
+
+
+def _checker(h):
+    return InvariantChecker(h.admin, scheduler=h.scheduler,
+                            wal_path=h.wal_path,
+                            factories=h._factories(),
+                            informer_classes=(Pod, Node, PodGroup))
+
+
+# ------------------------------------------------------ torn-WAL recovery
+
+
+class TestTornWalRecovery:
+    def test_future_rv_watch_answers_410_after_regression(self, tmp_path):
+        """A watcher resuming at a rv the regressed store has never
+        issued must get ExpiredError (410), not a silent from-now watch
+        that lets it keep ghost objects."""
+        from kubernetes_tpu.state import Client
+        from tests.test_wal import make_pod
+        path = str(tmp_path / "w.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        for i in range(6):
+            client.pods("default").create(make_pod(f"p{i}"))
+        store.flush_wal()
+        rv_head = store.resource_version
+        store.restart(torn=3)
+        assert store.resource_version < rv_head  # the clock regressed
+        with pytest.raises(ExpiredError):
+            store.watch("pods", resource_version=rv_head)
+        # at-or-below the replayed head is still servable
+        store.watch("pods", resource_version=store.resource_version)
+        store.close()
+
+    def test_torn_restart_reconverges_to_parity(self, tmp_path):
+        """ACCEPTANCE: tear the journal tail back past the bind records
+        (creations survive) — the store un-binds pods under a scheduler
+        that still holds their assumes. After the recovery sweep the
+        cluster must reach the SAME semantic end state a fault-free run
+        reached, with the convergence sweep green."""
+        h = ChaosHarness(seed=3, nodes=4, error_rate=0.0,
+                         wal_path=str(tmp_path / "t.wal"))
+        try:
+            h.start()
+            h._create_gang(2, 250)
+            h._create_pod("solo", 100)
+            for _ in range(4):
+                h._tick()
+            target = h.store_state()  # the fault-free end state
+            assert all(bound for res, _, _, _, bound in target
+                       if res == "pods"), "precondition: everything bound"
+            h.admin.store.flush_wal()
+            # tear everything after the workload creations: every bind
+            # and status record goes; the creates survive
+            records, _ = load_wal(h.wal_path)
+            keep = 0
+            for i, rec in enumerate(records):
+                if rec["op"] in ("BIND", "BINDS"):
+                    keep = i
+                    break
+            torn = len(records) - keep
+            h.restart_store(torn=torn)
+            # every pod is Pending again in the store
+            assert all(not p.spec.node_name
+                       for p in h.admin.pods().list(namespace=None))
+            for _ in range(6):
+                h._tick()
+            assert h.store_state() == target, "store-state parity lost"
+            assert _checker(h).check() == []
+            assert h.admin.store.wal_recovery.records_replayed == keep
+        finally:
+            h.close()
+
+    def test_erased_pod_pruned_everywhere_and_orphan_gced(self, tmp_path):
+        """A pod whose CREATE was in the torn tail no longer exists: the
+        informers must prune the ghost, the scheduler must drop every
+        trace, and the virtual kubelet must orphan-GC its container."""
+        h = ChaosHarness(seed=3, nodes=4, error_rate=0.0,
+                         wal_path=str(tmp_path / "g.wal"))
+        try:
+            h.start()
+            h._create_gang(2, 250)
+            for _ in range(3):
+                h._tick()
+            h.admin.store.flush_wal()
+            n_before = len(load_wal(h.wal_path)[0])
+            h._create_pod("ghost", 100)
+            for _ in range(2):
+                h._tick()
+            assert h.admin.pods("default").get("ghost").spec.node_name
+            h.admin.store.flush_wal()
+            torn = len(load_wal(h.wal_path)[0]) - n_before
+            h.restart_store(torn=torn)
+            with pytest.raises(NotFoundError):
+                h.admin.pods("default").get("ghost")
+            for _ in range(4):
+                h._tick()
+            assert h._orphans_gced >= 1  # the kubelet killed the container
+            assert any(ev[1] == "kubelet_orphan_gc"
+                       for ev in h.injector.events)
+            # nothing anywhere still knows the ghost
+            for fac in h._factories():
+                for inf in fac._informers.values():
+                    for obj in inf.indexer.list():
+                        assert obj.metadata.name != "ghost"
+            assert _checker(h).check() == []
+        finally:
+            h.close()
+
+    def test_foreign_scheduler_pod_regression_clears_cache(self):
+        """The cache charges bound pods regardless of schedulerName, so
+        the bound->Pending regression cleanup must too — a foreign
+        scheduler's regressed pod must not hold phantom capacity."""
+        from kubernetes_tpu.api import serde
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.state import Client
+        from tests.test_chaos import make_node, make_pod
+        client = Client()
+        sched = Scheduler(client, batch_size=4)
+        node = make_node("n1")
+        sched.cache.add_node(node)
+        bound = make_pod("alien", node="n1")
+        bound.spec.scheduler_name = "other-scheduler"
+        sched._on_pod_add(bound)
+        assert sched.cache.get_pod(bound) is not None
+        unbound = serde.deepcopy_obj(bound)
+        unbound.spec.node_name = ""
+        sched._on_pod_update(bound, unbound)
+        assert sched.cache.get_pod(bound) is None, \
+            "foreign pod's regressed bind left phantom capacity"
+        assert sched.queue.num_pending() == 0  # not ours: never queued
+
+    def test_scheduled_tears_smoke_same_seed_identical_logs(self, tmp_path):
+        """ACCEPTANCE (tier-1 cut): seeded runs with tear_wal IN the
+        schedule produce identical event logs and end invariants-green."""
+        logs = []
+        for i in range(2):
+            h = ChaosHarness(seed=29, nodes=6, error_rate=0.05,
+                             with_restarts=True, with_tears=True,
+                             wal_path=str(tmp_path / f"s{i}.wal"))
+            try:
+                r = h.run(n_events=16, quiesce_steps=10)
+                assert r.ok, r.violations
+                logs.append(r.events)
+                assert r.wal_tears > 0, "seed 29 must draw a tear"
+            finally:
+                h.close()
+        assert logs[0] == logs[1]
+
+
+# -------------------------------------------------- leader failover (HA)
+
+
+class TestLeaderElectionStep:
+    def test_step_mode_acquire_fence_takeover(self):
+        """The synchronous election on a FakeClock: B cannot acquire
+        while A renews; when A's writes start failing A fences itself at
+        renew_deadline — STRICTLY BEFORE B can acquire at lease expiry."""
+        from kubernetes_tpu.state import Client
+        from kubernetes_tpu.state.leaderelection import LeaderElector
+        clock = FakeClock()
+        client = Client()
+        metrics = RobustnessMetrics()
+        log = []
+        kw = dict(lease_duration=25.0, renew_deadline=10.0,
+                  retry_period=5.0, clock=clock, metrics=metrics)
+        a = LeaderElector(client, "cm", "a",
+                          on_started_leading=lambda: log.append("a+"),
+                          on_stopped_leading=lambda: log.append("a-"), **kw)
+        b = LeaderElector(client, "cm", "b",
+                          on_started_leading=lambda: log.append("b+"),
+                          on_stopped_leading=lambda: log.append("b-"), **kw)
+        a.step()
+        b.step()
+        assert a.is_leader and not b.is_leader
+        for _ in range(4):  # healthy renewals hold the standby off
+            clock.step(5.0)
+            a.step()
+            b.step()
+        assert a.is_leader and not b.is_leader
+        # A's lease writes start failing (suppression / dead hub)
+        real_leases = a._leases
+
+        def broken():
+            raise RuntimeError("lease writes suppressed")
+        a._leases = broken
+        fence_time = None
+        takeover_time = None
+        for _ in range(12):
+            clock.step(5.0)
+            a.step()
+            b.step()
+            if fence_time is None and not a.is_leader:
+                fence_time = clock.now()
+            if takeover_time is None and b.is_leader:
+                takeover_time = clock.now()
+        assert fence_time is not None, "holder never fenced"
+        assert takeover_time is not None, "standby never acquired"
+        assert fence_time < takeover_time, \
+            "fencing must complete before the takeover"
+        assert log == ["a+", "a-", "b+"]
+        assert metrics.leader_transitions.value(name="cm") == 2
+
+    def test_release_failure_logged_and_counted(self):
+        from kubernetes_tpu.state import Client
+        from kubernetes_tpu.state.leaderelection import LeaderElector
+        metrics = RobustnessMetrics()
+        el = LeaderElector(Client(), "cm", "x", metrics=metrics)
+
+        def broken():
+            raise RuntimeError("down")
+        el._leases = broken
+        el.release()  # must not raise
+        assert metrics.api_give_ups.value(
+            component="leaderelection", op="release") == 1
+
+
+class TestHAFailover:
+    _KW = dict(nodes=6, error_rate=0.05, ha=True, with_restarts=True)
+
+    def test_ha_smoke_same_seed_identical_logs_zero_double_binds(
+            self, tmp_path):
+        """ACCEPTANCE (tier-1 cut of the HA soak): leader kills and
+        lease suppression in the schedule; two same-seed runs produce
+        byte-identical event logs — bind stamps and failover timing
+        entries included — and the double-bind sweep stays empty."""
+        reports = []
+        for i in range(2):
+            h = ChaosHarness(seed=28, wal_path=str(tmp_path / f"h{i}.wal"),
+                             **self._KW)
+            try:
+                r = h.run(n_events=16, quiesce_steps=12)
+                assert r.ok, r.violations
+                reports.append(r)
+            finally:
+                h.close()
+        r1, r2 = reports
+        assert r1.events == r2.events
+        assert r1.leader_kills + r1.lease_suppressions > 0, \
+            "seed 28 must force at least one failover"
+        assert r1.pods_bound > 0
+        assert any(ev[1] == "bind" for ev in r1.events)
+
+    def test_deposed_leader_stops_before_standby_acquires(self, tmp_path):
+        """The fencing guarantee, read off the step-ordered log: every
+        leader_acquired that follows a suppression-driven deposition
+        comes AFTER the deposed holder's leader_deposed entry, and no
+        bind is stamped by a non-holder (check_ha_binds)."""
+        h = ChaosHarness(seed=11, nodes=4, error_rate=0.0, ha=True,
+                         wal_path=str(tmp_path / "f.wal"))
+        try:
+            h.start()
+            h._create_pod("p1", 100)
+            for _ in range(3):
+                h._tick()
+            assert h._sched_leader is not None
+            holder = h._sched_leader
+            h.injector.suppress_lease(True)
+            deposed_at = None
+            for i in range(8):
+                h._tick()
+                if deposed_at is None and h._sched_leader is None:
+                    deposed_at = i
+            assert deposed_at is not None, "holder never fenced"
+            h.injector.suppress_lease(False)
+            h._create_pod("p2", 100)
+            for _ in range(8):
+                h._tick()
+            assert h.admin.pods("default").get("p2").spec.node_name
+            assert h.check_ha_binds() == []
+            # log order: the deposition precedes any later acquisition
+            kinds = [(ev[1], ev[2] if len(ev) > 2 else None)
+                     for ev in h.injector.events]
+            dep = kinds.index(("leader_deposed", "kube-scheduler"))
+            acq_after = [i for i, k in enumerate(kinds)
+                         if k == ("leader_acquired", "kube-scheduler")
+                         and i > dep]
+            assert acq_after, "no re-acquisition after the deposition"
+        finally:
+            h.close()
+
+    def test_kill_leader_failover_timing_recorded(self, tmp_path):
+        h = ChaosHarness(seed=11, nodes=4, error_rate=0.0, ha=True,
+                         wal_path=str(tmp_path / "k.wal"))
+        try:
+            h.start()
+            h._create_pod("p1", 100)
+            for _ in range(3):
+                h._tick()
+            killed = h.kill_leader("kube-scheduler")
+            assert killed is not None
+            h._create_pod("p2", 100)
+            for _ in range(10):
+                h._tick()
+            # the standby bound p2 and the failover gap was measured
+            assert h.admin.pods("default").get("p2").spec.node_name
+            failovers = [ev for ev in h.injector.events
+                         if ev[1] == "leader_failover"
+                         and ev[2] == "kube-scheduler"]
+            assert len(failovers) == 1
+            assert failovers[0][3] > 0  # virtual seconds, deterministic
+            assert h.metrics.leader_failover_seconds.count(
+                name="kube-scheduler") == 1
+            assert h.check_ha_binds() == []
+        finally:
+            h.close()
+
+
+# ------------------------------------------------- replica-promote drill
+
+
+class TestReplicaPromote:
+    def test_promote_drill_continuity_and_no_relist(self, tmp_path):
+        """ACCEPTANCE: the standby continues the rv timeline, loses no
+        acknowledged write, serves new writes, and the informers fail
+        over with a reconnect — zero additional relists."""
+        h = ChaosHarness(seed=5, nodes=4, error_rate=0.0, replica=True,
+                         wal_path=str(tmp_path / "p.wal"))
+        try:
+            h.start()
+            h._create_gang(2, 250)
+            h._create_pod("pre", 100)
+            for _ in range(3):
+                h._tick()
+            rv_before = h.admin.store.resource_version
+            relists_before = [fac.metrics.relists.value(resource="pods")
+                              for fac in h._factories()]
+            assert h.promote_replica() == []
+            assert h.admin.store.resource_version >= rv_before
+            assert h.admin.store.read_only is False
+            h._create_pod("post", 100)
+            for _ in range(4):
+                h._tick()
+            assert h.admin.pods("default").get("post").spec.node_name
+            relists_after = [fac.metrics.relists.value(resource="pods")
+                             for fac in h._factories()]
+            assert relists_after == relists_before, \
+                "failover must resume watches, not relist"
+            assert _checker(h).check() == []
+            assert any(ev[1] == "kill_primary" for ev in h.injector.events)
+            assert any(ev[1] == "promote" for ev in h.injector.events)
+        finally:
+            h.close()
+
+    def test_follower_resyncs_after_primary_regression(self, tmp_path):
+        """A torn-WAL restart REGRESSES the primary under a live
+        follower. The follower's relist must accept the downgrade (the
+        primary's consistent LIST is authoritative — the etcd-learner
+        snapshot-resync analog), not keep the future the primary lost."""
+        from kubernetes_tpu.state import Client
+        from kubernetes_tpu.state.replication import StoreReplica
+        from tests.test_wal import make_pod
+        path = str(tmp_path / "p.wal")
+        primary = Store(wal_path=path)
+        client = Client(primary)
+        client.pods("default").create(make_pod("keep"))
+        rep = StoreReplica(Client(primary)).start()
+        try:
+            assert rep.wait_synced(15)
+            # churn the follower has already applied...
+            got = client.pods("default").get("keep")
+            for i in range(4):
+                got.metadata.labels["v"] = str(i)
+                got = client.pods("default").update(got)
+            client.pods("default").create(make_pod("doomed"))
+            primary.flush_wal()
+            deadline = time.time() + 15
+            while time.time() < deadline \
+                    and rep.store.contents() != primary.contents():
+                time.sleep(0.02)
+            assert rep.store.contents() == primary.contents()
+            # ...then the primary loses it to a torn tail
+            primary.restart(torn=3)
+            deadline = time.time() + 15
+            while time.time() < deadline \
+                    and rep.store.contents() != primary.contents():
+                time.sleep(0.02)
+            assert rep.store.contents() == primary.contents(), \
+                "follower kept state the primary lost"
+        finally:
+            rep.stop()
+            rep.store.close()
+            primary.close()
+
+    def test_follower_retry_uses_seeded_backoff(self):
+        """Satellite: the follower's error path waits out the shared
+        backoff policy's seeded delays on the injected clock — no bare
+        time.sleep(0.2), deterministic per (seed, resource), and the
+        follower NEVER advances a shared FakeClock itself (it waits for
+        the driver's step; stop() interrupts)."""
+        import threading
+        from kubernetes_tpu.state.replication import StoreReplica
+        a = list(StoreReplica.BACKOFF.delays(seed=7, op="pods"))
+        b = list(StoreReplica.BACKOFF.delays(seed=7, op="pods"))
+        assert a == b and len(a) == StoreReplica.BACKOFF.attempts - 1
+        clock = FakeClock()
+        rep = StoreReplica.__new__(StoreReplica)
+        rep.clock = clock
+        rep.seed = 7
+        rep._stop = threading.Event()
+        before = clock.now()
+        delays = rep._retry_delays("pods")
+        t = threading.Thread(target=lambda: rep._sleep(next(delays)))
+        t.start()
+        time.sleep(0.05)
+        assert t.is_alive()                 # waiting, not stepping
+        assert clock.now() == before        # the shared clock untouched
+        clock.step(a[0] + 0.001)            # the DRIVER advances time
+        t.join(timeout=2)
+        assert not t.is_alive()
+        # stop() interrupts a pending virtual sleep immediately
+        t2 = threading.Thread(target=lambda: rep._sleep(999.0))
+        t2.start()
+        rep._stop.set()
+        t2.join(timeout=2)
+        assert not t2.is_alive()
+
+
+# ---------------------------------------------------------- the full soak
+
+
+class TestRobustnessSoak:
+    @pytest.mark.slow
+    def test_soak_500_events_tears_kills_suppression_promote(
+            self, tmp_path):
+        """ACCEPTANCE (full soak, -m slow): 500 chaos events mixing
+        workload churn, node kills, API errors, partitions, component
+        restarts, torn-WAL restarts, leader kills, lease suppression,
+        and ONE replica-promote drill — InvariantChecker green (the
+        convergence sweep included), zero double-binds."""
+        h = ChaosHarness(seed=42, nodes=12, error_rate=0.05, ha=True,
+                         with_restarts=True, with_tears=True, replica=True,
+                         wal_path=str(tmp_path / "soak.wal"))
+        try:
+            r = h.run(n_events=500, quiesce_steps=40, promote_at_step=250)
+            assert r.ok, r.violations[:20]
+            assert r.gangs_created > 20
+            assert r.wal_tears > 0
+            assert r.leader_kills + r.lease_suppressions > 0
+            assert r.promoted
+            assert r.failovers, "no failover was ever timed"
+        finally:
+            h.close()

@@ -209,6 +209,174 @@ class TestWal:
         store2.close()
 
 
+def _frame_offsets(path):
+    """(offset, length) of every complete frame in the log."""
+    out = []
+    pos = 0
+    data = open(path, "rb").read()
+    while pos + 4 <= len(data):
+        (n,) = struct.unpack("<I", data[pos:pos + 4])
+        if pos + 4 + n > len(data):
+            break
+        out.append((pos, 4 + n))
+        pos += 4 + n
+    return out
+
+
+class TestChecksums:
+    """The WAL durability contract: every new record carries a CRC32,
+    replay stops at corruption ANYWHERE (not just a short tail), legacy
+    frames still replay, and a torn tail is truncated on open."""
+
+    def test_records_carry_crc_and_roundtrip(self, tmp_path):
+        from kubernetes_tpu.state.wal import WalWriter, read_wal
+        path = str(tmp_path / "crc.wal")
+        w = WalWriter(path)
+        w.append("PUT", "pods", 1, {"metadata": {"name": "x"}})
+        w.flush()
+        w.close()
+        raw = open(path, "rb").read()
+        (n,) = struct.unpack("<I", raw[:4])
+        payload = raw[4:4 + n]
+        assert payload[:1] == b"C"  # checksummed frame
+        import zlib
+        (want,) = struct.unpack("<I", payload[1:5])
+        assert zlib.crc32(payload[5:]) == want
+        assert list(read_wal(path)) == [
+            {"op": "PUT", "resource": "pods", "rv": 1, "uc": 0,
+             "object": {"metadata": {"name": "x"}}}]
+
+    def test_corrupt_middle_record_stops_replay(self, tmp_path):
+        """A CRC mismatch MID-FILE (bit rot, not a torn tail) must stop
+        the replay at the corrupt record — everything after it is
+        untrustworthy — and be counted as dropped."""
+        from kubernetes_tpu.state.wal import WalWriter, load_wal_ex
+        path = str(tmp_path / "rot.wal")
+        w = WalWriter(path)
+        for i in range(10):
+            w.append("PUT", "pods", i + 1, {"n": i})
+        w.flush()
+        w.close()
+        frames = _frame_offsets(path)
+        assert len(frames) == 10
+        off, length = frames[5]
+        with open(path, "rb+") as f:  # flip one byte inside record 5's body
+            f.seek(off + 4 + 5 + 2)
+            b = f.read(1)
+            f.seek(off + 4 + 5 + 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        rec = load_wal_ex(path)
+        assert rec.records_replayed == 5
+        assert [r["rv"] for r in rec.records] == [1, 2, 3, 4, 5]
+        assert rec.records_dropped == 1
+        assert rec.clean_offset == off
+        assert rec.truncated_bytes > 0
+
+    def test_legacy_frames_still_replay(self, tmp_path):
+        """Pre-checksum logs (bare JSON payloads) replay unchanged, and
+        a log may mix legacy and CRC frames (an upgraded process
+        appending to an old journal)."""
+        import json
+        from kubernetes_tpu.state.wal import WalWriter, read_wal
+        path = str(tmp_path / "legacy.wal")
+        with open(path, "wb") as f:
+            for i in range(3):
+                body = json.dumps({"op": "PUT", "resource": "pods",
+                                   "rv": i + 1, "uc": 0,
+                                   "object": {"n": i}}).encode()
+                f.write(struct.pack("<I", len(body)) + body)
+        w = WalWriter(path)  # appends CRC frames behind the legacy ones
+        w.append("PUT", "pods", 4, {"n": 3})
+        w.flush()
+        w.close()
+        assert [r["rv"] for r in read_wal(path)] == [1, 2, 3, 4]
+
+    def test_tear_wal_then_truncate_on_open(self, tmp_path):
+        """tear_wal chops the last N records; the reopened store serves
+        the surviving prefix, truncates the file to what it verified,
+        and the journal again replays to exactly the live store."""
+        from kubernetes_tpu.chaos.invariants import wal_digest
+        from kubernetes_tpu.state.wal import tear_wal
+        path = str(tmp_path / "tear.wal")
+        store = Store(wal_path=path)
+        client = Client(store)
+        for i in range(5):
+            client.pods("default").create(make_pod(f"p{i}"))
+        store.close()
+        assert tear_wal(path, 2) == 2
+        store2 = Store(wal_path=path)
+        names = [p.metadata.name for p in Client(store2).pods("default").list()]
+        assert names == ["p0", "p1", "p2"]
+        assert store2.wal_recovery.records_replayed == 3
+        client2 = Client(store2)
+        client2.pods("default").create(make_pod("post-tear"))
+        store2.flush_wal()
+        assert wal_digest(path) == store2.contents()
+        store2.close()
+
+    def test_tear_more_than_file_holds(self, tmp_path):
+        from kubernetes_tpu.state.wal import WalWriter, tear_wal, read_wal
+        path = str(tmp_path / "t.wal")
+        w = WalWriter(path)
+        w.append("PUT", "pods", 1, {})
+        w.flush()
+        w.close()
+        assert tear_wal(path, 99) == 1
+        assert list(read_wal(path)) == []
+
+    def test_append_errors_counted_not_swallowed(self, tmp_path):
+        """The deferred worker must COUNT every record it fails to write
+        (wal_append_errors_total) — the old traceback-and-continue left
+        silent data loss."""
+        from kubernetes_tpu.state.wal import WalWriter
+        from kubernetes_tpu.utils.metrics import RobustnessMetrics
+
+        class _Broken:
+            def append(self, payload):
+                raise OSError("disk on fire")
+
+            def flush(self, sync):
+                pass
+
+            def close(self):
+                pass
+        metrics = RobustnessMetrics()
+        path = str(tmp_path / "b.wal")
+        w = WalWriter(path, deferred=True, metrics=metrics)
+        w._a = _Broken()
+        for i in range(5):
+            w.append("PUT", "pods", i + 1, {})
+        w.drain(timeout=5)
+        assert metrics.wal_append_errors.value() == 5
+
+
+class TestSyncDrainContract:
+    def test_sync_flush_raises_on_timed_out_drain(self, tmp_path):
+        """wal_sync=True is a durability CONTRACT: a flush whose drain
+        the worker never confirms must raise, not silently ack an fsync
+        that never happened."""
+        import time as time_mod
+        from kubernetes_tpu.state.wal import WalWriter
+
+        class _Stuck:
+            def append(self, payload):
+                time_mod.sleep(5)
+
+            def flush(self, sync):
+                pass
+
+            def close(self):
+                pass
+        path = str(tmp_path / "stuck.wal")
+        w = WalWriter(path, sync=True, deferred=True)
+        w._a = _Stuck()
+        w.drain_timeout = 0.2
+        w.append("PUT", "pods", 1, {"n": 1})
+        import pytest
+        with pytest.raises(OSError, match="did not confirm"):
+            w.flush()
+
+
 class TestDeferredDrain:
     def test_drain_confirms_tail_on_disk(self, tmp_path):
         """drain() is serviced by the worker via a flush sentinel (all
