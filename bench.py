@@ -1216,6 +1216,61 @@ def main():
     }))
 
 
+TRACE_OUT = os.environ.get("BENCH_TRACE_OUT", "bench_trace.jsonl")
+
+
+def trace_main():
+    """`bench.py --trace` — run the headline uniform config with the
+    span tracer at DEFAULT sampling, dump the flight recorder as JSONL,
+    and report per-stage p50/p99 from the batch/stage spans
+    (launch/tensorize/scan_wait/fetch/commit/bind_txn), cross-checked
+    against measure_device_profile's pipeline section — the stage
+    attribution the ISSUE 11 acceptance reads."""
+    import gc
+    from kubernetes_tpu.observability import stage_percentiles
+    from kubernetes_tpu.serving.slo import SLOTracker
+    rate, scheduled, sched, setup_s, elapsed = run_config(
+        N_NODES, N_PODS, "uniform", warm_all_buckets=False)
+    recorder = sched.tracer.recorder
+    stages = stage_percentiles(recorder, component="scheduler")
+    # exact per-pod stage breakdown from the SAMPLED pod traces
+    # (queue admit -> drain -> bound); running never happens here (no
+    # kubelets), so only the scheduler-side stages appear
+    pod_stages = SLOTracker.stage_breakdown(recorder)
+    with open(TRACE_OUT, "w") as f:
+        f.write(recorder.export_jsonl())
+    spans_recorded = len(recorder)
+    spans_dropped = dict(recorder.dropped)
+    del sched
+    gc.collect()
+    device_profile = None
+    if os.environ.get("BENCH_DEVICE_PROFILE", "1") != "0" \
+            and N_PODS >= 16384:
+        try:
+            device_profile = measure_device_profile(
+                N_NODES, min(N_PODS, 16384), 16384)
+        except Exception as e:
+            device_profile = {"error": str(e)}
+    print(json.dumps({
+        "metric": "bench --trace per-stage span percentiles "
+                  f"({N_PODS} pods x {N_NODES} nodes)",
+        "value": round(rate, 1),
+        "unit": "pods/s",
+        "detail": {
+            "scheduled": scheduled,
+            "elapsed_s": round(elapsed, 2),
+            "flight_recorder": TRACE_OUT,
+            "spans_recorded": spans_recorded,
+            "spans_dropped": spans_dropped,
+            "stage_percentiles": stages,
+            "pod_stage_breakdown": pod_stages,
+            # cross-check: stage spans vs the device profiler's serial
+            # stage attribution and pipelined critical path
+            "device_profile": device_profile,
+        },
+    }))
+
+
 def serving_main():
     """`bench.py serving` — just the churn section: the p50/p95/p99
     pod-startup-latency-vs-arrival-rate curve on the wire config."""
@@ -1234,5 +1289,7 @@ def serving_main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
+    elif "--trace" in sys.argv[1:]:
+        trace_main()
     else:
         main()

@@ -90,11 +90,29 @@ class APIServer:
                  max_mutating_inflight: int = 200,
                  max_nonmutating_inflight: int = 400,
                  request_timeout: float = 60.0,
-                 cors_allowed_origins: Optional[List[str]] = None):
+                 cors_allowed_origins: Optional[List[str]] = None,
+                 metrics=None, flight_recorder=None):
         self.client = Client(store)
         self.store = self.client.store
         self.scheme = scheme
         self.admission = AdmissionChain()
+        # ---- observability surface (ISSUE 11): the hub is the cluster's
+        # scrape point. `metrics` is an observability.MetricsRegistry
+        # aggregating every attached component's families (collision-
+        # checked) plus the hub's own request/watch counters, served at
+        # GET /metrics; `flight_recorder` backs /debug/traces; pending
+        # providers (scheduler.debugger.pending_report) back
+        # /debug/pending; `health` checks gate /readyz.
+        from ..observability import FlightRecorder, MetricsRegistry
+        from ..utils.healthz import HealthChecks
+        from ..utils.metrics import APIServerMetrics
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.request_metrics = APIServerMetrics()
+        self.metrics.add_registry("apiserver", self.request_metrics.registry)
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FlightRecorder()
+        self.health = HealthChecks()
+        self.pending_providers: List[Callable[[], dict]] = []
         #: structured audit trail (ref: apiserver/pkg/audit — the
         #: ResponseComplete stage as one JSON line per request)
         self._audit_file = open(audit_log_path, "a") \
@@ -374,6 +392,10 @@ class APIServer:
         # so a stale grant must not leak onto the NEXT request
         origin = h.headers.get("Origin", "")
         h._cors_origin = origin if self._cors_allowed(origin) else None
+        # keep-alive reuses the handler instance: a request that dies
+        # before writing any response must not be counted (or audited)
+        # under the PREVIOUS request's status code
+        h._audit_code = 0
         # overload protection: try-acquire the verb class's inflight slot;
         # full pool answers 429 + Retry-After instead of queueing the
         # thread (watches are long-running and exempt)
@@ -386,17 +408,36 @@ class APIServer:
                 self._error(h, 429, "TooManyRequests",
                             "too many requests, please try again later",
                             headers={"Retry-After": "1"})
+                # shed requests are exactly the ones the request counter
+                # exists to make visible during an overload event
+                self.request_metrics.requests.inc(
+                    verb=method, resource="", code="429")
                 return
             if self._request_timeout:
                 try:
                     h.connection.settimeout(self._request_timeout)
                 except Exception:
                     pass
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             self._dispatch_inner(h, method)
         finally:
             if sem is not None:
                 sem.release()
+            # request accounting (ref: apiserver_request_total): resource
+            # from the parsed request-info when routing got that far, the
+            # code the response actually carried; watch streams skip the
+            # duration histogram (their wall time is stream lifetime)
+            am = self.request_metrics
+            ctx = getattr(h, "_audit_ctx", None)
+            am.requests.inc(
+                verb=method,
+                resource=ctx[1].resource if ctx is not None else "",
+                code=str(getattr(h, "_audit_code", 0)))
+            if not is_watch:
+                am.request_duration.observe(_time.perf_counter() - t0,
+                                            verb=method)
             self._finish_audit(h)
 
     def _finish_audit(self, h) -> None:
@@ -414,8 +455,34 @@ class APIServer:
         try:
             url = urlparse(h.path)
             query = {k: v[0] for k, v in parse_qs(url.query).items()}
-            if url.path in ("/healthz", "/readyz", "/livez"):
+            if url.path in ("/healthz", "/livez"):
+                # liveness: the process is up and serving
                 self._respond_raw(h, 200, b"ok", "text/plain")
+                return
+            if url.path == "/readyz":
+                # readiness reflects registered component contributors
+                # (utils/healthz: scheduler informer sync/staleness,
+                # queue progress, controller loops) — not just server-up
+                failed = self.health.failed()
+                if failed:
+                    self._respond_raw(
+                        h, 500,
+                        ("unhealthy: " + ",".join(failed)).encode(),
+                        "text/plain")
+                else:
+                    self._respond_raw(h, 200, b"ok", "text/plain")
+                return
+            if url.path == "/metrics":
+                if self._observability_authorized(h):
+                    self._handle_metrics(h, method)
+                return
+            if url.path == "/debug/traces":
+                if self._observability_authorized(h):
+                    self._handle_debug_traces(h, query)
+                return
+            if url.path == "/debug/pending":
+                if self._observability_authorized(h):
+                    self._handle_debug_pending(h)
                 return
             req = self._parse(url.path, query)
             if req is None:
@@ -474,6 +541,77 @@ class APIServer:
                 self._error(h, 500, "InternalError", str(e))
             except Exception:
                 pass
+
+    # ------------------------------------------------- observability routes
+
+    def _observability_authorized(self, h) -> bool:
+        """On a SECURED hub (authenticator configured), /metrics and the
+        /debug endpoints require an authenticated caller — the reference
+        serves them behind the full handler chain, and DELETE /metrics
+        is a mutation no anonymous client may reach; pod names and span
+        attributes are cluster-internal detail. Only /healthz-class
+        liveness stays open. An open hub (no authenticator) keeps the
+        insecure-port shape. Writes the 401 on failure."""
+        if self.authenticator is None:
+            return True
+        user = None
+        peer_auth = getattr(self.authenticator, "authenticate_cert", None)
+        if peer_auth is not None and self._tls:
+            try:
+                der = h.connection.getpeercert(binary_form=True)
+            except Exception:
+                der = None
+            if der:
+                user = peer_auth(der)
+        if user is None:
+            user = self.authenticator.authenticate(
+                h.headers.get("Authorization", ""))
+        if user is None or "system:unauthenticated" in \
+                tuple(getattr(user, "groups", ()) or ()):
+            # bad credentials AND the no-credentials ANONYMOUS identity:
+            # the main API path lets the authorizer judge anonymous, but
+            # these endpoints have no resource to authorize against —
+            # authenticated-only is the gate
+            self._error(h, 401, "Unauthorized", "invalid credentials")
+            return False
+        return True
+
+    def _handle_metrics(self, h, method: str) -> None:
+        """GET /metrics — the aggregated text exposition; DELETE resets
+        values across every attached registry (ref: the scheduler's
+        DELETE /metrics -> metrics.Reset, server.go:287-291)."""
+        if method == "GET":
+            self._respond_raw(h, 200, self.metrics.expose().encode(),
+                              "text/plain; version=0.0.4")
+        elif method == "DELETE":
+            self.metrics.reset()
+            self._respond_raw(h, 200, b"metrics reset", "text/plain")
+        else:
+            self._error(h, 405, "MethodNotAllowed", method)
+
+    def _handle_debug_traces(self, h, query: dict) -> None:
+        """GET /debug/traces[?component=&trace=] — the flight recorder's
+        JSONL export (oldest-evicted ring; per-component drop counts ride
+        as X-Trace-Dropped so truncation is never silent)."""
+        body = self.flight.export_jsonl(
+            component=query.get("component") or None,
+            trace_id=query.get("trace") or None).encode()
+        dropped = sum(self.flight.dropped.values())
+        self._respond_raw(h, 200, body, "application/jsonl",
+                          headers={"X-Trace-Dropped": str(dropped)})
+
+    def _handle_debug_pending(self, h) -> None:
+        """GET /debug/pending — every registered component's pending-pod
+        report (scheduler.debugger.pending_report): pod, last failure
+        reason, attempts. The wire answer to 'why is my pod pending'."""
+        reports = []
+        for provider in list(self.pending_providers):
+            try:
+                reports.append(provider())
+            except Exception as e:
+                reports.append({"error": str(e)})
+        body = json.dumps({"pending": reports}).encode()
+        self._respond_raw(h, 200, body, "application/json")
 
     # ------------------------------------------------------------- handlers
 
@@ -1202,6 +1340,8 @@ class APIServer:
         bookmarks_ok = req.query.get("allowWatchBookmarks") in ("true", "1")
         watch = self.store.watch(req.resource, req.namespace or None,
                                  int(rv) if rv else None)
+        h._audit_code = 200
+        self.request_metrics.watch_streams.inc(resource=req.resource)
         h.send_response(200)
         h.send_header("Content-Type", "application/json;stream=watch")
         h.send_header("Transfer-Encoding", "chunked")
@@ -1293,12 +1433,15 @@ class APIServer:
                              f"{serde.to_json_cached(e.object)}}}\n")
                             .encode())
                 flush_slim()
+                self.request_metrics.watch_events.inc(
+                    len(batch), resource=req.resource)
                 write_chunk(b"".join(parts))
                 if closing:
                     break
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            self.request_metrics.watch_streams.dec(resource=req.resource)
             watch.stop()
             try:
                 h.wfile.write(b"0\r\n\r\n")

@@ -279,6 +279,14 @@ class ChaosHarness:
         self.ha = ha
         self.clock = FakeClock()
         self.metrics = RobustnessMetrics()
+        # span tracer on the SHARED FakeClock, sampling every pod: the
+        # determinism contract extends to traces — same seed => byte-
+        # identical span logs (span_log()). In HA mode each scheduler
+        # replica keeps its own default tracer instead: two replicas'
+        # informer threads would interleave writes into one component
+        # buffer and break the byte-identity contract.
+        from ..observability import SpanTracer
+        self.tracer = SpanTracer(clock=self.clock, pod_sample=1)
         self.injector = FaultInjector(
             seed=seed, error_rate=error_rate, metrics=self.metrics,
             reset_rate=reset_rate, latency_rate=latency_rate,
@@ -380,7 +388,8 @@ class ChaosHarness:
         return Scheduler(client if client is not None else self.client,
                          informer_factory=factory,
                          batch_size=64, clock=self.clock,
-                         async_bind=False)
+                         async_bind=False,
+                         tracer=None if self.ha else self.tracer)
 
     def _make_controllers(self, factory: SharedInformerFactory,
                           client=None) -> Tuple:
@@ -858,6 +867,12 @@ class ChaosHarness:
         report.store_state = self.store_state()
         return report
 
+    def span_log(self) -> str:
+        """The run's span trail as deterministic JSONL (virtual-clock
+        timestamps, store-counter UIDs, canonical ordering): the
+        surface the same-seed byte-identity test compares."""
+        return self.tracer.recorder.export_jsonl()
+
     def store_state(self) -> List[Tuple]:
         """The run's semantic end state: which objects exist, each pod's
         phase and whether it is bound — NOT which node (fault-driven
@@ -1086,6 +1101,10 @@ class ChaosHarness:
                     pod.metadata.name, run_status)
                 self._containers.setdefault(
                     pod.spec.node_name, set()).add(pod.metadata.key())
+                # the kubelet-Running leg of the pod's trace (driver
+                # thread, sorted pod order — deterministic)
+                self.tracer.pod_event("kubelet", "running", pod,
+                                      node=pod.spec.node_name)
             except NotFoundError:
                 pass
 

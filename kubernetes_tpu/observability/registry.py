@@ -1,0 +1,202 @@
+"""MetricsRegistry — the cross-component scrape surface.
+
+Ref: each reference component registers its families into one prometheus
+default registry and serves them on /metrics (pkg/scheduler/metrics,
+apiserver endpoints/metrics). Our components each own a utils.metrics
+Registry; this aggregator joins them into ONE text exposition with
+name-collision detection:
+
+  - two components exporting the SAME family name with a DIFFERENT
+    type, help text, or histogram buckets is a registration error
+    (raised at add_registry — the tier-1 registry-completeness check);
+  - the same family name with an IDENTICAL signature (two schedulers,
+    scheduler + controller-manager RobustnessMetrics) merges label-wise
+    at expose time, like prometheus multi-process aggregation, so the
+    exposition never carries a duplicate HELP/TYPE header.
+
+`parse_exposition` is the reverse direction: text -> families/samples,
+used by the scrape round-trip test to assert histogram invariants hold
+at the source.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.metrics import (Registry, _Metric, _fmt_labels,
+                             expose_histogram_series)
+
+
+def _signature(m: _Metric) -> tuple:
+    return (m.kind, m.help, getattr(m, "buckets", None))
+
+
+class MetricsRegistry:
+    """Aggregates component registries into one /metrics exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: component name -> live Registry (enumerated fresh at expose,
+        #: so families a component registers late still ride the scrape)
+        self._components: Dict[str, Registry] = {}
+
+    # ------------------------------------------------------- registration
+
+    def add_registry(self, component: str, registry: Registry) -> Registry:
+        """Attach a component's registry. Raises on a component-name
+        reuse (unless it is the same registry) or on any family whose
+        signature conflicts with an already-attached family."""
+        with self._lock:
+            cur = self._components.get(component)
+            if cur is not None and cur is not registry:
+                raise ValueError(
+                    f"component {component!r} already registered with a "
+                    f"different registry")
+            conflicts = self._conflicts_locked(extra=(component, registry))
+            if conflicts:
+                raise ValueError("metric family collision: "
+                                 + "; ".join(conflicts))
+            self._components[component] = registry
+        return registry
+
+    def components(self) -> List[str]:
+        with self._lock:
+            return sorted(self._components)
+
+    def _families_locked(self, extra: Optional[tuple] = None
+                         ) -> List[Tuple[str, List[_Metric]]]:
+        """(name, metrics) in first-registration order, deduped by
+        object identity (one registry attached under two components must
+        not double its values)."""
+        comps = list(self._components.items())
+        if extra is not None and extra[0] not in self._components:
+            comps.append(extra)
+        order: List[str] = []
+        families: Dict[str, List[_Metric]] = {}
+        for _, reg in comps:
+            with reg._lock:
+                metrics = list(reg._metrics.values())
+            for m in metrics:
+                group = families.get(m.name)
+                if group is None:
+                    order.append(m.name)
+                    families[m.name] = [m]
+                elif not any(g is m for g in group):
+                    group.append(m)
+        return [(name, families[name]) for name in order]
+
+    def _conflicts_locked(self, extra: Optional[tuple] = None) -> List[str]:
+        out = []
+        for name, group in self._families_locked(extra=extra):
+            sigs = {_signature(m) for m in group}
+            if len(sigs) > 1:
+                kinds = sorted({m.kind for m in group})
+                out.append(f"{name} registered with conflicting "
+                           f"signatures (kinds {kinds})")
+        return out
+
+    def check_collisions(self) -> List[str]:
+        """Re-verify the no-conflict invariant over families registered
+        since attach time (the completeness check's second pass)."""
+        with self._lock:
+            return self._conflicts_locked()
+
+    # --------------------------------------------------------- exposition
+
+    def expose(self) -> str:
+        with self._lock:
+            families = self._families_locked()
+        lines: List[str] = []
+        for name, group in families:
+            if len(group) == 1:
+                lines.extend(group[0].expose())
+            else:
+                lines.extend(self._merged_expose(name, group))
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _merged_expose(name: str, group: List[_Metric]) -> List[str]:
+        """Label-wise merge of same-signature duplicates. A conflicting
+        group (possible only via post-attach registration) exposes the
+        FIRST member and skips the rest — a scrape must stay valid text
+        even when check_collisions() has findings to report."""
+        first = group[0]
+        sig = _signature(first)
+        members = [m for m in group if _signature(m) == sig]
+        out = first._header()
+        if first.kind == "histogram":
+            merged: Dict[tuple, list] = {}
+            for m in members:
+                for key, (counts, total, n) in m.snapshot().items():
+                    s = merged.get(key)
+                    if s is None:
+                        merged[key] = [list(counts), total, n]
+                    else:
+                        s[0] = [a + b for a, b in zip(s[0], counts)]
+                        s[1] += total
+                        s[2] += n
+            out.extend(expose_histogram_series(
+                name, first.buckets, sorted(merged.items())))
+            return out
+        totals: Dict[tuple, float] = {}
+        for m in members:
+            for key, v in m.snapshot().items():
+                totals[key] = totals.get(key, 0.0) + v
+        for key, v in sorted(totals.items()) or [((), 0.0)]:
+            out.append(f"{name}{_fmt_labels(key)} {v}")
+        return out
+
+    def reset(self) -> None:
+        """DELETE /metrics semantics across every component: values zero,
+        families stay registered (utils.metrics.Registry.reset)."""
+        with self._lock:
+            regs = list(self._components.values())
+        for reg in regs:
+            reg.reset()
+
+
+# ----------------------------------------------------------------- parsing
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Text exposition -> {family: {"type", "help", "samples"}} where
+    samples are (sample_name, labels dict, float value) — the scrape-side
+    half of the round-trip test. Histogram/summary suffixes (_bucket,
+    _sum, _count) attach to their base family."""
+    families: Dict[str, dict] = {}
+
+    def fam(name: str) -> dict:
+        return families.setdefault(
+            name, {"type": "untyped", "help": "", "samples": []})
+
+    for raw in text.splitlines():
+        if raw.startswith("# HELP "):
+            name, _, help_text = raw[len("# HELP "):].partition(" ")
+            fam(name)["help"] = help_text
+        elif raw.startswith("# TYPE "):
+            name, _, kind = raw[len("# TYPE "):].partition(" ")
+            fam(name)["type"] = kind.strip()
+        elif raw.startswith("#") or not raw.strip():
+            continue
+        else:
+            m = _SAMPLE_RE.match(raw)
+            if m is None:
+                raise ValueError(f"malformed exposition line: {raw!r}")
+            sample_name, labels_raw, value = m.groups()
+            labels = dict(_LABEL_RE.findall(labels_raw or ""))
+            base = sample_name
+            for suffix in ("_bucket", "_sum", "_count"):
+                stem = sample_name[:-len(suffix)] \
+                    if sample_name.endswith(suffix) else None
+                if stem is not None and stem in families:
+                    base = stem
+                    break
+            fam(base)["samples"].append(
+                (sample_name, labels, float(value)))
+    return families

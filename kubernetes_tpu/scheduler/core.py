@@ -328,6 +328,10 @@ class BatchScheduler:
         #: scheduler.SchedulerMetrics, installed by the shell (None in
         #: bare-algorithm tests); used for in-scan fallback counters
         self.sched_metrics = None
+        #: observability.SpanTracer, installed by the shell: the device
+        #: path's stage spans (tensorize / scan wait) ride the same
+        #: flight recorder as the shell's launch/commit/bind spans
+        self.tracer = None
         self._fallback_streak: Dict[str, int] = {}
         #: (pod-list, plan) from the most recent _soft_plan: the drain's
         #: soft_batch_limit and the launch's _assign_soft_terms see the
@@ -1398,6 +1402,9 @@ class BatchScheduler:
             if chain is not None:
                 return None
         import time as _time
+        tr = self.tracer if self.tracer is not None \
+            and self.tracer.enabled else None
+        t_tz = tr.now() if tr is not None else 0.0
         t_prep = _time.perf_counter()
         extra_mask, profiles, extra_group = self._residual_mask(pods)
         residual_free = extra_mask is None and not any(
@@ -1434,6 +1441,9 @@ class BatchScheduler:
             topo_cover = self._assign_topology_terms(pods, batch, profiles)
             soft_present = self._assign_soft_terms(pods, batch)
         self.phase_stats["term_prep_s"] += _time.perf_counter() - t_prep
+        if tr is not None:
+            tr.record("scheduler", "tensorize", t_tz, tr.now(),
+                      pods=len(pods))
         nom_dev = self._nominated_device()
         if nom_dev is not None:
             # each pod's own nominated row, from the EXACT snapshot the
@@ -1499,9 +1509,15 @@ class BatchScheduler:
         """Back half: fetch results, host repair, adopt chained usage."""
         import time as _time
         from .kernels.batch import unpack_results
+        tr = self.tracer if self.tracer is not None \
+            and self.tracer.enabled else None
+        t_sw = tr.now() if tr is not None else 0.0
         t0 = _time.perf_counter()
         assign, scores = unpack_results(pending.packed)
         self.phase_stats["scan_wait_s"] += _time.perf_counter() - t0
+        if tr is not None:
+            tr.record("scheduler", "scan_wait", t_sw, tr.now(),
+                      pods=len(pending.pods))
         out: List[ScheduleResult] = []
         for i, pod in enumerate(pending.pods):
             row = int(assign[i])

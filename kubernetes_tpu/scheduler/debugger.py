@@ -1,18 +1,73 @@
-"""Cache debugger — dump + cache-vs-informer comparer.
+"""Cache debugger — dump, cache-vs-informer comparer, and unschedulable
+attribution.
 
 Ref: pkg/scheduler/internal/cache/debugger (CacheComparer compares the
 scheduler cache's nodes/pods against the informer's truth; CacheDumper
 writes a snapshot of cached state + the pending queue on SIGUSR2). The
 comparer is the structural race-detection defense: a divergence means an
 event was dropped or double-applied somewhere between informer and cache.
+
+`UnschedulableAttribution` is the per-pod half of "why is my pod
+pending": the drain records each pod's LAST failure (top predicate
+reason + the full FitError rendering, or the queue's park cause) and
+`pending_report` joins it against the live pending set — the payload the
+APIServer's /debug/pending endpoint serves.
 """
 
 from __future__ import annotations
 
 import signal
 import sys
+import threading
 from dataclasses import dataclass, field
-from typing import List
+from typing import Dict, List, Optional
+
+from ..utils.clock import Clock, REAL_CLOCK
+
+
+class UnschedulableAttribution:
+    """Bounded per-pod last-failure records (insertion-ordered LRU —
+    oldest evicts; a re-record moves the pod to the fresh end)."""
+
+    MAX_RECORDS = 8192
+
+    def __init__(self, clock: Clock = REAL_CLOCK,
+                 max_records: int = MAX_RECORDS):
+        self.clock = clock
+        self.max_records = max_records
+        self._lock = threading.Lock()
+        self._records: Dict[str, dict] = {}
+
+    def record(self, key: str, reason: str, message: str,
+               cycle: int = 0) -> None:
+        with self._lock:
+            prev = self._records.pop(key, None)
+            count = prev["count"] + 1 \
+                if prev is not None and prev["reason"] == reason else 1
+            self._records[key] = {
+                "reason": reason, "message": message, "cycle": cycle,
+                "time": self.clock.now(), "count": count}
+            while len(self._records) > self.max_records:
+                self._records.pop(next(iter(self._records)))
+
+    def discard(self, key: str) -> None:
+        """Cheap on the bind hot path: no lock taken while empty."""
+        if not self._records:
+            return
+        with self._lock:
+            self._records.pop(key, None)
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._records.get(key)
+            return dict(rec) if rec is not None else None
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._records.items()}
+
+    def __len__(self) -> int:
+        return len(self._records)
 
 
 @dataclass
@@ -55,7 +110,7 @@ class CacheDebugger:
 
     def dump(self) -> str:
         """Ref: debugger/dumper.go — cached nodes with usage, assumed pods,
-        pending queue."""
+        pending queue (with each pod's last-failure attribution)."""
         sched = self.scheduler
         lines = ["Dump of cached NodeInfo:"]
         # snapshot the dict: a SIGUSR2 handler races the scheduler thread's
@@ -68,9 +123,41 @@ class CacheDebugger:
                 f"cpu={ni.requested.milli_cpu}/{ni.allocatable.milli_cpu}m "
                 f"mem={ni.requested.memory}/{ni.allocatable.memory}")
         lines.append("Dump of scheduling queue:")
+        attribution = getattr(sched, "attribution", None)
         for pod in sched.queue.pending_pods():
-            lines.append(f"  {pod.metadata.key()}")
+            key = pod.metadata.key()
+            rec = attribution.get(key) if attribution is not None else None
+            if rec is not None:
+                lines.append(f"  {key} ({rec['reason']} x{rec['count']})")
+            else:
+                lines.append(f"  {key}")
         return "\n".join(lines)
+
+    def pending_report(self, limit: int = 500) -> dict:
+        """Why each pending pod is pending — the /debug/pending payload:
+        the live pending set (sorted by key) joined with the last-failure
+        attribution the drain recorded. A pod with no record yet simply
+        hasn't completed a failed attempt (freshly arrived, or mid-batch).
+        """
+        sched = self.scheduler
+        pods = sorted(sched.queue.pending_pods(),
+                      key=lambda p: p.metadata.key())
+        attribution = getattr(sched, "attribution", None)
+        out = []
+        for pod in pods[:limit]:
+            key = pod.metadata.key()
+            rec = attribution.get(key) if attribution is not None else None
+            entry = {"pod": key, "uid": pod.metadata.uid,
+                     "reason": rec["reason"] if rec else "NotYetAttempted",
+                     "message": rec["message"] if rec else "",
+                     "attempts": rec["count"] if rec else 0,
+                     "lastCycle": rec["cycle"] if rec else None,
+                     "lastFailureTime": rec["time"] if rec else None}
+            out.append(entry)
+        return {"component": sched.scheduler_name,
+                "pending": len(pods),
+                "truncated": max(0, len(pods) - limit),
+                "pods": out}
 
     def install(self, signum: int = signal.SIGUSR2) -> None:
         """SIGUSR2 -> dump + comparison to stderr (ref: debugger.go

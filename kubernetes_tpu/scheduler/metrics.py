@@ -95,6 +95,14 @@ class SchedulerMetrics:
             "scheduler_backpressure_shrinks_total",
             "Drain cycles whose batch cap was shrunk by bind/commit "
             "backpressure")
+        # unschedulable attribution: one inc per (failed attempt, distinct
+        # reason) from the explain() diagnosis, plus the queue's park
+        # causes (gang below minMember) — the "why is my pod pending"
+        # family /debug/pending reads per-pod detail for
+        self.unschedulable_reasons = r.counter(
+            "scheduler_unschedulable_reasons_total",
+            "Unschedulable scheduling attempts by failure reason "
+            "(predicate message or queue park cause)")
 
     def observe_queue(self, queue) -> None:
         """Sample the three sub-queue depths (PendingPods gauges)."""

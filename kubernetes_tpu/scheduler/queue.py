@@ -172,6 +172,13 @@ class SchedulingQueue:
         self._parked: Dict[str, _PodInfo] = {}
         #: GangManager, installed by the scheduler shell; None = no gating
         self.gang = None
+        #: observability hooks, installed by the scheduler shell: span
+        #: tracer (admit/park/backoff/unschedulable pod milestones), the
+        #: per-pod last-failure attribution store, and the park-cause
+        #: tally counter (scheduler_unschedulable_reasons_total)
+        self.tracer = None
+        self.attribution = None
+        self.unsched_reasons = None
         self.backoff_map = PodBackoffMap(clock)
         self.nominated = NominatedPodMap()
         self._scheduling_cycle = 0
@@ -193,6 +200,8 @@ class SchedulingQueue:
             self._push_active(key, info)
             self.nominated.add(pod)
             self._gang_notify_locked(pod)
+            if self.tracer is not None:
+                self.tracer.pod_event("queue", "admit", pod)
             self._cond.notify_all()
 
     def _gang_notify_locked(self, pod: Pod) -> None:
@@ -268,6 +277,8 @@ class SchedulingQueue:
                 self.gang.pod_gone(pod)
             self.nominated.delete(pod)
             self.backoff_map.clear(key)
+            if self.attribution is not None:
+                self.attribution.discard(key)
 
     def _push_active(self, key: str, info: _PodInfo) -> None:
         """(Re)enter the active heap sorted by (priority, arrival): the
@@ -362,6 +373,16 @@ class SchedulingQueue:
                     # PodGroup change) reactivates it. The pods behind it
                     # keep popping — no head-of-line blocking.
                     self._parked[key] = info
+                    if self.tracer is not None:
+                        self.tracer.pod_event("queue", "park", info.pod)
+                    if self.unsched_reasons is not None:
+                        self.unsched_reasons.inc(reason="PodGroupNotReady")
+                    if self.attribution is not None:
+                        self.attribution.record(
+                            key, "PodGroupNotReady",
+                            f"gang {pod_group_key(info.pod)} below "
+                            f"minMember; parked off the active heap",
+                            cycle=self._scheduling_cycle)
                     continue
                 # popped pods leave the pending set; a failed attempt re-adds
                 # them via add_unschedulable_if_not_present (ref: Pop removes
@@ -392,9 +413,13 @@ class SchedulingQueue:
             self.nominated.add(pod)
             if self._move_request_cycle >= pod_scheduling_cycle:
                 self._push_backoff(key)
+                if self.tracer is not None:
+                    self.tracer.pod_event("queue", "backoff", pod)
             else:
                 info.unsched_since = self._clock.now()
                 self._unschedulable[key] = info
+                if self.tracer is not None:
+                    self.tracer.pod_event("queue", "unschedulable", pod)
             self._gang_notify_locked(pod)
             self._cond.notify_all()
 

@@ -58,10 +58,17 @@ class Scheduler:
                  adaptive_batch: Optional[bool] = None,
                  min_batch: int = MIN_ADAPTIVE_BATCH,
                  lane_priority: int = DEFAULT_LANE_PRIORITY,
-                 max_inflight_binds: int = MAX_INFLIGHT_BINDS):
+                 max_inflight_binds: int = MAX_INFLIGHT_BINDS,
+                 tracer=None):
         from .framework import Framework
         from .metrics import SchedulerMetrics
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
+        # span tracer (observability/tracer.py): pod-lifecycle milestones
+        # sampled 1-in-N by UID, batch/stage spans always on; rides the
+        # scheduler's clock so FakeClock harnesses get deterministic span
+        # logs. Callers share one tracer across components by passing it.
+        from ..observability import SpanTracer
+        self.tracer = tracer if tracer is not None else SpanTracer(clock=clock)
         self.client = client
         self.scheduler_name = scheduler_name
         self.batch_size = batch_size
@@ -221,13 +228,20 @@ class Scheduler:
             on_update=lambda old, new: self.queue.gang_group_changed(
                 new.metadata.key())))
         from ..state.record import EventRecorder
-        from .debugger import CacheDebugger
+        from .debugger import CacheDebugger, UnschedulableAttribution
         #: correlating recorder (ref: client-go tools/record): dedup by
         #: count-bumping, aggregation, spam filtering
         self.recorder = EventRecorder(client, component=scheduler_name,
-                                      clock=clock)
+                                      clock=clock, tracer=self.tracer)
         #: SIGUSR2 dump + cache-vs-informer comparer (install() to arm)
         self.debugger = CacheDebugger(self)
+        #: per-pod last-failure records behind /debug/pending; the queue
+        #: contributes park causes, the drain the explain() diagnosis
+        self.attribution = UnschedulableAttribution(clock=clock)
+        self.queue.tracer = self.tracer
+        self.queue.attribution = self.attribution
+        self.queue.unsched_reasons = self.metrics.unschedulable_reasons
+        self.algorithm.tracer = self.tracer
         self.scheduled_count = 0
         self.unschedulable_count = 0
         self.preemption_count = 0
@@ -506,6 +520,10 @@ class Scheduler:
                                     on_pop=_mark_in_flight)
         if not pods:
             return []
+        if self.tracer.enabled:
+            for pod in pods:
+                self.tracer.pod_event("scheduler", "drain_member", pod,
+                                      cycle=cycle)
         try:
             results: List[ScheduleResult] = []
             while pods:
@@ -528,13 +546,22 @@ class Scheduler:
         import time as _time
         from ..utils.trace import Trace
         trace = Trace("schedule_batch", pods=len(pods), cycle=cycle)
+        tr = self.tracer
+        ts0 = tr.now() if tr.enabled else 0.0
         t0 = _time.perf_counter()
         results = self.algorithm.schedule(pods)
         trace.step("batch decided (tensorize + kernel + repair)")
+        ts1 = tr.now() if tr.enabled else 0.0
         t1 = _time.perf_counter()
         self._commit_results(results, cycle)
         trace.step("results committed (volumes + plugins + bind + assume)")
         t2 = _time.perf_counter()
+        if tr.enabled:
+            ts2 = tr.now()
+            tr.record("scheduler", "algorithm", ts0, ts1,
+                      pods=len(pods), cycle=cycle)
+            tr.record("scheduler", "commit", ts1, ts2,
+                      pods=len(pods), cycle=cycle)
         # per-attempt step tracing, logged only when slow (ref: utiltrace
         # in generic_scheduler.go:185 with the same 100ms threshold)
         trace.log_if_long(100.0)
@@ -697,6 +724,11 @@ class Scheduler:
                         carry = extra + carry
                 if pods:
                     self.metrics.batch_size.observe(len(pods))
+                    if self.tracer.enabled:
+                        for pod in pods:
+                            self.tracer.pod_event("scheduler",
+                                                  "drain_member", pod,
+                                                  cycle=cycle)
                 if not pods and prev is None:
                     if commit_fut is not None:
                         # a failed commit may have requeued pods — settle
@@ -711,6 +743,7 @@ class Scheduler:
                     break
                 pending = None
                 if pods:
+                    tl0 = self.tracer.now() if self.tracer.enabled else 0.0
                     if prev is not None:
                         with self._algo_lock:
                             pending = self.algorithm.schedule_launch(
@@ -729,6 +762,12 @@ class Scheduler:
                         self._pipe_anchor()
                         with self._algo_lock:
                             pending = self.algorithm.schedule_launch(pods)
+                    if self.tracer.enabled:
+                        self.tracer.record(
+                            "scheduler", "launch", tl0, self.tracer.now(),
+                            pods=len(pods), cycle=cycle,
+                            chained=bool(pending is not None
+                                         and pending.chained))
                 if prev is not None:
                     commit_fut = self._finish_pipelined(prev[0], prev[1],
                                                         commit_fut)
@@ -773,11 +812,15 @@ class Scheduler:
                 # drop device usage so the next launch re-uploads host
                 # truth (and this batch's own adopt is epoch-refused)
                 self.algorithm.mirror.invalidate_usage()
+        tf0 = self.tracer.now() if self.tracer.enabled else 0.0
         t0 = _time.perf_counter()
         with self._algo_lock:
             results = self.algorithm.schedule_finish(pending)
         t1 = _time.perf_counter()
         self.metrics.scheduling_duration.observe(t1 - t0, operation="fetch")
+        if self.tracer.enabled:
+            self.tracer.record("scheduler", "fetch", tf0, self.tracer.now(),
+                               pods=len(pending.pods), cycle=cycle)
         if any(r.retry for r in results):
             # losers the chained usage already counted: in-flight chained
             # successors must retry their unassigned pods, not park them
@@ -801,6 +844,7 @@ class Scheduler:
         their unassigned pods. Returns the number of assumes."""
         import time as _time
         epoch_before = self.algorithm.mirror.usage_epoch
+        tc0 = self.tracer.now() if self.tracer.enabled else 0.0
         t1 = _time.perf_counter()
         try:
             return self._commit_results(results, cycle)
@@ -813,6 +857,10 @@ class Scheduler:
             m.scheduling_duration.observe(t2 - t1, operation="commit")
             m.commit_overlap_duration.observe(t2 - t1)
             m.e2e_scheduling_duration.observe(t2 - t_start)
+            if self.tracer.enabled:
+                self.tracer.record("scheduler", "commit", tc0,
+                                   self.tracer.now(), pods=len(results),
+                                   cycle=cycle)
             with self._count_lock:
                 self._in_flight -= len(results)
 
@@ -1010,6 +1058,9 @@ class Scheduler:
                 with self._count_lock:
                     self.scheduled_count += 1
                 self.metrics.schedule_attempts.inc(result="scheduled")
+                self.tracer.pod_event("scheduler", "bound", out,
+                                      node=res.node_name)
+                self.attribution.discard(out.metadata.key())
                 continue
             # any failed bind is a kernel winner that will never be assumed:
             # no dirty row can repair its phantom usage on device
@@ -1065,6 +1116,9 @@ class Scheduler:
             with self._count_lock:
                 self.scheduled_count += 1
             self.metrics.schedule_attempts.inc(result="scheduled")
+            self.tracer.pod_event("scheduler", "bound", out,
+                                  node=res.node_name)
+            self.attribution.discard(out.metadata.key())
         if not pairs:
             return n_assumed
         items = [(res.pod.metadata.namespace, res.pod.metadata.name,
@@ -1109,6 +1163,15 @@ class Scheduler:
         returns the error in every slot; the caller's forget/requeue
         machinery self-heals exactly as for any failed bind."""
         from ..utils import backoff
+        tb0 = self.tracer.now() if self.tracer.enabled else 0.0
+        try:
+            return self._bind_items_inner(items, backoff)
+        finally:
+            if self.tracer.enabled:
+                self.tracer.record("scheduler", "bind_txn", tb0,
+                                   self.tracer.now(), pods=len(items))
+
+    def _bind_items_inner(self, items, backoff) -> list:
         pc = self.client.pods()
         if not hasattr(pc, "bind_bulk_pairs"):
             bindings = [Binding(
@@ -1305,7 +1368,24 @@ class Scheduler:
         with self._algo_lock:
             try:
                 fit_err = self.algorithm.explain(pod)
-                self._record_event(pod, "FailedScheduling", fit_err.error())
+                # per-reason attribution: one tally per distinct reason
+                # in this attempt's diagnosis, the dominant reason (most
+                # nodes) as the pod's last-failure record, and the full
+                # rendering as a FailedScheduling event — "why is my pod
+                # pending" answerable from /metrics, /debug/pending, and
+                # the event stream respectively
+                counts: dict = {}
+                for reasons in fit_err.failed_predicates.values():
+                    for r in reasons:
+                        counts[r] = counts.get(r, 0) + 1
+                for r in counts:
+                    self.metrics.unschedulable_reasons.inc(reason=r)
+                top = max(counts, key=lambda r: (counts[r], r)) \
+                    if counts else "NoNodesAvailable"
+                message = fit_err.error()
+                self.attribution.record(pod.metadata.key(), top, message,
+                                        cycle=cycle)
+                self._record_event(pod, "FailedScheduling", message)
             except Exception:
                 pass
             self._try_preempt(pod)

@@ -97,6 +97,11 @@ class ServingHarness:
         self.clock = FakeClock()
         self.metrics = RobustnessMetrics()
         self.serving_metrics = ServingMetrics()
+        # one tracer across scheduler + SLO tracker on the shared
+        # FakeClock (every pod sampled): stage_breakdown on the recorder
+        # yields EXACT per-stage latencies, deterministic per seed
+        from ..observability import SpanTracer
+        self.tracer = SpanTracer(clock=self.clock, pod_sample=1)
         self.injector = FaultInjector(
             seed=seed, error_rate=error_rate, metrics=self.metrics,
             reset_rate=reset_rate, latency_rate=latency_rate,
@@ -130,7 +135,8 @@ class ServingHarness:
                                lane_priority=lane_priority)
         self.serving_metrics.arrival_rate.set(rate)
         self.tracker = SLOTracker(clock=self.clock,
-                                  metrics=self.serving_metrics)
+                                  metrics=self.serving_metrics,
+                                  tracer=self.tracer)
         self._running_since: Dict[str, int] = {}
         self._tick_idx = 0
         self._started = False
@@ -153,7 +159,8 @@ class ServingHarness:
                          batch_size=self.batch_size, clock=self.clock,
                          async_bind=False, adaptive_batch=True,
                          min_batch=self.min_batch,
-                         lane_priority=self.lane_priority)
+                         lane_priority=self.lane_priority,
+                         tracer=self.tracer)
 
     def _build_controllers(self, factory: SharedInformerFactory) -> None:
         self.deployments = DeploymentController(self.client, factory)

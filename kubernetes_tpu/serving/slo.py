@@ -32,10 +32,10 @@ reproduce them bit-for-bit — pinned by the serving smoke test.
 
 from __future__ import annotations
 
-import math
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..observability.tracer import nearest_rank_percentile
 from ..state.informer import EventHandlers
 from ..utils.clock import Clock, REAL_CLOCK, parse_iso
 from .loadgen import CLASS_LABEL
@@ -47,21 +47,25 @@ STARTUP = "startup"  # created -> running
 
 def percentile(samples: List[float], q: float) -> float:
     """Nearest-rank percentile over a SORTED sample list — the scalar
-    definition the smoke test replays against report()."""
-    if not samples:
-        return 0.0
-    rank = max(1, math.ceil(q * len(samples)))
-    return samples[rank - 1]
+    definition the smoke test replays against report(). Delegates to the
+    ONE shared implementation (observability.tracer) so the SLO report
+    and the span stage reports can never drift apart."""
+    return nearest_rank_percentile(samples, q)
 
 
 class SLOTracker:
     def __init__(self, clock: Clock = REAL_CLOCK, metrics=None,
                  class_label: str = CLASS_LABEL,
-                 use_object_timestamps: bool = False):
+                 use_object_timestamps: bool = False,
+                 tracer=None):
         self.clock = clock
         self.metrics = metrics
         self.class_label = class_label
         self.use_object_timestamps = use_object_timestamps
+        #: observability.SpanTracer (optional): lifecycle transitions also
+        #: land as pod spans (created/bound/running) so the flight
+        #: recorder holds the kubelet-Running leg of each pod's trace
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._created: Dict[str, float] = {}
         self._bound: Dict[str, float] = {}
@@ -101,6 +105,8 @@ class SLOTracker:
                 if self.metrics is not None:
                     self.metrics.pods_observed.inc(
                         cls=self._cls[key], phase="created")
+                if self.tracer is not None:
+                    self.tracer.pod_event("lifecycle", "created", pod)
             cls = self._cls[key]
             if pod.spec.node_name and key not in self._bound:
                 self._bound[key] = self._stamp_bound(pod, now)
@@ -110,6 +116,9 @@ class SLOTracker:
                     self.metrics.pod_bind_seconds.observe(
                         max(0.0, self._bound[key] - self._created[key]),
                         cls=cls)
+                if self.tracer is not None:
+                    self.tracer.pod_event("lifecycle", "bound", pod,
+                                          node=pod.spec.node_name)
             if pod.status.phase == "Running" and key not in self._running:
                 self._running[key] = self._stamp_running(pod, now)
                 if self.metrics is not None:
@@ -118,6 +127,8 @@ class SLOTracker:
                     self.metrics.pod_startup_seconds.observe(
                         max(0.0, self._running[key] - self._created[key]),
                         cls=cls)
+                if self.tracer is not None:
+                    self.tracer.pod_event("lifecycle", "running", pod)
 
     def _stamp_created(self, pod, now: float) -> float:
         if self.use_object_timestamps:
@@ -191,3 +202,42 @@ class SLOTracker:
         the chaos soak checks ('no pod permanently stuck')."""
         with self._lock:
             return sorted(k for k in self._created if k not in self._bound)
+
+    #: (stage, (from milestone, to milestone)) pairs stage_breakdown cuts
+    #: a pod's span trail into — milestones are span names across the
+    #: queue/scheduler/kubelet/lifecycle components
+    STAGES = (
+        ("queue_wait", ("admit", "drain_member")),
+        ("schedule_to_bound", ("drain_member", "bound")),
+        ("bound_to_running", ("bound", "running")),
+        ("e2e", ("admit", "running")),
+    )
+
+    @classmethod
+    def stage_breakdown(cls, recorder) -> dict:
+        """EXACT per-stage latency percentiles from a flight recorder's
+        pod spans: each sampled pod's trace is cut at its first 'admit',
+        'drain_member', 'bound', and 'running' milestones (emitted by the
+        queue, the drain, and the kubelet/lifecycle observers), giving
+        the stage-level answer the SLO's aggregate bind/startup
+        percentiles can't: WHERE a slow pod spent its time."""
+        marks: Dict[str, Dict[str, float]] = {}
+        for span in recorder.spans():
+            if not span.trace_id:
+                continue
+            d = marks.setdefault(span.trace_id, {})
+            if span.name not in d:  # first sighting wins (re-queues keep
+                d[span.name] = span.end  # the original admit stamp)
+        out: dict = {}
+        for stage, (a, b) in cls.STAGES:
+            vals = sorted(m[b] - m[a] for m in marks.values()
+                          if a in m and b in m and m[b] >= m[a])
+            if not vals:
+                continue
+            out[stage] = {
+                "count": len(vals),
+                "p50_s": round(percentile(vals, 0.50), 6),
+                "p95_s": round(percentile(vals, 0.95), 6),
+                "p99_s": round(percentile(vals, 0.99), 6),
+            }
+        return out

@@ -50,10 +50,15 @@ class EventRecorder:
 
     def __init__(self, client, component: str = "",
                  clock: Clock = REAL_CLOCK,
-                 burst: int = 25, refill_per_sec: float = 1.0 / 300.0):
+                 burst: int = 25, refill_per_sec: float = 1.0 / 300.0,
+                 tracer=None):
         self.client = client
         self.component = component
         self.clock = clock
+        #: observability.SpanTracer (optional): every recorded event also
+        #: lands as an instant span under the pod's trace, so the flight
+        #: recorder shows FailedScheduling next to the queue/drain spans
+        self.tracer = tracer
         self.burst = burst
         self.refill_per_sec = refill_per_sec
         self._lock = threading.Lock()
@@ -80,6 +85,14 @@ class EventRecorder:
             name=meta.name if meta else getattr(obj, "name", ""),
             uid=meta.uid if meta else getattr(obj, "uid", ""))
         ns = ref.namespace or "default"
+        if self.tracer is not None and self.tracer.enabled \
+                and (ref.uid or ref.name):
+            # before correlation: the span log should show every attempt
+            # the dedup below collapses into one Event object's count
+            if self.tracer.sampled(ref.uid or ref.name):
+                self.tracer.event("events", reason,
+                                  trace_id=ref.uid or ref.name,
+                                  pod=f"{ns}/{ref.name}")
         spam_key = (ns, ref.uid or ref.name)
         agg_key = (ns, ref.uid or ref.name, reason)
         full_key = agg_key + (message,)

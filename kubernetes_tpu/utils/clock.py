@@ -12,6 +12,13 @@ class Clock:
     def now(self) -> float:
         return time.time()
 
+    def monotonic(self) -> float:
+        """Interval measurement: a source that never steps backwards
+        (time.time can — NTP), so durations computed from two reads are
+        always >= 0. FakeClock unifies the two (virtual time only moves
+        forward), which is what keeps traces deterministic under test."""
+        return time.perf_counter()
+
     def sleep(self, seconds: float) -> None:
         time.sleep(seconds)
 
@@ -27,6 +34,9 @@ class FakeClock(Clock):
     def now(self) -> float:
         with self._lock:
             return self._now
+
+    def monotonic(self) -> float:
+        return self.now()
 
     def sleep(self, seconds: float) -> None:
         self.step(seconds)

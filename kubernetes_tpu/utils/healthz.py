@@ -4,22 +4,163 @@ Ref: apiserver/pkg/server/healthz (every component serves /healthz with
 named checks) and the scheduler's insecure serving mux which also exposes
 /metrics with DELETE -> Reset (cmd/kube-scheduler/app/server.go:194-211,
 :287-291).
+
+`HealthChecks` is the named-check set itself, shareable between the
+standalone HealthzServer and the APIServer's /readyz (the hub answers
+ready only while every registered component contributor passes).
+Component contributors — `scheduler_contributors`,
+`controller_manager_contributors`, `leaderelection_contributor` — turn
+liveness signals the components already carry (informer sync +
+staleness, queue progress, elector thread) into named checks, so
+"server up" stops being the whole readiness story.
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from .metrics import Registry
 
 
+def _safe(fn) -> bool:
+    try:
+        return bool(fn())
+    except Exception:
+        return False
+
+
+class HealthChecks:
+    """Named boolean checks (ref: healthz.NamedCheck). A check that
+    raises counts as failed — a probe must never take the server down."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._checks: Dict[str, Callable[[], bool]] = {
+            "ping": lambda: True}
+
+    def add(self, name: str, fn: Callable[[], bool]) -> None:
+        with self._lock:
+            self._checks[name] = fn
+
+    def add_all(self, contributors: Dict[str, Callable[[], bool]]) -> None:
+        with self._lock:
+            self._checks.update(contributors)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._checks.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._checks)
+
+    def failed(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._checks.items())
+        return [n for n, fn in items if not _safe(fn)]
+
+    # dict-ish compatibility for the HealthzServer handler
+    def items(self):
+        with self._lock:
+            return list(self._checks.items())
+
+
+# ------------------------------------------------- component contributors
+
+def _informers_synced(factory) -> bool:
+    """Every STARTED informer of the factory completed its first sync
+    (the one informer-liveness predicate both contributors share)."""
+    with factory._lock:
+        informers = list(factory._informers.values())
+    return all(inf.has_synced() for inf in informers
+               if getattr(inf, "_started", False))
+
+
+def scheduler_contributors(scheduler, staleness_max: float = 60.0,
+                           stuck_after: float = 300.0
+                           ) -> Dict[str, Callable[[], bool]]:
+    """The scheduler's liveness surface as named checks:
+
+      - informers-synced: every STARTED informer completed its first sync
+      - informer-staleness: no live watch stream has gone silent past
+        `staleness_max` (the InformerMetrics staleness gauge)
+      - queue-progress: pods are pending but no scheduling cycle has
+        started for `stuck_after` seconds (injected clock) — the "depth
+        stuck" tell that the drain loop died while the process lives
+    """
+    def informers_synced() -> bool:
+        return _informers_synced(scheduler.informers)
+
+    def informers_fresh() -> bool:
+        staleness = scheduler.informers.metrics.watch_staleness.snapshot()
+        return all(v < staleness_max for v in staleness.values())
+
+    state = {"cycle": -1, "since": None}
+
+    def queue_progress() -> bool:
+        now = scheduler.clock.now()
+        cycle = scheduler.queue.scheduling_cycle
+        if scheduler.queue.num_pending() == 0 or cycle != state["cycle"]:
+            state["cycle"] = cycle
+            state["since"] = now
+            return True
+        if state["since"] is None:
+            state["since"] = now
+        return (now - state["since"]) < stuck_after
+
+    name = getattr(scheduler, "scheduler_name", "scheduler")
+    return {
+        f"{name}-informers-synced": informers_synced,
+        f"{name}-informer-staleness": informers_fresh,
+        f"{name}-queue-progress": queue_progress,
+    }
+
+
+def controller_manager_contributors(manager
+                                    ) -> Dict[str, Callable[[], bool]]:
+    """Controller-manager liveness: informers synced, and every control
+    loop that was started still has a live worker thread."""
+    def informers_synced() -> bool:
+        return _informers_synced(manager.informers)
+
+    def controllers_running() -> bool:
+        for c in getattr(manager, "controllers", ()):
+            t = getattr(c, "_thread", None)
+            if t is not None and not t.is_alive():
+                return False
+        return True
+
+    return {
+        "controller-manager-informers-synced": informers_synced,
+        "controller-manager-loops-running": controllers_running,
+    }
+
+
+def leaderelection_contributor(elector, name: str = "leader-election"
+                               ) -> Dict[str, Callable[[], bool]]:
+    """Leader status as a check: healthy while the elector is running
+    (leading OR standing by) — a dead election loop means the component
+    will never (re)acquire, which is unreadiness even though the process
+    lives. A standby is READY by design (the reference's healthz does
+    not fail followers)."""
+    def alive() -> bool:
+        t = getattr(elector, "_thread", None)
+        if t is not None:
+            return t.is_alive()
+        # step()-driven electors (the chaos harness) have no thread;
+        # they are healthy while not stopped
+        return not getattr(elector, "_stop", threading.Event()).is_set()
+    return {name: alive}
+
+
 class HealthzServer:
     def __init__(self, registry: Optional[Registry] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 health: Optional[HealthChecks] = None):
         self.registry = registry
-        self.checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
+        self.health = health if health is not None else HealthChecks()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -40,8 +181,7 @@ class HealthzServer:
                 if self.path.startswith("/healthz") or \
                         self.path.startswith("/readyz") or \
                         self.path.startswith("/livez"):
-                    failed = [n for n, fn in outer.checks.items()
-                              if not _safe(fn)]
+                    failed = outer.health.failed()
                     if failed:
                         self._write(500, ("unhealthy: " +
                                           ",".join(failed)).encode())
@@ -74,8 +214,13 @@ class HealthzServer:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
 
+    @property
+    def checks(self) -> Dict[str, Callable[[], bool]]:
+        """Back-compat view of the named checks."""
+        return dict(self.health.items())
+
     def add_check(self, name: str, fn: Callable[[], bool]) -> None:
-        self.checks[name] = fn
+        self.health.add(name, fn)
 
     def start(self) -> "HealthzServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -88,10 +233,3 @@ class HealthzServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
-
-
-def _safe(fn) -> bool:
-    try:
-        return bool(fn())
-    except Exception:
-        return False

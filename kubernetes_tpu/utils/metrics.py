@@ -27,6 +27,28 @@ def _fmt_labels(labels: Tuple) -> str:
     return "{" + inner + "}"
 
 
+def expose_histogram_series(name: str, buckets: Sequence[float],
+                            items) -> List[str]:
+    """Histogram sample lines (no header) from (label key, (per-bucket
+    counts, sum, count)) items — shared by Histogram.expose and the
+    observability MetricsRegistry's label-wise merge, so the two paths
+    can never drift in format."""
+    out: List[str] = []
+    for key, (counts, total, n) in items:
+        acc = 0
+        for i, b in enumerate(buckets):
+            acc += counts[i]
+            lab = dict(key)
+            lab["le"] = repr(b) if b != int(b) else str(b)
+            out.append(f"{name}_bucket{_fmt_labels(_label_key(lab))} {acc}")
+        lab = dict(key)
+        lab["le"] = "+Inf"
+        out.append(f"{name}_bucket{_fmt_labels(_label_key(lab))} {n}")
+        out.append(f"{name}_sum{_fmt_labels(key)} {total}")
+        out.append(f"{name}_count{_fmt_labels(key)} {n}")
+    return out
+
+
 class _Metric:
     kind = "untyped"
 
@@ -66,6 +88,11 @@ class Counter(_Metric):
         with self._lock:
             self._values.clear()
 
+    def snapshot(self) -> Dict[Tuple, float]:
+        """Label key -> value copy (the aggregator's merge input)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -104,6 +131,13 @@ class Gauge(_Metric):
     def clear(self) -> None:
         with self._lock:
             self._values.clear()
+
+    def snapshot(self) -> Dict[Tuple, float]:
+        """Label key -> value copy (callback gauges sample the fn)."""
+        if self._fn is not None:
+            return {(): float(self._fn())}
+        with self._lock:
+            return dict(self._values)
 
     def expose(self) -> List[str]:
         out = self._header()
@@ -172,23 +206,16 @@ class Histogram(_Metric):
                     return self.buckets[i]
             return float("inf")
 
-    def expose(self) -> List[str]:
+    def snapshot(self) -> Dict[Tuple, Tuple[list, float, int]]:
+        """Label key -> (per-bucket counts, sum, count) copy."""
         with self._lock:
-            items = sorted((k, ([*s[0]], s[1], s[2]))
-                           for k, s in self._series.items())
+            return {k: ([*s[0]], s[1], s[2])
+                    for k, s in self._series.items()}
+
+    def expose(self) -> List[str]:
+        items = sorted(self.snapshot().items())
         out = self._header()
-        for key, (counts, total, n) in items:
-            acc = 0
-            for i, b in enumerate(self.buckets):
-                acc += counts[i]
-                lab = dict(key)
-                lab["le"] = repr(b) if b != int(b) else str(b)
-                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {acc}")
-            lab = dict(key)
-            lab["le"] = "+Inf"
-            out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lab))} {n}")
-            out.append(f"{self.name}_sum{_fmt_labels(key)} {total}")
-            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+        out.extend(expose_histogram_series(self.name, self.buckets, items))
         return out
 
 
@@ -391,6 +418,37 @@ class ServingMetrics:
         self.arrival_rate = r.gauge(
             "serving_arrival_rate_events_per_s",
             "Configured open-loop arrival rate (events/s)")
+
+
+class APIServerMetrics:
+    """The hub's own request/watch families (ref: apiserver
+    endpoints/metrics — apiserver_request_total{verb,resource,code} and
+    the registered-watcher gauges), self-served on its /metrics next to
+    the component registries it aggregates."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: every completed request, including the error mappings — code
+        #: is the HTTP status the response actually carried
+        self.requests = r.counter(
+            "apiserver_request_total",
+            "API requests by verb, resource, and HTTP code")
+        #: non-watch request wall time (watches are long-running and
+        #: would saturate every bucket with their stream lifetime)
+        self.request_duration = r.histogram(
+            "apiserver_request_duration_seconds",
+            "Request latency for non-watch requests, by verb")
+        #: currently-open watch streams (the long-running exemption's
+        #: population — what the inflight limits deliberately don't cap)
+        self.watch_streams = r.gauge(
+            "apiserver_registered_watchers",
+            "Currently-open watch streams, by resource")
+        #: event frames written to watch streams (coalesced slim frames
+        #: count every event they carry)
+        self.watch_events = r.counter(
+            "apiserver_watch_events_sent_total",
+            "Watch events written to streams, by resource")
 
 
 class Registry:
