@@ -47,7 +47,6 @@ the same schedule, or the faults leaked into outcomes.
 from __future__ import annotations
 
 import random
-import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -62,7 +61,7 @@ from ..scheduler.scheduler import Scheduler
 from ..state.client import Client
 from ..state.informer import SharedInformerFactory
 from ..state.store import NotFoundError, Store
-from ..utils.clock import FakeClock, now_iso
+from ..utils.clock import Clock, FakeClock, REAL_CLOCK, now_iso
 from ..utils.metrics import RobustnessMetrics
 from .injector import ChaosClient, ChaosHTTPClient, FaultInjector
 from .invariants import InvariantChecker
@@ -120,24 +119,30 @@ def informers_current(admin, factories, classes) -> bool:
 
 def settle_informers(admin, factories, classes, injector,
                      timeout: float = 10.0, logger_name: str = "chaos",
-                     step=None) -> bool:
-    """Wait (REAL time) until informers_current holds twice in a row —
-    the second check lets the last event's handler dispatch finish, so
-    control-loop inputs are identical across same-seed runs. On timeout
-    the next control loop runs on stale indexers and the run's event log
-    may diverge; the log is stamped so a determinism failure points at
-    the starved informer thread, not the harness logic."""
-    deadline = time.time() + timeout
+                     step=None, clock: Clock = REAL_CLOCK) -> bool:
+    """Wait until informers_current holds twice in a row — the second
+    check lets the last event's handler dispatch finish, so control-loop
+    inputs are identical across same-seed runs. On timeout the next
+    control loop runs on stale indexers and the run's event log may
+    diverge; the log is stamped so a determinism failure points at the
+    starved informer thread, not the harness logic.
+
+    `clock` defaults to REAL time on purpose: informer threads pump
+    events in real time even while the harness's event clock is a
+    FakeClock, and sleeping on the SHARED virtual clock would step it
+    from the settle loop and fork the event-log contract (the
+    StoreReplica._sleep lesson from PR 8)."""
+    deadline = clock.now() + timeout
     streak = 0
-    while time.time() < deadline:
+    while clock.now() < deadline:
         if informers_current(admin, factories, classes):
             streak += 1
             if streak >= 2:
                 return True
-            time.sleep(0.002)
+            clock.sleep(0.002)
         else:
             streak = 0
-            time.sleep(0.002)
+            clock.sleep(0.002)
     import logging
     logging.getLogger(logger_name).warning(
         "informers failed to settle within %.1fs at step %s",
@@ -278,6 +283,10 @@ class ChaosHarness:
         #: join the schedule
         self.ha = ha
         self.clock = FakeClock()
+        #: the WALL clock for settle/promote barriers (informer and
+        #: follower threads pump in real time regardless of the virtual
+        #: event clock above); injectable so tests can bound the waits
+        self.wall_clock: Clock = REAL_CLOCK
         self.metrics = RobustnessMetrics()
         # span tracer on the SHARED FakeClock, sampling every pod: the
         # determinism contract extends to traces — same seed => byte-
@@ -715,14 +724,14 @@ class ChaosHarness:
         horizon = primary.contents()
         self.injector.record("kill_primary", target_rv)
         # barrier: an etcd learner refuses promotion until caught up —
-        # wait (REAL time; follower threads pump frames) for the standby
-        # to hold exactly the primary's final state
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # wait (wall_clock: follower threads pump frames in real time)
+        # for the standby to hold exactly the primary's final state
+        deadline = self.wall_clock.now() + timeout
+        while self.wall_clock.now() < deadline:
             if self._replica.store.contents() == horizon \
                     and self._replica.store.resource_version >= target_rv:
                 break
-            time.sleep(0.01)
+            self.wall_clock.sleep(0.01)
         promoted = self._replica.promote()
         violations: List[str] = []
         if promoted.resource_version < target_rv:
@@ -1119,4 +1128,4 @@ class ChaosHarness:
         settle_informers(self.admin, self._factories(),
                          (PodCls, NodeCls, PodGroup), self.injector,
                          timeout=timeout, logger_name="chaos",
-                         step=self.injector.step)
+                         step=self.injector.step, clock=self.wall_clock)

@@ -18,6 +18,7 @@ from ..api.batch import CronJob, Job
 from ..api.meta import ObjectMeta, controller_ref, new_controller_ref
 from ..state.informer import SharedInformerFactory
 from ..utils.clock import Clock, REAL_CLOCK, parse_iso, now_iso
+from ..utils.errlog import SwallowedErrors
 
 
 def _field_matches(expr: str, value: int, min_value: int = 0) -> bool:
@@ -59,10 +60,15 @@ class CronJobController:
     name = "cronjob"
 
     def __init__(self, client, informers: SharedInformerFactory,
-                 period: float = 10.0, clock: Clock = REAL_CLOCK):
+                 period: float = 10.0, clock: Clock = REAL_CLOCK,
+                 metrics=None):
         self.client = client
         self.period = period
         self.clock = clock
+        # spawn/prune/stamp writes survive single failures (the next
+        # poll re-evaluates the schedule) but are never silent: logged
+        # once per streak + counted (swallowed_errors_total)
+        self._swallowed = SwallowedErrors(self.name, metrics)
         #: cronjob uid -> last wall minute the missed-run scan ran
         self._missed_scan_memo = {}
         self.informer = informers.informer_for(CronJob)
@@ -171,8 +177,9 @@ class CronJobController:
                         try:
                             self.client.jobs(j.metadata.namespace).delete(
                                 j.metadata.name)
-                        except Exception:
-                            pass
+                            self._swallowed.ok("replace_job")
+                        except Exception as e:
+                            self._swallowed.swallow("replace_job", e)
                 self._spawn_job(cj, now)
         self._prune_history(cj, owned)
 
@@ -193,7 +200,10 @@ class CronJobController:
             "CronJob", cj.api_version, cj.metadata)]
         try:
             self.client.jobs(cj.metadata.namespace).create(job)
-        except Exception:
+            self._swallowed.ok("spawn_job")
+        except Exception as e:
+            # the next poll's due/missed scan retries this minute's fire
+            self._swallowed.swallow("spawn_job", e)
             return
         from datetime import datetime, timezone
         fired_at = datetime.fromtimestamp(now, tz=timezone.utc).strftime(
@@ -207,8 +217,9 @@ class CronJobController:
         try:
             self.client.resource(CronJob, cj.metadata.namespace).patch(
                 cj.metadata.name, stamp, namespace=cj.metadata.namespace)
-        except Exception:
-            pass
+            self._swallowed.ok("stamp_last_schedule")
+        except Exception as e:
+            self._swallowed.swallow("stamp_last_schedule", e)
 
     def _prune_history(self, cj: CronJob, owned: List[Job]) -> None:
         done = [j for j in owned if self._job_finished(j)]
@@ -224,5 +235,6 @@ class CronJobController:
                 try:
                     self.client.jobs(j.metadata.namespace).delete(
                         j.metadata.name)
-                except Exception:
-                    pass
+                    self._swallowed.ok("prune_history")
+                except Exception as e:
+                    self._swallowed.swallow("prune_history", e)

@@ -56,17 +56,21 @@ class ControllerManager:
         from ..utils.metrics import RobustnessMetrics
         self.robustness = RobustnessMetrics()
         from ..api.core import ReplicationController
-        self.replicaset = ReplicaSetController(client, self.informers)
+        self.replicaset = ReplicaSetController(client, self.informers,
+                                               metrics=self.robustness)
         # the rc controller is the same logic over ReplicationControllers
         # (ref: pkg/controller/replication/conversion.go)
         self.replication = ReplicaSetController(
-            client, self.informers, kind=ReplicationController)
+            client, self.informers, kind=ReplicationController,
+            metrics=self.robustness)
         self.deployment = DeploymentController(client, self.informers)
         self.job = JobController(client, self.informers)
-        self.statefulset = StatefulSetController(client, self.informers)
+        self.statefulset = StatefulSetController(client, self.informers,
+                                                 metrics=self.robustness)
         self.daemonset = DaemonSetController(client, self.informers)
         self.cronjob = CronJobController(client, self.informers,
-                                         period=cronjob_period)
+                                         period=cronjob_period,
+                                         metrics=self.robustness)
         self.endpoints = EndpointsController(client, self.informers)
         self.namespace = NamespaceController(client, self.informers)
         self.pv_binder = PersistentVolumeBinder(client, self.informers)
@@ -104,7 +108,7 @@ class ControllerManager:
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
-            period=podgc_period)
+            period=podgc_period, metrics=self.robustness)
         from .bootstrap import BootstrapSigner, TokenCleaner
         self.bootstrapsigner = BootstrapSigner(client, self.informers)
         self.tokencleaner = TokenCleaner(client, self.informers)

@@ -97,8 +97,8 @@ class NodeLifecycleController:
             return True
         except NotFoundError:
             return False
-        except Exception:
-            return False  # logged + counted in api_give_ups by retry()
+        except Exception:  # ktpulint: disable=KTPU001 retry() above already logged the give-up once and counted it in api_give_ups
+            return False
 
     # ------------------------------------------------------------ monitor
 

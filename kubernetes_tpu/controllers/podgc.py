@@ -15,6 +15,7 @@ from ..api.batch import Job
 from ..api.core import Node, Pod
 from ..state.informer import SharedInformerFactory
 from ..utils.clock import Clock, REAL_CLOCK, parse_iso
+from ..utils.errlog import SwallowedErrors
 
 DEFAULT_TERMINATED_THRESHOLD = 12500  # --terminated-pod-gc-threshold
 
@@ -26,9 +27,14 @@ class PodGCController:
 
     def __init__(self, client, informers: SharedInformerFactory,
                  terminated_threshold: int = DEFAULT_TERMINATED_THRESHOLD,
-                 period: float = 20.0, clock: Clock = REAL_CLOCK):
+                 period: float = 20.0, clock: Clock = REAL_CLOCK,
+                 metrics=None):
         self.client = client
         self.clock = clock
+        # a GC sweep must survive any single object's API failure (the
+        # next period retries the whole sweep), but never silently:
+        # logged once per streak + counted (swallowed_errors_total)
+        self._swallowed = SwallowedErrors(self.name, metrics)
         self.terminated_threshold = terminated_threshold
         self.period = period
         self.pod_informer = informers.informer_for(Pod)
@@ -66,8 +72,10 @@ class PodGCController:
         try:
             self.client.pods(pod.metadata.namespace).delete(
                 pod.metadata.name)
+            self._swallowed.ok("delete_pod")
             return True
-        except Exception:
+        except Exception as e:
+            self._swallowed.swallow("delete_pod", e)
             return False
 
     def _gc_terminated(self) -> int:
@@ -102,11 +110,15 @@ class PodGCController:
             if node not in confirmed_gone:
                 try:
                     self.client.nodes().get(node)
+                    self._swallowed.ok("node_lookup")
                     continue  # informer lag; node is alive
                 except NotFoundError:
+                    self._swallowed.ok("node_lookup")
                     confirmed_gone.add(node)
-                except Exception:
-                    continue  # fail safe on lookup errors
+                except Exception as e:
+                    # fail safe: an unconfirmed node must not kill pods
+                    self._swallowed.swallow("node_lookup", e)
+                    continue
             gkey = pod_group_key(p)
             if gkey is not None and self._group_exists(gkey):
                 if self._fail_pod(p):
@@ -125,10 +137,13 @@ class PodGCController:
         ns, _, name = gkey.partition("/")
         try:
             self.client.pod_groups(ns).get(name)
+            self._swallowed.ok("podgroup_lookup")
             return True
         except NotFoundError:
+            self._swallowed.ok("podgroup_lookup")
             return False
-        except Exception:
+        except Exception as e:
+            self._swallowed.swallow("podgroup_lookup", e)
             return True
 
     def _fail_pod(self, pod: Pod) -> bool:
@@ -146,8 +161,10 @@ class PodGCController:
         try:
             self.client.pods(pod.metadata.namespace).patch(
                 pod.metadata.name, mutate)
+            self._swallowed.ok("fail_pod")
             return True
-        except Exception:
+        except Exception as e:
+            self._swallowed.swallow("fail_pod", e)
             return False
 
     def _gc_finished_jobs(self) -> int:
@@ -174,7 +191,8 @@ class PodGCController:
             try:
                 self.client.jobs(job.metadata.namespace).delete(
                     job.metadata.name)
+                self._swallowed.ok("delete_job")
                 n += 1
-            except Exception:
-                pass
+            except Exception as e:
+                self._swallowed.swallow("delete_job", e)
         return n

@@ -19,6 +19,7 @@ from ..api.core import Pod
 from ..api.meta import (LabelSelector, ObjectMeta, controller_ref,
                         new_controller_ref)
 from ..state.informer import EventHandlers, SharedInformerFactory
+from ..utils.errlog import SwallowedErrors
 from .base import Controller, Expectations
 
 
@@ -50,12 +51,16 @@ class ReplicaSetController(Controller):
 
     def __init__(self, client, informers: SharedInformerFactory,
                  kind=ReplicaSet, workers: int = 2,
-                 burst_replicas: int = 500):
+                 burst_replicas: int = 500, metrics=None):
         super().__init__(workers)
         self.client = client
         self.kind = kind
         self.api_version = kind().api_version
         self.burst_replicas = burst_replicas
+        # adoption/release/status writes survive single failures (the
+        # next sync retries the whole reconcile) but are never silent:
+        # logged once per streak + counted (swallowed_errors_total)
+        self._swallowed = SwallowedErrors(self.name, metrics)
         self.expectations = Expectations()
         self.rs_informer = informers.informer_for(kind)
         self.pod_informer = informers.informer_for(Pod)
@@ -150,8 +155,9 @@ class ReplicaSetController(Controller):
                     try:
                         self.client.pods(pod.metadata.namespace).patch(
                             pod.metadata.name, release)
-                    except Exception:
-                        pass
+                        self._swallowed.ok("release_pod")
+                    except Exception as e:
+                        self._swallowed.swallow("release_pod", e)
                     continue
                 out.append(pod)
                 continue
@@ -170,8 +176,9 @@ class ReplicaSetController(Controller):
             try:
                 out.append(self.client.pods(pod.metadata.namespace).patch(
                     pod.metadata.name, adopt))
-            except Exception:
-                pass
+                self._swallowed.ok("adopt_pod")
+            except Exception as e:
+                self._swallowed.swallow("adopt_pod", e)
         return out
 
     def _manage_replicas(self, key: str, rs, active: List[Pod]) -> None:
@@ -257,5 +264,6 @@ class ReplicaSetController(Controller):
         try:
             self._client_for().patch(rs.metadata.name, mutate,
                                      namespace=rs.metadata.namespace)
-        except Exception:
-            pass
+            self._swallowed.ok("update_status")
+        except Exception as e:
+            self._swallowed.swallow("update_status", e)

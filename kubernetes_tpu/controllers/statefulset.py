@@ -18,6 +18,7 @@ from ..api.core import PersistentVolumeClaim, Pod
 from ..api.meta import ObjectMeta, controller_ref, new_controller_ref
 from ..runtime.scheme import SCHEME
 from ..state.informer import EventHandlers, SharedInformerFactory
+from ..utils.errlog import SwallowedErrors
 from .base import Controller
 from .replicaset import pod_is_active, pod_is_ready
 
@@ -42,9 +43,13 @@ class StatefulSetController(Controller):
     name = "statefulset"
 
     def __init__(self, client, informers: SharedInformerFactory,
-                 workers: int = 1):
+                 workers: int = 1, metrics=None):
         super().__init__(workers)
         self.client = client
+        # ordinal create/delete/status writes survive single failures
+        # (the next sync re-walks the ordinals) but are never silent:
+        # logged once per streak + counted (swallowed_errors_total)
+        self._swallowed = SwallowedErrors(self.name, metrics)
         self.informer = informers.informer_for(StatefulSet)
         self.pod_informer = informers.informer_for(Pod)
         self.informer.add_event_handlers(EventHandlers(
@@ -82,8 +87,9 @@ class StatefulSetController(Controller):
             victim = owned[excess[0]]
             try:
                 self.client.pods(ns).delete(victim.metadata.name)
-            except Exception:
-                pass
+                self._swallowed.ok("scale_down")
+            except Exception as e:
+                self._swallowed.swallow("scale_down", e)
             self._update_status(st, owned)
             return
         # scale up / replace: lowest missing ordinal; OrderedReady waits for
@@ -136,8 +142,9 @@ class StatefulSetController(Controller):
         try:
             self.client.pods(st.metadata.namespace).delete(
                 victim.metadata.name)
-        except Exception:
-            pass
+            self._swallowed.ok("rolling_update")
+        except Exception as e:
+            self._swallowed.swallow("rolling_update", e)
 
     def _create_pod(self, st: StatefulSet, ordinal: int) -> None:
         name = f"{st.metadata.name}-{ordinal}"
@@ -157,8 +164,9 @@ class StatefulSetController(Controller):
                     owner_references=[new_controller_ref(
                         "StatefulSet", st.api_version, st.metadata)]),
                 spec=spec))
-        except Exception:
-            pass
+            self._swallowed.ok("create_pod")
+        except Exception as e:
+            self._swallowed.swallow("create_pod", e)
 
     def _ensure_claims(self, st: StatefulSet, ordinal: int, spec) -> None:
         """volumeClaimTemplates -> one PVC per ordinal, named
@@ -178,9 +186,9 @@ class StatefulSetController(Controller):
                     st.metadata.namespace).create(
                         serde.decode(PersistentVolumeClaim, pvc_data))
             except AlreadyExistsError:
-                pass
-            except Exception:
-                pass
+                self._swallowed.ok("create_claim")
+            except Exception as e:
+                self._swallowed.swallow("create_claim", e)
             for v in spec.volumes:
                 if v.name == tmpl_name and v.persistent_volume_claim:
                     v.persistent_volume_claim.claim_name = claim_name
@@ -210,5 +218,6 @@ class StatefulSetController(Controller):
         try:
             self.client.stateful_sets(st.metadata.namespace).patch(
                 st.metadata.name, mutate)
-        except Exception:
-            pass
+            self._swallowed.ok("update_status")
+        except Exception as e:
+            self._swallowed.swallow("update_status", e)

@@ -31,6 +31,7 @@ from ..state.informer import EventHandlers, SharedInformerFactory
 from ..state.store import ConflictError, NotFoundError
 from ..state.workqueue import RateLimitingQueue
 from ..utils.clock import now_iso
+from ..utils.errlog import SwallowedErrors
 from .runtime import ContainerRuntime, FakeRuntime
 
 DEFAULT_CAPACITY = {"cpu": "4", "memory": "32Gi", "pods": "110"}
@@ -46,9 +47,13 @@ class NodeAgent:
                  heartbeat_period: float = 10.0,
                  pleg_period: float = 1.0, eviction=None,
                  static_pod_dir=None, serve_port=None,
-                 device_manager=None, volume_manager=None):
+                 device_manager=None, volume_manager=None, metrics=None):
         self.client = client
         self.node_name = node_name
+        # heartbeat/lease/mirror writes must survive a flaky hub (the
+        # next period retries) but never silently: logged once per
+        # streak + counted (swallowed_errors_total{component=kubelet})
+        self._swallowed = SwallowedErrors("kubelet", metrics)
         self.capacity = dict(capacity or DEFAULT_CAPACITY)
         self.labels = dict(labels or {})
         self.runtime = runtime or FakeRuntime()
@@ -170,6 +175,7 @@ class NodeAgent:
                 cur.spec.renew_time = now_iso()
                 return cur
             self.client.leases(LEASE_NAMESPACE).patch(self.node_name, renew)
+            self._swallowed.ok("renew_lease")
         except NotFoundError:
             try:
                 self.client.leases(LEASE_NAMESPACE).create(Lease(
@@ -178,10 +184,13 @@ class NodeAgent:
                     spec=LeaseSpec(holder_identity=self.node_name,
                                    lease_duration_seconds=40,
                                    renew_time=now_iso())))
-            except Exception:
-                pass
-        except Exception:
-            pass
+                self._swallowed.ok("renew_lease")
+            except Exception as e:
+                self._swallowed.swallow("renew_lease", e)
+        except Exception as e:
+            # a missed renewal is the node-lifecycle controller's signal
+            # to start the grace clock; the next heartbeat retries
+            self._swallowed.swallow("renew_lease", e)
 
     def heartbeat(self) -> None:
         """Refresh the Ready condition's heartbeat (monitorNodeHealth's
@@ -217,8 +226,9 @@ class NodeAgent:
             return cur
         try:
             self.client.nodes().patch(self.node_name, beat)
-        except Exception:
-            pass
+            self._swallowed.ok("heartbeat")
+        except Exception as e:
+            self._swallowed.swallow("heartbeat", e)
         if self.device_manager is not None:
             # the ListAndWatch poll: health changes re-publish node
             # allocatable so the scheduler stops counting broken chips
@@ -232,8 +242,9 @@ class NodeAgent:
                             cur.status.allocatable[rname] = Quantity(count)
                         return cur
                     self.client.nodes().patch(self.node_name, republish)
-            except Exception:
-                pass
+                self._swallowed.ok("republish_devices")
+            except Exception as e:
+                self._swallowed.swallow("republish_devices", e)
         self._renew_lease()
         self._maybe_evict()
 
@@ -538,8 +549,9 @@ class NodeAgent:
         name, ns, _ = state
         try:
             self.client.pods(ns).delete(name)
-        except Exception:
-            pass
+            self._swallowed.ok("delete_mirror")
+        except Exception as e:
+            self._swallowed.swallow("delete_mirror", e)
 
     def start(self) -> None:
         self.register()

@@ -811,6 +811,21 @@ class BatchScheduler:
                 "scheduler_topo_inscan_fallbacks_total", reason)
         self._fallback_streak[reason] = streak + 1
 
+    def _count_capped_scan(self, cap: str, n: int) -> None:
+        """No silent caps (KTPU005): a truncated candidate search is
+        counted by cap name and logged once per streak, like the
+        in-scan fallbacks above."""
+        if self.sched_metrics is not None:
+            self.sched_metrics.capped_scans.inc(cap=cap)
+        streak = self._fallback_streak.get(cap, 0)
+        if streak == 0:
+            import logging
+            logging.getLogger(__name__).warning(
+                "capped scan (%s): %d candidates truncated to the "
+                "documented cap; further occurrences counted in "
+                "scheduler_capped_scans_total", cap, n)
+        self._fallback_streak[cap] = streak + 1
+
     def _end_inscan_streak(self, *reasons: str) -> None:
         """A batch made it through the in-scan caps: close these reasons'
         fallback streaks so the NEXT overflow logs again (the per-streak
@@ -1774,6 +1789,7 @@ class BatchScheduler:
                 continue
             candidates.append((name, ni))
         if len(candidates) > self.PREEMPT_CANDIDATE_CAP:
+            self._count_capped_scan("preempt_candidates", len(candidates))
             # cost bound: the clone + reprieve loop per candidate is host
             # python (the reference absorbs full-cluster cost with 16
             # goroutines, :996); rank by a cheap proxy for pick_one_node's
@@ -1841,6 +1857,8 @@ class BatchScheduler:
                         sum(prios), len(victims))
             candidates.sort(key=proxy)
             candidates = candidates[:self.PREEMPT_CANDIDATE_CAP]
+        else:
+            self._end_inscan_streak("preempt_candidates")
         victims_map: Dict[str, Tuple[List[Pod], int]] = {}
         for name, ni in candidates:
             sel = pre.select_victims_on_node(pod, ni, infos, fits, pdbs,

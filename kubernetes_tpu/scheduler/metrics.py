@@ -49,12 +49,14 @@ class SchedulerMetrics:
         self.schedule_attempts = r.counter(
             "scheduler_schedule_attempts_total",
             "Scheduling attempts by result")
-        # ref: PreemptionAttempts / PreemptionVictims
+        # ref: PreemptionAttempts / PreemptionVictims; family names use
+        # the reference's POST-rename spelling (the originals predate
+        # its metrics-naming linter — exactly the KTPU004 contract)
         self.preemption_attempts = r.counter(
-            "scheduler_total_preemption_attempts",
+            "scheduler_preemption_attempts_total",
             "Preemption attempts")
         self.preemption_victims = r.counter(
-            "scheduler_preemption_victims",
+            "scheduler_preemption_victims_total",
             "Pods evicted by preemption")
         self.pod_scheduling_errors = r.counter(
             "scheduler_pod_scheduling_errors_total",
@@ -103,6 +105,11 @@ class SchedulerMetrics:
             "scheduler_unschedulable_reasons_total",
             "Unschedulable scheduling attempts by failure reason "
             "(predicate message or queue park cause)")
+        # no silent caps (the PR 5 contract, enforced by KTPU005): every
+        # bounded search that truncated its candidate set is visible
+        self.capped_scans = r.counter(
+            "scheduler_capped_scans_total",
+            "Scans truncated at a documented cap, by cap name")
 
     def observe_queue(self, queue) -> None:
         """Sample the three sub-queue depths (PendingPods gauges)."""

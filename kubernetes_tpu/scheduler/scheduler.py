@@ -1502,23 +1502,28 @@ class Scheduler:
         if self._bind_pool is not None:
             self._bind_pool.shutdown(wait=False)
 
-    def wait_for_idle(self, timeout: float = 30.0,
-                      settle: float = 0.25) -> bool:
+    def wait_for_idle(self, timeout: float = 30.0, settle: float = 0.25,
+                      clock: Clock = REAL_CLOCK) -> bool:
         """Test helper: wait until no pod is pending OR in flight, and that
         stays true for `settle` seconds (creations reach the queue through
         the async informer, so a single instantaneous check can observe
-        "idle" before deliveries land)."""
-        import time
-        deadline = time.time() + timeout
+        "idle" before deliveries land).
+
+        `clock` defaults to REAL time, deliberately NOT self.clock:
+        queue deliveries ride informer threads that run in real time
+        even when the scheduler's own clock is a FakeClock, and
+        sleeping on a shared virtual clock would STEP it from this
+        helper and perturb the deterministic event timeline."""
+        deadline = clock.now() + timeout
         idle_since: Optional[float] = None
-        while time.time() < deadline:
+        while clock.now() < deadline:
             if self.queue.num_pending() == 0 and self._in_flight == 0:
-                now = time.time()
+                now = clock.now()
                 if idle_since is None:
                     idle_since = now
                 elif now - idle_since >= settle:
                     return True
             else:
                 idle_since = None
-            time.sleep(0.01)
+            clock.sleep(0.01)
         return self.queue.num_pending() == 0 and self._in_flight == 0

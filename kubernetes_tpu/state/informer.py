@@ -17,6 +17,7 @@ from collections import defaultdict
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Type
 
 from ..utils.backoff import BackoffPolicy
+from ..utils.clock import Clock, REAL_CLOCK
 from ..utils.metrics import InformerMetrics
 from .client import Client, ResourceClient, apply_bind_fields
 from .store import (ADDED, BOOKMARK, DELETED, ExpiredError, MODIFIED,
@@ -445,16 +446,19 @@ class SharedInformer:
                 self.last_sync_rv = rv
         return True
 
-    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+    def wait_for_sync(self, timeout: float = 10.0,
+                      clock: Clock = REAL_CLOCK) -> bool:
         """False fast if the informer is stopped (ref: WaitForCacheSync
-        returning false when the stop channel closes)."""
-        deadline = time.time() + timeout
+        returning false when the stop channel closes). Waits on `clock`
+        — REAL time by default, since the sync it polls for happens on a
+        real watch-pump thread even under a virtual event clock."""
+        deadline = clock.now() + timeout
         while True:
             if self._synced.is_set():
                 return True
-            if self._stop.is_set() or time.time() >= deadline:
+            if self._stop.is_set() or clock.now() >= deadline:
                 return False
-            time.sleep(0.005)
+            clock.sleep(0.005)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()

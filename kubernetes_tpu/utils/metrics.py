@@ -378,6 +378,14 @@ class RobustnessMetrics:
         self.kubelet_orphans_gced = r.counter(
             "kubelet_orphan_containers_gced_total",
             "Containers removed for pods the store no longer knows")
+        #: exceptions a drop-and-continue handler deliberately dropped
+        #: (utils.errlog.SwallowedErrors — the KTPU001 contract: logged
+        #: once per streak, counted every time). Distinct from
+        #: api_give_ups, which counts writes a RETRY policy abandoned.
+        self.swallowed_errors = r.counter(
+            "swallowed_errors_total",
+            "Exceptions handled by drop-and-continue paths, by "
+            "component and op")
 
 
 #: pod-startup latency buckets (seconds) — wider than the scheduler's

@@ -149,3 +149,46 @@ class TestCacheDebugger:
             assert "ghost" in dump and "n1" in dump
         finally:
             sched.informers.stop()
+
+
+class TestSwallowedErrors:
+    """utils/errlog.SwallowedErrors — the KTPU001 handling idiom: log
+    once per streak, count every swallow, re-arm on success."""
+
+    def test_counts_every_swallow_logs_once_per_streak(self, caplog):
+        import logging
+        from kubernetes_tpu.utils.errlog import SwallowedErrors
+        from kubernetes_tpu.utils.metrics import RobustnessMetrics
+        metrics = RobustnessMetrics()
+        sw = SwallowedErrors("testcomp", metrics)
+        with caplog.at_level(logging.WARNING,
+                             logger="kubernetes_tpu.testcomp"):
+            for _ in range(3):
+                sw.swallow("write", RuntimeError("boom"))
+        assert metrics.swallowed_errors.value(
+            component="testcomp", op="write") == 3
+        assert sw.streak("write") == 3
+        # one log line for the whole streak
+        assert len([r for r in caplog.records
+                    if "swallowed" in r.message]) == 1
+
+    def test_success_rearms_the_log(self, caplog):
+        import logging
+        from kubernetes_tpu.utils.errlog import SwallowedErrors
+        sw = SwallowedErrors("testcomp2")  # no metrics: still logs
+        with caplog.at_level(logging.WARNING,
+                             logger="kubernetes_tpu.testcomp2"):
+            sw.swallow("op", ValueError("a"))
+            sw.ok("op")
+            sw.swallow("op", ValueError("b"))
+        assert sw.streak("op") == 1
+        assert len([r for r in caplog.records
+                    if "swallowed" in r.message]) == 2
+
+    def test_streaks_are_per_op(self):
+        from kubernetes_tpu.utils.errlog import SwallowedErrors
+        sw = SwallowedErrors("testcomp3")
+        sw.swallow("a", RuntimeError("x"))
+        sw.swallow("b", RuntimeError("y"))
+        sw.ok("a")
+        assert sw.streak("a") == 0 and sw.streak("b") == 1

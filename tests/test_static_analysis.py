@@ -1,0 +1,413 @@
+"""ktpulint tier-1 gate: per-rule fixtures, suppression syntax, report
+determinism, and the baseline zero-growth contract.
+
+The whole module is a single-process AST walk — it must never import
+kubernetes_tpu (or jax): the linter reads source, it does not run it.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.ktpulint.engine import (BASELINE_PATH, REPO_ROOT,
+                                   apply_baseline, baseline_counts,
+                                   lint_modules, lint_text,
+                                   load_baseline, load_modules,
+                                   render_report)
+from tools.ktpulint.rules import (ALL_RULES, LockOrder, MetricNaming,
+                                  SilentCap, SwallowedException,
+                                  UnseededRandom, WallClock)
+
+FIXTURE = "kubernetes_tpu/_fixture.py"
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------- per-rule
+
+
+class TestKTPU001:
+    def test_bad_silent_pass(self):
+        src = ("try:\n    x = 1\nexcept Exception:\n    pass\n")
+        assert rules_of(lint_text(src)) == ["KTPU001"]
+
+    def test_bad_bare_except_return_constant(self):
+        src = ("def f():\n    try:\n        return g()\n"
+               "    except:\n        return False\n")
+        assert rules_of(lint_text(src)) == ["KTPU001"]
+
+    def test_good_logged(self):
+        src = ("import logging\ntry:\n    x = 1\n"
+               "except Exception as e:\n"
+               "    logging.getLogger('x').warning('%r', e)\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_good_counted(self):
+        src = ("try:\n    x = 1\nexcept Exception as e:\n"
+               "    swallowed.swallow('op', e)\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_good_narrow_type(self):
+        # a typed handler encodes an expected outcome, not a swallow
+        src = ("try:\n    x = 1\nexcept KeyError:\n    pass\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_good_fallback_call(self):
+        src = ("def f():\n    try:\n        return g()\n"
+               "    except Exception:\n        return fallback()\n")
+        assert rules_of(lint_text(src)) == []
+
+
+class TestKTPU002:
+    def test_bad_time_time(self):
+        src = "import time\ndeadline = time.time() + 5\n"
+        assert rules_of(lint_text(src)) == ["KTPU002"]
+
+    def test_bad_aliased_import(self):
+        src = "import time as _t\nx = _t.sleep(1)\n"
+        assert rules_of(lint_text(src)) == ["KTPU002"]
+
+    def test_bad_datetime_now(self):
+        src = ("from datetime import datetime\n"
+               "stamp = datetime.now()\n")
+        assert rules_of(lint_text(src)) == ["KTPU002"]
+
+    def test_good_injected_clock(self):
+        src = ("from kubernetes_tpu.utils.clock import REAL_CLOCK\n"
+               "deadline = REAL_CLOCK.now() + 5\nREAL_CLOCK.sleep(0.1)\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_clock_module_exempt(self):
+        src = "import time\nnow = time.time()\n"
+        assert rules_of(lint_text(
+            src, path="kubernetes_tpu/utils/clock.py")) == []
+
+    def test_local_receiver_not_confused(self):
+        # `self.time.time()` / locals named `time` must not match
+        src = "def f(self):\n    return self.time.time()\n"
+        assert rules_of(lint_text(src)) == []
+
+
+class TestKTPU003:
+    def test_bad_global_random(self):
+        src = "import random\nx = random.random()\n"
+        assert rules_of(lint_text(src)) == ["KTPU003"]
+
+    def test_bad_np_random(self):
+        src = "import numpy as np\nx = np.random.rand(3)\n"
+        assert rules_of(lint_text(src)) == ["KTPU003"]
+
+    def test_good_seeded_generator(self):
+        src = ("import random\nimport numpy as np\n"
+               "rng = random.Random('seed:1')\nx = rng.random()\n"
+               "g = np.random.default_rng(7)\ny = g.random()\n")
+        assert rules_of(lint_text(src)) == []
+
+
+class TestKTPU004:
+    def test_bad_counter_suffix(self):
+        src = ("class FooMetrics:\n    def __init__(self, r):\n"
+               "        self.c = r.counter('foo_count', 'h')\n")
+        found = lint_text(src)
+        assert rules_of(found) == ["KTPU004"]
+        assert "_total" in found[0].message
+
+    def test_bad_histogram_suffix(self):
+        src = ("class FooMetrics:\n    def __init__(self, r):\n"
+               "        self.h = r.histogram('foo_latency', 'h')\n")
+        assert rules_of(lint_text(src)) == ["KTPU004"]
+
+    def test_good_suffixes(self):
+        src = ("class FooMetrics:\n    def __init__(self, r):\n"
+               "        self.c = r.counter('foo_total', 'h')\n"
+               "        self.h = r.histogram('foo_seconds', 'h')\n"
+               "        self.g = r.gauge('foo_pending', 'h')\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_conflicting_kinds_across_files(self):
+        a = ("class AMetrics:\n    def __init__(self, r):\n"
+             "        self.c = r.counter('x_total', 'h')\n")
+        b = ("class BMetrics:\n    def __init__(self, r):\n"
+             "        self.h = r.histogram('x_total', 'h')\n")
+        found = lint_text(a, extra_sources={"kubernetes_tpu/_b.py": b})
+        # the counter side is suffix-clean but kind-conflicted; the
+        # histogram side is both; every registration site is reported
+        assert rules_of(found).count("KTPU004") >= 2
+        assert any("conflicting kinds" in f.message for f in found)
+
+    def test_literal_increment_must_resolve(self):
+        src = ("class FooMetrics:\n    def __init__(self, r):\n"
+               "        self.c = r.counter('known_total', 'h')\n"
+               "def f(families):\n"
+               "    families['unknown_total'].inc()\n"
+               "    families['known_total'].inc()\n")
+        found = lint_text(src)
+        assert rules_of(found) == ["KTPU004"]
+        assert "unknown_total" in found[0].message
+
+
+class TestKTPU005:
+    def test_bad_silent_slice(self):
+        src = ("CAND_CAP = 10\n"
+               "def f(items):\n    return items[:CAND_CAP]\n")
+        assert rules_of(lint_text(src)) == ["KTPU005"]
+
+    def test_bad_silent_min_clamp(self):
+        src = ("def f(self, n):\n"
+               "    return min(n, self.BATCH_LIMIT)\n")
+        assert rules_of(lint_text(src)) == ["KTPU005"]
+
+    def test_good_counted_cap(self):
+        src = ("CAND_CAP = 10\n"
+               "def f(self, items):\n"
+               "    if len(items) > CAND_CAP:\n"
+               "        self.metrics.capped.inc(cap='cand')\n"
+               "    return items[:CAND_CAP]\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_good_logged_cap(self):
+        src = ("import logging\nCAND_CAP = 10\n"
+               "def f(items):\n"
+               "    logging.getLogger('x').warning('capped')\n"
+               "    return items[:CAND_CAP]\n")
+        assert rules_of(lint_text(src)) == []
+
+
+class TestKTPU006:
+    CYCLE = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.b = B()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self.b._lock:\n"
+        "                pass\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.a = A()\n"
+        "    def g(self):\n"
+        "        with self._lock:\n"
+        "            with self.a._lock:\n"
+        "                pass\n")
+
+    def test_bad_cycle(self):
+        found = lint_text(self.CYCLE)
+        assert rules_of(found) == ["KTPU006"]
+        assert "A._lock -> B._lock -> A._lock" in found[0].message
+
+    def test_good_consistent_order(self):
+        src = self.CYCLE.replace(
+            "        with self._lock:\n"
+            "            with self.a._lock:\n",
+            "        with self.a._lock:\n"
+            "            with self._lock:\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_bad_self_deadlock_plain_lock(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def f(self):\n"
+               "        with self._lock:\n"
+               "            with self._lock:\n"
+               "                pass\n")
+        assert rules_of(lint_text(src)) == ["KTPU006"]
+
+    def test_bad_multi_item_with_cycle(self):
+        # `with a, b:` is sugar for nesting — the AB/BA deadlock must
+        # be caught in the single-statement form too
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.b = B()\n"
+            "    def f(self):\n"
+            "        with self._lock, self.b._lock:\n"
+            "            pass\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.a = A()\n"
+            "    def g(self):\n"
+            "        with self._lock, self.a._lock:\n"
+            "            pass\n")
+        found = lint_text(src)
+        assert rules_of(found) == ["KTPU006"]
+
+    def test_good_reentrant_rlock(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.RLock()\n"
+               "    def f(self):\n"
+               "        with self._lock:\n"
+               "            with self._lock:\n"
+               "                pass\n")
+        assert rules_of(lint_text(src)) == []
+
+
+# -------------------------------------------------------- suppressions
+
+
+class TestSuppressions:
+    def test_disable_with_reason_honored(self):
+        src = ("try:\n    x = 1\n"
+               "except Exception:  "
+               "# ktpulint: disable=KTPU001 handled by outer retry\n"
+               "    pass\n")
+        assert rules_of(lint_text(src)) == []
+
+    def test_disable_without_reason_is_an_error(self):
+        src = ("try:\n    x = 1\n"
+               "except Exception:  # ktpulint: disable=KTPU001\n"
+               "    pass\n")
+        found = lint_text(src)
+        # the finding is NOT suppressed, and the bare disable is flagged
+        assert rules_of(found) == ["KTPU000", "KTPU001"]
+
+    def test_disable_unknown_rule_is_an_error(self):
+        src = "x = 1  # ktpulint: disable=KTPU999x reason here\n"
+        assert rules_of(lint_text(src)) == ["KTPU000"]
+
+    def test_multi_rule_disable(self):
+        src = ("import time\n"
+               "try:\n    deadline = time.time()  "
+               "# ktpulint: disable=KTPU001,KTPU002 fixture needs both\n"
+               "except Exception:\n    pass\n")
+        found = lint_text(src)
+        assert rules_of(found) == ["KTPU001"]  # except is on its own line
+
+    def test_marker_inside_string_is_not_a_suppression(self):
+        src = ("import time\n"
+               "s = '# ktpulint: disable=KTPU002 nope'\n"
+               "t = time.time()\n")
+        assert rules_of(lint_text(src)) == ["KTPU002"]
+
+
+# --------------------------------------------------- full-tree contract
+
+#: ceilings frozen at the PR that introduced the linter; these may only
+#: be LOWERED (fix sites, regenerate the baseline) — raising one is the
+#: "baseline growth" this test exists to refuse. For comparison, the
+#: pre-linter tree produced KTPU001=80, KTPU002=47, KTPU004=4,
+#: KTPU005=1 (the delta is this PR's down-payment).
+BASELINE_CEILINGS = {"KTPU001": 57, "KTPU002": 33, "KTPU004": 2}
+
+
+@pytest.fixture(scope="module")
+def full_lint():
+    modules, parse_errors = load_modules(["kubernetes_tpu"])
+    assert not parse_errors, parse_errors
+    findings = lint_modules(modules, [r() for r in ALL_RULES])
+    return findings
+
+
+class TestRepoContract:
+    def test_zero_nonbaselined_findings(self, full_lint):
+        baseline = load_baseline()
+        new = apply_baseline(full_lint, baseline)
+        assert new == [], "non-baselined findings:\n" + render_report(new)
+
+    def test_baseline_counts_match_tree_exactly(self, full_lint):
+        """A fixed site must be REMOVED from the baseline (run
+        --update-baseline): a stale allowance would let a regression
+        hide inside the grandfathered count."""
+        assert baseline_counts(full_lint) == {
+            key: e["count"] for key, e in load_baseline().items()}
+
+    def test_baseline_growth_refused(self):
+        baseline = load_baseline()
+        per_rule = {}
+        for (path, rule), e in baseline.items():
+            per_rule[rule] = per_rule.get(rule, 0) + e["count"]
+        assert set(per_rule) <= set(BASELINE_CEILINGS), \
+            f"new rule grandfathered into the baseline: {per_rule}"
+        for rule, total in per_rule.items():
+            assert total <= BASELINE_CEILINGS[rule], \
+                (f"{rule} baseline grew past its frozen ceiling "
+                 f"({total} > {BASELINE_CEILINGS[rule]}); fix the new "
+                 "sites instead of baselining them")
+
+    def test_every_baseline_entry_has_a_reason(self):
+        for key, e in load_baseline().items():
+            assert e["reason"] and not e["reason"].startswith("TODO"), \
+                f"baseline entry {key} has no reason"
+
+    def test_report_is_deterministic(self):
+        reports = []
+        for _ in range(2):
+            modules, _errs = load_modules(["kubernetes_tpu"])
+            findings = lint_modules(modules, [r() for r in ALL_RULES])
+            reports.append(render_report(findings))
+        assert reports[0] == reports[1]
+
+    def test_suppression_reasons_mandatory_in_tree(self, full_lint):
+        assert not [f for f in full_lint if f.rule == "KTPU000"], \
+            render_report([f for f in full_lint if f.rule == "KTPU000"])
+
+
+class TestCLI:
+    def test_cli_clean_on_tree(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.ktpulint", "kubernetes_tpu"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "clean" in out.stdout
+
+    def test_cli_changed_mode(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.ktpulint", "--changed"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_linter_never_imports_the_package_or_jax(self):
+        """The tier-1 speed contract: a pure AST walk, no JAX init."""
+        out = subprocess.run(
+            [sys.executable, "-c",
+             # snapshot first: a site hook may preload jax at interpreter
+             # start; the contract is that the LINTER adds neither
+             "import sys; before = set(sys.modules)\n"
+             "import tools.ktpulint as k\n"
+             "from tools.ktpulint.engine import load_modules\n"
+             "from tools.ktpulint.rules import ALL_RULES\n"
+             "mods, _ = load_modules(['kubernetes_tpu'])\n"
+             "k.lint_modules(mods, [r() for r in ALL_RULES])\n"
+             "bad = [m for m in set(sys.modules) - before\n"
+             "       if m.startswith(('kubernetes_tpu', 'jax'))]\n"
+             "assert not bad, bad\n"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_cli_nonexistent_path_is_an_error(self):
+        # a typo'd target must not read as a passing lint
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.ktpulint",
+             "kubernetes_tpu/typo_does_not_exist.py"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2, out.stdout + out.stderr
+        assert "no .py files" in out.stderr
+
+    def test_cli_update_baseline_refuses_explicit_paths(self):
+        # a subtree-scoped rewrite would delete every other entry
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.ktpulint",
+             "kubernetes_tpu/scheduler", "--update-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2, out.stdout + out.stderr
+
+    def test_baseline_json_parses(self):
+        data = json.loads(Path(BASELINE_PATH).read_text())
+        assert data["version"] == 1
+        assert all(e["count"] > 0 for e in data["entries"])

@@ -1,0 +1,3 @@
+"""Repo tooling (static analysis, verification helpers) — the analog of
+the reference's hack/ directory, shipped as an importable package so the
+tier-1 suite can run the checks in-process."""

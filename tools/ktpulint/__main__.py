@@ -1,0 +1,148 @@
+"""ktpulint CLI.
+
+    python -m tools.ktpulint                    # lint kubernetes_tpu/
+    python -m tools.ktpulint --changed          # only files touched vs main
+    python -m tools.ktpulint path [path ...]    # explicit targets
+    python -m tools.ktpulint --update-baseline  # regenerate counts
+                                                # (reasons preserved)
+
+Exit codes: 0 clean, 1 findings, 2 usage/environment error.
+
+--changed is the pre-commit fast path: targets are the .py files under
+kubernetes_tpu/ that differ from the merge-base with main (committed,
+staged, unstaged, or untracked). Cross-file rules (metric resolution,
+the lock graph) still read the FULL tree for context — diff mode
+narrows what is REPORTED, never what is KNOWN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+from typing import List, Optional, Set
+
+from .engine import (BASELINE_PATH, REPO_ROOT, apply_baseline,
+                     lint_modules, load_baseline, load_modules,
+                     render_report, write_baseline)
+from .rules import ALL_RULES
+
+DEFAULT_TARGET = "kubernetes_tpu"
+
+
+def _git(*args: str) -> Optional[List[str]]:
+    try:
+        out = subprocess.run(
+            ["git", *args], cwd=REPO_ROOT, capture_output=True,
+            text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def changed_files(base: str = "main") -> Optional[Set[str]]:
+    """Repo-relative .py paths under kubernetes_tpu/ that differ from
+    the merge-base with `base`, plus uncommitted/untracked work. None
+    when git is unavailable (caller falls back to a full lint)."""
+    merge_base = _git("merge-base", "HEAD", base)
+    changed: Set[str] = set()
+    parts = [
+        _git("diff", "--name-only", merge_base[0]) if merge_base else None,
+        _git("diff", "--name-only"),                    # unstaged
+        _git("diff", "--name-only", "--cached"),        # staged
+        _git("ls-files", "--others", "--exclude-standard"),  # untracked
+    ]
+    if all(p is None for p in parts):
+        return None
+    for p in parts:
+        changed.update(p or [])
+    return {c for c in changed
+            if c.endswith(".py") and c.startswith("kubernetes_tpu/")}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ktpulint",
+        description="AST contract linter for kubernetes_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGET})")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs main (fast "
+                         "pre-commit mode)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite baseline.json at current counts "
+                         "(reasons preserved; growth is warned)")
+    ap.add_argument("--baseline", default=str(BASELINE_PATH),
+                    help="baseline file (default: the checked-in one)")
+    args = ap.parse_args(argv)
+
+    report_paths: Optional[Set[str]] = None
+    if args.update_baseline and args.paths:
+        # a subtree-scoped rewrite would silently DELETE every other
+        # grandfathered entry (and its hand-written reason)
+        ap.error("--update-baseline regenerates from the full tree; "
+                 "it cannot be combined with explicit paths")
+    if args.changed:
+        if args.paths:
+            ap.error("--changed and explicit paths are mutually exclusive")
+        report_paths = changed_files()
+        if report_paths is None:
+            print("ktpulint: git unavailable; falling back to full lint",
+                  file=sys.stderr)
+        elif not report_paths:
+            print("ktpulint: no changed kubernetes_tpu/*.py files")
+            return 0
+
+    # cross-file rules always see the full tree
+    targets = args.paths or [DEFAULT_TARGET]
+    load_targets = [DEFAULT_TARGET] if (args.changed or args.update_baseline) \
+        else sorted(set(targets) | {DEFAULT_TARGET})
+    modules, parse_errors = load_modules(load_targets)
+    if not modules and not parse_errors:
+        print(f"ktpulint: nothing to lint under {targets}", file=sys.stderr)
+        return 2
+    if not args.changed and args.paths:
+        # explicit paths: report only what was asked for — and refuse a
+        # target that resolves to nothing (a typo in a pre-commit hook
+        # must not read as a passing lint forever)
+        from .engine import iter_py_files
+        for p in args.paths:
+            if not iter_py_files([p]):
+                print(f"ktpulint: no .py files under '{p}'",
+                      file=sys.stderr)
+                return 2
+        wanted, _ = load_modules(targets)
+        report_paths = {m.path for m in wanted}
+
+    rules = [r() for r in ALL_RULES]
+    findings = lint_modules(modules, rules, report_paths=report_paths)
+    findings = sorted(findings + [e for e in parse_errors
+                                  if report_paths is None
+                                  or e.path in report_paths],
+                      key=lambda f: f.sort_key)
+
+    if args.update_baseline:
+        delta = write_baseline(findings, Path(args.baseline))
+        for key, prev, cur in delta["grew"]:
+            print(f"ktpulint: WARNING baseline GREW for {key[0]} "
+                  f"{key[1]}: {prev} -> {cur} (fix the new sites "
+                  "instead)", file=sys.stderr)
+        print(f"ktpulint: baseline written to {args.baseline} "
+              f"({len(findings)} findings recorded)")
+        return 1 if delta["grew"] else 0
+
+    if not args.no_baseline:
+        findings = apply_baseline(findings,
+                                  load_baseline(Path(args.baseline)))
+
+    sys.stdout.write(render_report(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

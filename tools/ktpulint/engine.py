@@ -1,0 +1,303 @@
+"""ktpulint engine: module loading, suppression parsing, baseline
+accounting, and the deterministic report.
+
+Design constraints (ISSUE 12):
+- stdlib only (`ast`, `tokenize`, `json`); never imports kubernetes_tpu
+  so the tier-1 test pays a single-process AST walk, not a JAX init.
+- Findings are DETERMINISTIC: sorted by (path, line, rule, message) and
+  rendered without timestamps, so two runs over the same tree produce
+  byte-identical reports (pinned by test_static_analysis).
+- Inline suppressions require a reason; a reasonless or unknown-rule
+  disable is reported as KTPU000 instead of honored.
+- The baseline grandfathers pre-linter findings as per-(path, rule)
+  COUNTS (line numbers drift; counts don't): a file may never exceed
+  its baselined count, and the checked-in counts may only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: repo root = parent of tools/
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+#: "# ktpulint: disable=KTPU001[,KTPU002] <mandatory reason>"
+_SUPPRESS_RE = re.compile(
+    r"ktpulint:\s*disable=([A-Za-z0-9_,]+)\s*(.*)\s*$")
+
+_RULE_ID_RE = re.compile(r"^KTPU\d{3}$")
+
+#: the engine's own rule id: malformed suppressions (missing reason,
+#: unknown rule id) — never suppressible, never baselined away silently
+BAD_SUPPRESS = "KTPU000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def sort_key(self) -> Tuple:
+        return (self.path, self.line, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    path: str                      # repo-relative, forward slashes
+    tree: ast.Module
+    source: str
+    #: line -> set of rule ids disabled on that line (reason present)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    #: (line, detail) for malformed disables -> KTPU000
+    bad_suppressions: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _parse_suppressions(module: Module) -> None:
+    """Comment scan via tokenize (precise: a string literal that happens
+    to contain the marker is not a suppression)."""
+    try:
+        tokens = tokenize.generate_tokens(
+            io.StringIO(module.source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):  # parse caught it
+        return
+    for line, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            if "ktpulint:" in text:
+                module.bad_suppressions.append(
+                    (line, "unparseable ktpulint directive"))
+            continue
+        rules = [r for r in m.group(1).split(",") if r]
+        reason = m.group(2).strip()
+        bad = [r for r in rules if not _RULE_ID_RE.match(r)
+               or r == BAD_SUPPRESS]
+        if bad:
+            module.bad_suppressions.append(
+                (line, f"unknown rule id {','.join(bad)}"))
+            continue
+        if not reason:
+            module.bad_suppressions.append(
+                (line, f"disable={m.group(1)} carries no reason "
+                       "(the reason is mandatory)"))
+            continue
+        module.suppressions.setdefault(line, set()).update(rules)
+
+
+def load_module(path: Path, rel: str) -> Tuple[Optional[Module],
+                                               Optional[Finding]]:
+    """Parse one file; a syntax error is itself a finding (the linter
+    must never silently skip what it cannot read — its own no-silent-
+    swallow contract)."""
+    source = path.read_text(encoding="utf-8")
+    return load_module_text(source, rel)
+
+
+def load_module_text(source: str, rel: str) -> Tuple[Optional[Module],
+                                                     Optional[Finding]]:
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return None, Finding(rel, e.lineno or 1, BAD_SUPPRESS,
+                             f"file does not parse: {e.msg}")
+    module = Module(path=rel, tree=tree, source=source)
+    _parse_suppressions(module)
+    return module, None
+
+
+def iter_py_files(paths: Sequence[str],
+                  root: Path = REPO_ROOT) -> List[Tuple[Path, str]]:
+    """Expand files/directories into (abs path, repo-relative) pairs,
+    sorted by relative path for determinism."""
+    out: Dict[str, Path] = {}
+    for p in paths:
+        ap = (root / p) if not Path(p).is_absolute() else Path(p)
+        if ap.is_dir():
+            for f in ap.rglob("*.py"):
+                rel = f.relative_to(root).as_posix()
+                out[rel] = f
+        elif ap.suffix == ".py" and ap.exists():
+            rel = ap.resolve().relative_to(root).as_posix()
+            out[rel] = ap
+    return [(out[rel], rel) for rel in sorted(out)]
+
+
+def load_modules(paths: Sequence[str],
+                 root: Path = REPO_ROOT
+                 ) -> Tuple[List[Module], List[Finding]]:
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for ap, rel in iter_py_files(paths, root):
+        module, err = load_module(ap, rel)
+        if err is not None:
+            errors.append(err)
+        else:
+            modules.append(module)
+    return modules, errors
+
+
+# ------------------------------------------------------------------ lint
+
+def lint_modules(modules: List[Module], rules,
+                 report_paths: Optional[Set[str]] = None) -> List[Finding]:
+    """Run `rules` over `modules`. Global context (registered metric
+    families, the lock graph) is always built from EVERY module; when
+    `report_paths` is given (--changed), only findings in those files
+    are reported — diff mode must not weaken cross-file rules."""
+    for rule in rules:
+        rule.prepare(modules)
+    findings: List[Finding] = []
+    for m in modules:
+        per_file: List[Finding] = []
+        for rule in rules:
+            per_file.extend(rule.check(m))
+        # honor inline suppressions (reason already validated)
+        kept = []
+        for f in per_file:
+            if f.rule in m.suppressions.get(f.line, ()):
+                continue
+            kept.append(f)
+        for line, detail in m.bad_suppressions:
+            kept.append(Finding(m.path, line, BAD_SUPPRESS, detail))
+        findings.extend(kept)
+    if report_paths is not None:
+        findings = [f for f in findings if f.path in report_paths]
+    return sorted(findings, key=lambda f: f.sort_key)
+
+
+def lint_text(source: str, path: str = "kubernetes_tpu/_fixture.py",
+              rules=None, extra_sources: Optional[Dict[str, str]] = None
+              ) -> List[Finding]:
+    """Fixture entry point for tests: lint a snippet (plus optional
+    companion modules for the cross-file rules) without touching disk."""
+    from .rules import ALL_RULES
+    rules = [r() for r in (rules or ALL_RULES)]
+    sources = dict(extra_sources or {})
+    sources[path] = source
+    modules: List[Module] = []
+    errors: List[Finding] = []
+    for rel in sorted(sources):
+        module, err = load_module_text(sources[rel], rel)
+        if err is not None:
+            errors.append(err)
+        else:
+            modules.append(module)
+    return sorted(errors + lint_modules(modules, rules),
+                  key=lambda f: f.sort_key)
+
+
+# -------------------------------------------------------------- baseline
+
+def load_baseline(path: Path = BASELINE_PATH) -> Dict[Tuple[str, str], dict]:
+    """(path, rule) -> {"count": int, "reason": str}."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out: Dict[Tuple[str, str], dict] = {}
+    for e in data.get("entries", []):
+        out[(e["path"], e["rule"])] = {
+            "count": int(e["count"]), "reason": e.get("reason", "")}
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str], dict]) -> List[Finding]:
+    """Drop the first `count` findings of each baselined (path, rule)
+    group (line order); anything beyond the grandfathered count — and
+    every finding in a non-baselined group — is reported. KTPU000 is
+    never baselined: a malformed suppression is always an error."""
+    grouped: Dict[Tuple[str, str], List[Finding]] = {}
+    for f in findings:
+        grouped.setdefault((f.path, f.rule), []).append(f)
+    out: List[Finding] = []
+    for key in sorted(grouped):
+        group = grouped[key]
+        if key[1] == BAD_SUPPRESS:
+            out.extend(group)
+            continue
+        allowed = baseline.get(key, {}).get("count", 0)
+        if len(group) > allowed:
+            excess = group[allowed:]
+            for f in excess:
+                note = (f" [{len(group)} findings vs {allowed} baselined]"
+                        if allowed else "")
+                out.append(Finding(f.path, f.line, f.rule,
+                                   f.message + note))
+    return sorted(out, key=lambda f: f.sort_key)
+
+
+def baseline_counts(findings: List[Finding]) -> Dict[Tuple[str, str], int]:
+    counts: Dict[Tuple[str, str], int] = {}
+    for f in findings:
+        if f.rule == BAD_SUPPRESS:
+            continue
+        counts[(f.path, f.rule)] = counts.get((f.path, f.rule), 0) + 1
+    return counts
+
+
+def write_baseline(findings: List[Finding], path: Path = BASELINE_PATH,
+                   reasons: Optional[Dict[Tuple[str, str], str]] = None,
+                   ) -> dict:
+    """Regenerate the baseline at the CURRENT counts, preserving reasons
+    for entries that survive. Returns {"grew": [...], "shrank": [...]} so
+    the CLI can warn — growth is what the tier-1 test exists to refuse."""
+    old = load_baseline(path) if path.exists() else {}
+    counts = baseline_counts(findings)
+    entries = []
+    grew, shrank = [], []
+    for key in sorted(counts):
+        reason = (reasons or {}).get(key) or old.get(key, {}).get(
+            "reason") or "TODO: justify or fix"
+        prev = old.get(key, {}).get("count")
+        if prev is not None and counts[key] > prev:
+            grew.append((key, prev, counts[key]))
+        if prev is not None and counts[key] < prev:
+            shrank.append((key, prev, counts[key]))
+        entries.append({"path": key[0], "rule": key[1],
+                        "count": counts[key], "reason": reason})
+    for key in sorted(old):
+        if key not in counts:
+            shrank.append((key, old[key]["count"], 0))
+    path.write_text(json.dumps(
+        {"version": 1,
+         "comment": "Grandfathered pre-linter findings; counts may only "
+                    "shrink. Regenerate with --update-baseline after "
+                    "fixing sites.",
+         "entries": entries}, indent=1) + "\n")
+    return {"grew": grew, "shrank": shrank}
+
+
+# ---------------------------------------------------------------- report
+
+def render_report(findings: List[Finding]) -> str:
+    """Byte-deterministic report: sorted findings, one per line, then a
+    per-rule tally (stable ordering, no timestamps)."""
+    lines = [f.render() for f in findings]
+    tally: Dict[str, int] = {}
+    for f in findings:
+        tally[f.rule] = tally.get(f.rule, 0) + 1
+    if findings:
+        lines.append("")
+        lines.append("findings: " + " ".join(
+            f"{rule}={n}" for rule, n in sorted(tally.items())))
+    else:
+        lines.append("ktpulint: clean")
+    return "\n".join(lines) + "\n"
