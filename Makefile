@@ -1,6 +1,6 @@
 # Convenience entries (the reference's hack/ equivalents).
 
-.PHONY: lint lint-changed test test-tier1
+.PHONY: lint lint-changed test test-tier1 bench-sharded
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -13,3 +13,9 @@ lint-changed:
 # tier-1 suite (what the roadmap's verify line runs)
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# sharded drain bench: device-scaling curve + bit-identity parity on 8
+# virtual CPU devices (no TPU needed; see README "Sharded scheduling")
+bench-sharded:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python bench.py sharded

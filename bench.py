@@ -114,7 +114,7 @@ def make_pod(i, variant="uniform"):
 
 
 def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
-               warm_all_buckets=True):
+               warm_all_buckets=True, mesh=None):
     """One scheduler_perf config. Returns (pods/s, scheduled, sched,
     setup_s, elapsed) — the ONE fixture/warmup scaffold every config runs
     through, so warmup strategies cannot drift between configs.
@@ -124,11 +124,14 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
     bucket the drain can produce — needed when in-batch (anti-)affinity
     repair demotes losers into shrinking retry batches; uniform configs
     produce no retries, so they warm just the full + final-partial buckets.
+
+    `mesh` shards the drain over the device mesh (the sharded section's
+    scaling sweep passes 1-D node meshes of growing width).
     """
     from kubernetes_tpu.scheduler import Scheduler
     client = Client(validate=False)
     b = batch or BATCH
-    sched = Scheduler(client, batch_size=b)
+    sched = Scheduler(client, batch_size=b, mesh=mesh)
     t_setup = time.time()
     for i in range(n_nodes):
         node = make_node(i)
@@ -1013,6 +1016,159 @@ def measure_parity(variant, n_pods, n_nodes):
     return matches / max(1, len(oracle_decision)), scheduled, extra
 
 
+# ------------------------------------------------------ sharded section
+#
+# The mesh-sharded drain (ISSUE 13): run the SAME uniform fill with the
+# node axis sharded over 1..K devices (shard_map class scan, cross-shard
+# argmax) and report the device-scaling curve, plus bit-identity parity
+# fixtures against the single-device kernel. Runs on CPU via
+# XLA_FLAGS=--xla_force_host_platform_device_count=8 (make bench-sharded);
+# on a single-core host the virtual devices timeshare, so wall-clock
+# scaling there measures sharding OVERHEAD — the honest number is still
+# reported, with the host's core count alongside.
+
+SHARD_SWEEP = os.environ.get("BENCH_SHARD_SWEEP", "5000x50000,50000x500000")
+SHARD_COUNTS = [int(x) for x in
+                os.environ.get("BENCH_SHARD_COUNTS", "1,2,4,8").split(",")]
+SHARD_BATCH = int(os.environ.get("BENCH_SHARD_BATCH", "16384"))
+SHARD_PARITY_PODS = int(os.environ.get("BENCH_SHARD_PARITY_PODS", "2000"))
+SHARD_PARITY_NODES = int(os.environ.get("BENCH_SHARD_PARITY_NODES", "512"))
+
+
+def _node_mesh(shards):
+    """A 1-D "nodes" mesh over the first `shards` devices. For 1 shard
+    returns the EXPLICIT single-device sentinel (resolve_mesh maps n<=1
+    to no mesh, env-immune) — `KTPU_MESH=auto` in the environment must
+    not quietly turn the baseline curve point into an 8-shard run."""
+    if shards <= 1:
+        return 1
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    devs = jax.devices()
+    if len(devs) < shards:
+        return None
+    return Mesh(np.array(devs[:shards]), ("nodes",))
+
+
+def measure_sharded_parity(variant, n_pods, n_nodes, shards=8):
+    """Bit-identity rate of the sharded drain's binds vs the single-device
+    drain on one fixture variant (1.0 = every decision identical). The
+    node count keeps both layouts at the same mirror capacity, so the
+    (row, seq) tie-break hashes — part of the decision — are comparable."""
+    import gc
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.tensorize import precompute_pod_features
+
+    def run(mesh):
+        client = Client(validate=False)
+        sched = Scheduler(client, batch_size=4096, mesh=mesh)
+        for i in range(n_nodes):
+            node = make_node(i, variant)
+            client.nodes().create(node)
+            sched.cache.add_node(node)
+        pods = [client.pods().create(make_pod(i, variant))
+                for i in range(n_pods)]
+        for p in pods:
+            precompute_pod_features(p)
+            sched.queue.add(p)
+        sched.algorithm.refresh()
+        sched.drain_pipelined()
+        binds = {p.metadata.name: p.spec.node_name or ""
+                 for p in client.pods().list()}
+        n_sharded = sched.metrics.sharded_batches.value()
+        del sched
+        gc.collect()
+        return binds, n_sharded
+
+    single, _ = run(1)       # explicit single-device (KTPU_MESH-immune)
+    mesh = _node_mesh(shards)
+    if mesh is None:
+        return None
+    sharded, n_sharded_batches = run(mesh)
+    matches = sum(1 for k, v in single.items() if sharded.get(k) == v)
+    return {"rate": round(matches / max(1, len(single)), 4),
+            "pods": n_pods, "nodes": n_nodes, "shards": shards,
+            "sharded_batches": n_sharded_batches}
+
+
+def sharded_curve():
+    """The sharded section's detail: a device-scaling sweep per
+    (nodes x pods) combo plus the parity fixtures."""
+    import gc
+    combos = []
+    for part in SHARD_SWEEP.split(","):
+        n, p = part.strip().split("x")
+        combos.append((int(n), int(p)))
+    sweeps = []
+    for n_nodes, n_pods in combos:
+        curve = []
+        for shards in SHARD_COUNTS:
+            mesh = _node_mesh(shards)
+            if shards > 1 and mesh is None:
+                curve.append({"shards": shards,
+                              "skipped": "not enough devices"})
+                continue
+            rate, scheduled, sched, setup_s, elapsed = run_config(
+                n_nodes, n_pods, "uniform", batch=SHARD_BATCH,
+                warm_all_buckets=False, mesh=mesh)
+            m = sched.metrics
+            sync_p99 = m.shard_sync_seconds.quantile(0.99)
+            curve.append({
+                "shards": shards,
+                "pods_per_sec": round(rate, 1),
+                "scheduled": scheduled,
+                "elapsed_s": round(elapsed, 2),
+                "setup_s": round(setup_s, 2),
+                # where the device went: the scan-wait phase is the part
+                # sharding can move; commit/bind stay host-bound
+                "device_scan_wait_s":
+                    sched.bench_phases["device_scan_wait_s"],
+                "host_term_prep_s":
+                    sched.bench_phases["host_term_prep_s"],
+                "sharded_batches": m.sharded_batches.value(),
+                "shard_sync_p99_s": (round(sync_p99, 4)
+                                     if sync_p99 != float("inf") else None),
+                "mirror_pad_rows": m.mirror_shard_pad_rows.value(),
+            })
+            del sched
+            gc.collect()
+        sweeps.append({"nodes": n_nodes, "pods": n_pods,
+                       "batch": SHARD_BATCH, "scaling": curve})
+    parity = {}
+    for variant in ("uniform", "node-affinity", "pod-anti-affinity"):
+        p = measure_sharded_parity(variant, SHARD_PARITY_PODS,
+                                   SHARD_PARITY_NODES)
+        if p is not None:
+            parity[variant] = p
+        gc.collect()
+    return {"sweeps": sweeps, "parity": parity,
+            "host_cores": os.cpu_count(),
+            "kernel": "shard_map class scan, cross-shard argmax over "
+                      "(score, global node id)"}
+
+
+def sharded_main():
+    """`bench.py sharded` — the device-scaling curve + parity fixtures.
+    The headline value is the widest mesh's pods/s at the LARGEST combo."""
+    detail = sharded_curve()
+    big = detail["sweeps"][-1]
+    widest = [c for c in big["scaling"] if "pods_per_sec" in c]
+    value = widest[-1]["pods_per_sec"] if widest else 0.0
+    parity_min = min((p["rate"] for p in detail["parity"].values()),
+                     default=None)
+    print(json.dumps({
+        "metric": "sharded drain pods-scheduled/sec "
+                  f"({big['pods']} pods x {big['nodes']} nodes, "
+                  f"{len(detail['sweeps'][0]['scaling'])}-point device "
+                  "scaling curve)",
+        "value": value,
+        "unit": "pods/s",
+        "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 2),
+        "detail": {"sharded": detail, "parity_min": parity_min},
+    }))
+
+
 N_RUNS = int(os.environ.get("BENCH_RUNS", "3"))
 
 
@@ -1289,6 +1445,8 @@ def serving_main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "sharded":
+        sharded_main()
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

@@ -260,8 +260,15 @@ class ChaosHarness:
                  enable_restarts: bool = True,
                  with_tears: bool = False,
                  ha: bool = False,
-                 replica: bool = False):
+                 replica: bool = False,
+                 mesh=None):
         self.seed = seed
+        #: jax.sharding.Mesh for the scheduler's drain (None = single
+        #: device). The determinism contract must survive sharding: the
+        #: sharded kernel's decisions are bit-identical by construction,
+        #: so same seed => identical event logs with the mesh on
+        #: (pinned by tests/test_sharded.py)
+        self.mesh = mesh
         self.n_nodes = nodes
         self.nodes_per_slice = max(1, nodes_per_slice)
         self.clock_step = clock_step
@@ -397,7 +404,7 @@ class ChaosHarness:
         return Scheduler(client if client is not None else self.client,
                          informer_factory=factory,
                          batch_size=64, clock=self.clock,
-                         async_bind=False,
+                         async_bind=False, mesh=self.mesh,
                          tracer=None if self.ha else self.tracer)
 
     def _make_controllers(self, factory: SharedInformerFactory,

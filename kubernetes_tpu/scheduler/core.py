@@ -43,6 +43,7 @@ from ..api.serde import deepcopy_obj
 from .cache import Cache, Snapshot
 from .nodeinfo import NodeInfo, pod_has_affinity_constraints
 from . import predicates as preds
+from . import sharding as sharding_mod
 from .tensorize import PodBatchTensors, TensorMirror, TermCompiler
 from .topology import AffinityProfile, BatchOverlay, TopologyIndex
 
@@ -116,6 +117,10 @@ class PendingBatch:
     #: carries no ports/volumes/extenders: with no stale winners, the
     #: repair pass has nothing left to validate and is skipped outright
     inscan_cover: bool = False
+    #: True when this batch ran the shard_map kernel (per-shard
+    #: filter+score, cross-shard argmax) — schedule_finish attributes its
+    #: fetch wait to scheduler_shard_sync_seconds
+    sharded: bool = False
 
 
 class _RepairReassigner:
@@ -899,6 +904,15 @@ class BatchScheduler:
         P = len(pods)
         dom, n_domains = idx.term_table(tuple(terms),
                                         use_cache=self.topo_table_cache)
+        # sharded drain: the padded [T, N] table also lives ON DEVICE,
+        # epoch-cached and sharded by the name rules, so steady-state
+        # batches skip the per-batch table upload entirely
+        dom_dev = None
+        if self.mirror.mesh is not None:
+            dom_dev, _ = idx.term_table_device(
+                tuple(terms), self.mirror.mesh,
+                use_cache=self.topo_table_cache,
+                dom=dom, n_domains=n_domains)
         tpos = {tid: j for j, tid in enumerate(terms)}
         # per-pod [K] term-index lists (-1 padded): the kernel's cost per
         # scan step is O(K*N), independent of the batch's term union
@@ -978,7 +992,8 @@ class BatchScheduler:
         batch.set_topology_terms(
             dom, n_domains, to_arr(anti_l), to_arr(aff_l), to_arr(match_l),
             cmatch_tids=to_arr(cmatch_l) if dir2_read else None,
-            canti_tids=to_arr(canti_l) if dir2_read else None)
+            canti_tids=to_arr(canti_l) if dir2_read else None,
+            dom_dev=dom_dev)
         self._end_inscan_streak("term_cap", "kmax")
         return "installed"
 
@@ -1501,15 +1516,35 @@ class BatchScheduler:
             node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
+        sharded = False
         if gang_units is not None:
             from .kernels.gang import gang_schedule_batch
             assign_d, scores_d, new_usage = gang_schedule_batch(
                 node_cfg, usage, batch.device(self.mirror.mesh),
                 self._gang_device_table(gang_units, batch), nom_dev)
+        elif batch._class_tables is not None and nom_dev is None \
+                and sharding_mod.use_shard_map(self.mirror.mesh,
+                                               self.mirror.t.capacity):
+            # the sharded drain's hot path: per-shard filter+score with a
+            # cross-shard argmax (kernels/batch.py schedule_batch_sharded)
+            # — bit-identical decisions to the single-device class scan
+            from .kernels.batch import schedule_batch_sharded
+            sharded = True
+            if self.sched_metrics is not None:
+                self.sched_metrics.sharded_batches.inc()
+            assign_d, scores_d, new_usage = schedule_batch_sharded(
+                self.mirror.mesh, node_cfg, usage,
+                batch.device(self.mirror.mesh))
         else:
             assign_d, scores_d, new_usage = schedule_batch(
                 node_cfg, usage, batch.device(self.mirror.mesh), nom_dev)
+        if self.sched_metrics is not None and self.mirror.mesh is not None:
+            # padding added for shard divisibility is VISIBLE (KTPU005):
+            # the gauge tracks the mirror's current shard-pad rows
+            self.sched_metrics.mirror_shard_pad_rows.set(
+                self.mirror.shard_pad_rows)
         return PendingBatch(pods=pods, profiles=profiles, batch=batch,
+                            sharded=sharded,
                             packed=pack_results(assign_d, scores_d),
                             new_usage=new_usage,
                             residual_free=residual_free,
@@ -1529,7 +1564,12 @@ class BatchScheduler:
         t_sw = tr.now() if tr is not None else 0.0
         t0 = _time.perf_counter()
         assign, scores = unpack_results(pending.packed)
-        self.phase_stats["scan_wait_s"] += _time.perf_counter() - t0
+        fetch_wait = _time.perf_counter() - t0
+        self.phase_stats["scan_wait_s"] += fetch_wait
+        if pending.sharded and self.sched_metrics is not None:
+            # the fetch drains the cross-shard argmax pipeline: this is
+            # the wall time spent synchronizing the mesh for this batch
+            self.sched_metrics.shard_sync_seconds.observe(fetch_wait)
         if tr is not None:
             tr.record("scheduler", "scan_wait", t_sw, tr.now(),
                       pods=len(pending.pods))
@@ -1659,18 +1699,9 @@ class BatchScheduler:
         put = self.mirror.put_replicated
         out = {"pod_idx": put(pod_idx), "start": put(start),
                "end": put(end), "gang_id": put(gang_id),
-               "entry_dom_idx": put(entry_dom), "pin_dom": put(pin_dom)}
-        mesh = self.mirror.mesh
-        if mesh is None:
-            import jax.numpy as jnp
-            out["dom_tab"] = jnp.asarray(dom_tab)
-        else:
-            # node axis shards with the mirror, like the mask tables
-            import jax
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as PSpec
-            out["dom_tab"] = jax.device_put(
-                dom_tab, NamedSharding(mesh, PSpec(None, "nodes")))
+               "entry_dom_idx": put(entry_dom), "pin_dom": put(pin_dom),
+               # node axis shards with the mirror, by the name-keyed rule
+               "dom_tab": self.mirror.put_named("dom_tab", dom_tab)}
         return out
 
     def _nominated_device(self) -> Optional[dict]:

@@ -155,7 +155,11 @@ def _spread_score(cnt_g: jnp.ndarray, fits: jnp.ndarray,
     zs = zinit.at[zone_of].add(cf)
     z_idx = jnp.arange(zs.shape[0])
     maxz = jnp.max(jnp.where(z_idx > 0, zs, 0.0))
-    have_zones = jnp.any(fits & (zone_of > 0))
+    # f32 max, not jnp.any: a boolean reduce over the mesh-sharded node
+    # axis lowers to a pred all-reduce, which the CPU collective backend
+    # rejects (the pre-PR test_multichip XLA failures); the f32 form is
+    # semantically identical and reduces everywhere
+    have_zones = jnp.max(jnp.where(fits & (zone_of > 0), 1.0, 0.0)) > 0
     node_s = jnp.where(maxc > 0,
                        MAX_PRIORITY * (maxc - cnt_g) / jnp.maximum(maxc, 1.0),
                        MAX_PRIORITY)
@@ -387,6 +391,21 @@ def _topo_scatter(anti_dom, carry, pod, best, ok, has_dir2):
 _NEG_THRESHOLD = jnp.float32(-1e29)
 
 
+def _tie_penalized(masked, rows, seq):
+    """selectHost rotates among max-score ties across cycles (:286-296):
+    sub-integer hash penalty keyed on (node row, pod seq). Base scores
+    are integers spaced >= 1 and the penalty is < 0.5, so cross-class
+    ranking is intact. ONE copy for the classic, class-indexed, gang,
+    and sharded kernels — the hash is part of the DECISION, so it must
+    never diverge between them (the sharded kernel feeds GLOBAL row ids,
+    making its penalties match the single-device kernel bit for bit);
+    the host replicas (core._RepairReassigner, the gang oracle, bench's
+    parity oracle) mirror the same constants in int64+mask form."""
+    h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
+                        seq * jnp.int32(40503), 0xFFFF)
+    return masked - h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
+
+
 def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
     """The class-indexed incremental scan: pods sharing a (template,
     score-row) class share a precomputed masked-score ROW; a scan step
@@ -421,10 +440,8 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
             # kernel so the mask arithmetic can't diverge)
             masked = jnp.where(_topo_bad(anti_dom, carry, pod, has_dir2),
                                NEG, masked)
-        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
-                            pod["seq"] * jnp.int32(40503), 0xFFFF)
-        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
-        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
+            .astype(jnp.int32)
         chosen = masked[best]
         ok = (chosen > _NEG_THRESHOLD) & pod["active"]
         ok_f = jnp.where(ok, 1.0, 0.0)
@@ -558,14 +575,8 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         score = score + spread_w * use_spread * _spread_score(
             carry["spread"][gi], fits, zone_of, zinit)
         masked = jnp.where(fits, score, NEG)
-        # selectHost rotates among max-score ties across cycles (:286-296):
-        # sub-integer hash penalty keyed on (row, pod seq). Base scores are
-        # integers spaced >= 1 and the penalty is < 0.5, so cross-class
-        # ranking is intact.
-        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
-                            pod["seq"] * jnp.int32(40503), 0xFFFF)
-        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
-        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
+            .astype(jnp.int32)
         ok = fits[best] & pod["active"]
         onehot = (rows == best) & ok
         oh_f = onehot.astype(jnp.float32)
@@ -637,6 +648,170 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
             {"used": final["used"],
              "nonzero_used": final["nz_used"],
              "pod_count": final["pod_count"]})
+
+
+# ------------------------------------------------------------- sharded scan
+#
+# The class-indexed scan under jax.experimental.shard_map over a 1-D
+# "nodes" mesh axis (sharding.py owns the axis name and the name-keyed
+# partition rules). Each shard holds its node slice of the mirror
+# (cfg/usage rows), the mask/score tables' node columns, and the [C, N]
+# masked-score carry; a scan step runs filter+score over the LOCAL slice
+# and reduces to a winner with a cross-shard argmax over (penalized
+# score, global node id):
+#
+#     per shard:   local max + first-max row of (masked - tie_penalty)
+#     cross-shard: pmax(score)  -> the global max
+#                  pmin(row where local max == global max) -> the winner
+#
+# f32 max is exact and ties resolve to the LOWEST global row — precisely
+# jnp.argmax's first-max-index semantics on one device, so decisions are
+# bit-identical to _schedule_batch_classes (the parity-1.0 and chaos
+# determinism contracts survive sharding). The winner's column refresh
+# and usage scatter stay local to the owning shard (non-owners write
+# through an out-of-range index with mode="drop"); the winner's masked
+# score and its (anti-)affinity domain ids are broadcast from the owner
+# (re-deriving the score from the penalized max would re-round).
+#
+# GSPMD (plain jit over sharded inputs) remains the path for batch
+# shapes the class scan excludes — spread groups, soft credits,
+# nominated reservations, gangs — and for KTPU_SHARD_MAP=0 (the
+# pjit-vs-shard_map selection knob).
+
+_INT32_MAX = jnp.int32(2147483647)
+
+
+def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
+    """shard_map body: every node-axis array here is the LOCAL shard."""
+    from ..sharding import NODE_AXIS
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
+    Nl = node_cfg["alloc"].shape[0]
+    offset = lax.axis_index(NODE_AXIS).astype(jnp.int32) * Nl
+    rows_g = offset + jnp.arange(Nl, dtype=jnp.int32)
+    cls = {k: pod_batch[k] for k in ("class_req", "class_nz",
+                                     "class_blocked", "class_mask_idx",
+                                     "class_score_idx")}
+    anti_dom = pod_batch.get("anti_dom")
+    has_topo = anti_dom is not None
+    has_dir2 = has_topo and "cmatch_tids" in pod_batch
+    ms0 = _class_ms_init(node_cfg, usage, cls, unique_masks,
+                         unique_scores, rw)
+
+    def one_pod(carry, pod):
+        u = pod["class_idx"]
+        masked = carry["ms"][u]                                    # [Nl]
+        if has_topo:
+            masked = jnp.where(_topo_bad(anti_dom, carry, pod, has_dir2),
+                               NEG, masked)
+        # tie-break hash on the GLOBAL row id — identical inputs to the
+        # single-device kernel's (row, seq) penalty
+        penalized = _tie_penalized(masked, rows_g, pod["seq"])
+        lmax = jnp.max(penalized)
+        lbest = jnp.argmax(penalized).astype(jnp.int32)  # first max, local
+        gmax = lax.pmax(lmax, NODE_AXIS)
+        best = lax.pmin(jnp.where(lmax == gmax, offset + lbest, _INT32_MAX),
+                        NODE_AXIS)
+        lb = best - offset
+        owner = (lb >= 0) & (lb < Nl)
+        lbc = jnp.clip(lb, 0, Nl - 1)
+        chosen = lax.pmax(jnp.where(owner, masked[lbc], NEG), NODE_AXIS)
+        ok = (chosen > _NEG_THRESHOLD) & pod["active"]
+        ok_f = jnp.where(ok, 1.0, 0.0)
+        lb_w = jnp.where(owner, lb, Nl)      # out of range off-shard
+        used = carry["used"].at[lb_w].add(ok_f * cls["class_req"][u],
+                                          mode="drop")
+        nz_used = carry["nz_used"].at[lb_w].add(ok_f * cls["class_nz"][u],
+                                                mode="drop")
+        pod_count = carry["pod_count"].at[lb_w].add(ok_f, mode="drop")
+        # winner-column refresh, owner-local (non-owners compute a
+        # garbage column from the clamped row and drop the write)
+        col = _class_col(node_cfg, cls, unique_masks, unique_scores, rw,
+                         used[lbc], nz_used[lbc], pod_count[lbc], lbc)
+        out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
+               "ms": carry["ms"].at[:, lb_w].set(col, mode="drop")}
+        if has_topo:
+            out.update(_topo_scatter_sharded(anti_dom, carry, pod, lbc,
+                                             owner, ok, has_dir2))
+        assign = jnp.where(ok, best, jnp.int32(-1))
+        return out, (assign, chosen)
+
+    carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
+              "pod_count": usage["pod_count"], "ms": ms0}
+    if has_topo:
+        carry0["topo_cnt"] = pod_batch["anti_cnt0"]
+        carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
+        if has_dir2:
+            carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    P = per_pod["seq"].shape[0]
+    want = max(1, _STEP_GROUP)
+    G = min(1 << (want.bit_length() - 1), P)
+
+    def step(carry, podg):
+        outs = []
+        for g in range(G):
+            pod = {k: v[g] for k, v in podg.items()}
+            carry, out = one_pod(carry, pod)
+            outs.append(out)
+        return carry, (jnp.stack([o[0] for o in outs]),
+                       jnp.stack([o[1] for o in outs]))
+
+    per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
+                 for k, v in per_pod.items()}
+    final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
+    return (assign_g.reshape(P), scores_g.reshape(P),
+            {"used": final["used"],
+             "nonzero_used": final["nz_used"],
+             "pod_count": final["pod_count"]})
+
+
+def _topo_scatter_sharded(anti_dom, carry, pod, lbc, owner, ok, has_dir2):
+    """_topo_scatter under shard_map: the dom ids at the winner's column
+    live on the owning shard, so each table's [K] dom vector is broadcast
+    with one pmax (non-owners contribute -1, the 'no label' value, and
+    real dom ids are >= 0 — pmax recovers the owner's exact vector); the
+    replicated counters then apply the identical scatter-add on every
+    shard, keeping the carry in sync without further communication."""
+    from ..sharding import NODE_AXIS
+    mtids = pod["match_tids"]
+    mt = jnp.maximum(mtids, 0)
+    md = lax.pmax(jnp.where(owner, anti_dom[mt, lbc], jnp.int32(-1)),
+                  NODE_AXIS)
+    val = ((mtids >= 0) & (md >= 0) & ok).astype(jnp.float32)
+    out = {"topo_cnt": carry["topo_cnt"].at[
+               mt, jnp.maximum(md, 0)].add(val),
+           "topo_tot": carry["topo_tot"].at[mt].add(val)}
+    if has_dir2:
+        atids2 = pod["canti_tids"]
+        at2 = jnp.maximum(atids2, 0)
+        ad = lax.pmax(jnp.where(owner, anti_dom[at2, lbc], jnp.int32(-1)),
+                      NODE_AXIS)
+        aval = ((atids2 >= 0) & (ad >= 0) & ok).astype(jnp.float32)
+        out["topo_carry"] = carry["topo_carry"].at[
+            at2, jnp.maximum(ad, 0)].add(aval)
+    return out
+
+
+@partial(jax.jit, static_argnums=(0,))
+def schedule_batch_sharded(mesh, node_cfg: dict, usage: dict,
+                           pod_batch: dict):
+    """schedule_batch for class-table batches on a 1-D "nodes" mesh:
+    the shard-mapped scan above, with every input placed by the
+    name-keyed partition rules (sharding.spec_for). Same returns as
+    schedule_batch; decisions bit-identical (tier-1 CPU-sharded smoke +
+    the bench's sharded parity fixtures pin this)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..sharding import NODE_AXIS, spec_for
+    cfg_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in node_cfg.items()}
+    usage_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in usage.items()}
+    batch_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in pod_batch.items()}
+    out_specs = (P(), P(), {"used": P(NODE_AXIS, None),
+                            "nonzero_used": P(NODE_AXIS, None),
+                            "pod_count": P(NODE_AXIS)})
+    fn = shard_map(_sharded_class_scan, mesh=mesh,
+                   in_specs=(cfg_specs, usage_specs, batch_specs),
+                   out_specs=out_specs, check_rep=False)
+    return fn(node_cfg, usage, pod_batch)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

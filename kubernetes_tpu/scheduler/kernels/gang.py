@@ -63,7 +63,7 @@ import numpy as np
 from jax import lax
 
 from .batch import (COL_CPU, COL_MEM, NEG, _pod_feasible, _pod_score,
-                    _split_batch)
+                    _split_batch, _tie_penalized)
 
 #: entries per scan step (unrolled inside, same op sequence — see
 #: batch.py's step grouping); must divide the bucketed T (a power of two)
@@ -148,10 +148,8 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         score = _pod_score(node_cfg, trial["nonzero_used"], pod, static, rw)
         masked = jnp.where(fits, score, NEG)
         # identical tie-break to schedule_batch (selectHost rotation)
-        h = jnp.bitwise_and(rows * jnp.int32(-1640531527) +
-                            pod["seq"] * jnp.int32(40503), 0xFFFF)
-        tie_penalty = h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
-        best = jnp.argmax(masked - tie_penalty).astype(jnp.int32)
+        best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
+            .astype(jnp.int32)
         ok = fits[best] & pod["active"] & valid
         oh_f = ((rows == best) & ok).astype(jnp.float32)
         trial = {

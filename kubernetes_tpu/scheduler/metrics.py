@@ -110,6 +110,26 @@ class SchedulerMetrics:
         self.capped_scans = r.counter(
             "scheduler_capped_scans_total",
             "Scans truncated at a documented cap, by cap name")
+        # ---- sharded drain (mesh execution substrate) ----
+        # batches routed through the shard_map kernel (per-shard
+        # filter+score, cross-shard argmax) vs the GSPMD/single paths
+        self.sharded_batches = r.counter(
+            "scheduler_sharded_batches_total",
+            "Batches scheduled by the shard-mapped class scan")
+        # wall time the fetch spent draining the cross-shard argmax
+        # pipeline for a sharded batch (mesh synchronization cost)
+        self.shard_sync_seconds = r.histogram(
+            "scheduler_shard_sync_seconds",
+            "Mesh-synchronization wait fetching a sharded batch's packed "
+            "results",
+            buckets=SCHEDULING_LATENCY_BUCKETS)
+        # mirror rows added purely for shard divisibility (TensorMirror
+        # pads the node capacity to a multiple of the mesh's shard count;
+        # pad rows are valid=False and excluded from every decision) —
+        # padding is visible, never a silent cap
+        self.mirror_shard_pad_rows = r.gauge(
+            "scheduler_mirror_shard_pad_rows",
+            "Node-mirror rows added to make the capacity shard-divisible")
 
     def observe_queue(self, queue) -> None:
         """Sample the three sub-queue depths (PendingPods gauges)."""

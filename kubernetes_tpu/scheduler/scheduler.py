@@ -73,6 +73,13 @@ class Scheduler:
         self.scheduler_name = scheduler_name
         self.batch_size = batch_size
         self.clock = clock
+        # the mesh is the drain's execution substrate: a Mesh passes
+        # through, "auto"/n build a 1-D "nodes" mesh over local devices,
+        # and None consults KTPU_MESH — so `KTPU_MESH=auto` flips the
+        # production drain onto the device mesh with no code change
+        from .sharding import resolve_mesh
+        mesh = resolve_mesh(mesh)
+        self.mesh = mesh
         self.disable_preemption = disable_preemption
         #: Reserve/Prebind plugin runner (ref: framework/v1alpha1)
         self.framework = framework or Framework()

@@ -135,14 +135,25 @@ class TensorMirror:
 
     def __init__(self, vocab: Optional[ResourceVocab] = None,
                  min_capacity: int = 128, mesh=None):
+        from . import sharding
         #: jax.sharding.Mesh with a "nodes" axis, or None (single device).
-        #: With a mesh, every [N]/[N,C] tensor is placed
-        #: NamedSharding(P("nodes")) so the kernels' node axis rides ICI
-        #: (the scaling-book recipe: annotate shardings, let XLA insert
-        #: the collectives); pod batches stay replicated.
+        #: With a mesh, every tensor is placed by the name-keyed partition
+        #: rules (sharding.spec_for) so the kernels' node axis rides ICI
+        #: (the scaling-book recipe: annotate shardings, let the runtime
+        #: insert the collectives); pod batches stay replicated.
         self.mesh = mesh
+        #: shards on the node axis; the row capacity is always a multiple
+        #: so per-shard slices are equal (shard_map requires it, and a
+        #: ragged GSPMD pad would silently skew the argmax row space)
+        self._shards = sharding.n_shards(mesh)
+        #: rows the current capacity carries ONLY for shard divisibility
+        #: (beyond the power-of-two bucket); surfaced as the
+        #: scheduler_mirror_shard_pad_rows gauge — padding is visible,
+        #: never a silent cap
+        self.shard_pad_rows = 0
         self.vocab = vocab or ResourceVocab()
-        self.t = NodeTensors(_bucket(1, min_capacity), self.vocab.n_cols)
+        self.t = NodeTensors(self._capacity_for(1, min_capacity),
+                             self.vocab.n_cols)
         self.row_of: Dict[str, int] = {}
         self.name_of: Dict[int, str] = {}
         self._free: List[int] = list(range(self.t.capacity))
@@ -162,6 +173,17 @@ class TensorMirror:
         self.usage_epoch = 0
         self._usage_lock = threading.Lock()
 
+    def _capacity_for(self, need: int, minimum: int = 128) -> int:
+        """Row capacity for `need` nodes: the power-of-two bucket, padded
+        up to a multiple of the mesh's shard count. Pad rows (valid=False,
+        excluded from every kernel decision) are counted in
+        shard_pad_rows, not silently absorbed."""
+        from .sharding import shard_divisible
+        bucket = _bucket(need, minimum)
+        cap = shard_divisible(bucket, self._shards)
+        self.shard_pad_rows = cap - bucket
+        return cap
+
     # ------------------------------------------------------------ updates
 
     def apply(self, snapshot: Snapshot, dirty_names: Sequence[str]) -> None:
@@ -171,7 +193,7 @@ class TensorMirror:
         self.epoch += 1
         need = len(snapshot.node_infos)
         if need > self.t.capacity:
-            self._grow(_bucket(need))
+            self._grow(self._capacity_for(need))
         for name in dirty_names:
             ni = snapshot.node_infos.get(name)
             if ni is None or ni.node is None:
@@ -303,9 +325,16 @@ class TensorMirror:
 
     # ------------------------------------------------------------- device
 
+    def put_named(self, name: str, arr):
+        """Host array -> device, placed by the name-keyed partition rules
+        (sharding.spec_for) — plain transfer when no mesh is active."""
+        from .sharding import put
+        return put(self.mesh, name, arr)
+
     def put_nodes(self, arr):
         """Host array -> device, sharded over the mesh's node axis (or a
-        plain transfer single-device)."""
+        plain transfer single-device). For tensors whose NAME carries the
+        rule, prefer put_named."""
         import jax
         import jax.numpy as jnp
         if self.mesh is None:
@@ -330,9 +359,9 @@ class TensorMirror:
         t = self.t
         if self._device_cfg is None or self._device_usage is None:
             # resize or invalidate_usage: both re-uploaded from host truth
-            self._device_cfg = {k: self.put_nodes(v)
+            self._device_cfg = {k: self.put_named(k, v)
                                 for k, v in t.cfg_arrays().items()}
-            self._device_usage = {k: self.put_nodes(v)
+            self._device_usage = {k: self.put_named(k, v)
                                   for k, v in t.usage_arrays().items()}
         elif self._dirty_rows:
             from .kernels.batch import apply_dirty
@@ -686,6 +715,10 @@ class PodBatchTensors:
         # in-scan required (anti-)affinity term tables
         # (core._assign_topology_terms)
         self.anti_dom: Optional[np.ndarray] = None      # [T, N] int32
+        #: epoch-cached DEVICE copy of the padded anti_dom table (sharded
+        #: by the name rules) — set under a mesh so repeat batches skip
+        #: the [T, N] upload entirely
+        self.anti_dom_dev = None
         self.anti_cnt0: Optional[np.ndarray] = None     # [T, D] f32 zeros
         self.anti_tids: Optional[np.ndarray] = None     # [P, K] int32 (-1 pad)
         self.aff_tids: Optional[np.ndarray] = None      # [P, K] int32
@@ -709,18 +742,24 @@ class PodBatchTensors:
                            anti_tids: np.ndarray, aff_tids: np.ndarray,
                            match_tids: np.ndarray,
                            cmatch_tids: Optional[np.ndarray] = None,
-                           canti_tids: Optional[np.ndarray] = None) -> None:
+                           canti_tids: Optional[np.ndarray] = None,
+                           dom_dev=None) -> None:
         """Install in-scan term tables; T, D, and the per-pod K axis all
         bucketed to powers of two (padded term rows carry dom=-1
         everywhere: never conflict, never bump) so consecutive batches
         with drifting term fan-outs share one compiled kernel instead of
         recompiling per batch. The per-pod [K]-term lists keep the scan
-        O(K*N) per step."""
+        O(K*N) per step. `dom_dev` is an already-padded, already-sharded
+        DEVICE copy of the same table (TopologyIndex.term_table_device's
+        epoch cache); its T bucketing matches this method's."""
         T = _bucket(dom.shape[0], minimum=8)
         P = self.req.shape[0]
         dom_p = np.full((T, dom.shape[1]), -1, np.int32)
         dom_p[:dom.shape[0]] = dom
         self.anti_dom = dom_p
+        assert dom_dev is None or tuple(dom_dev.shape) == dom_p.shape, \
+            "device dom table bucketing diverged from the host table"
+        self.anti_dom_dev = dom_dev
         self.anti_cnt0 = np.zeros((T, _bucket(max(n_domains, 1),
                                               minimum=64)), np.float32)
         K = _bucket(max(anti_tids.shape[1], aff_tids.shape[1],
@@ -867,21 +906,25 @@ class PodBatchTensors:
 
     def device(self, mesh=None) -> dict:
         import jax.numpy as jnp
+        from . import sharding
         if mesh is None:
-            put = mask_put = jnp.asarray
+            put = jnp.asarray
+
+            def mask_put(name, a):
+                return jnp.asarray(a)
         else:
             # pod axes replicate; the mask/score tables' NODE axis shards
-            # with the mirror (each core sees every pod, owns a node shard)
+            # with the mirror (each core sees every pod, owns a node
+            # shard) — both resolved by the name-keyed rule table
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
             repl = NamedSharding(mesh, P())
-            by_node = NamedSharding(mesh, P(None, "nodes"))
 
             def put(a):
                 return jax.device_put(np.asarray(a), repl)
 
-            def mask_put(a):
-                return jax.device_put(np.asarray(a), by_node)
+            def mask_put(name, a):
+                return sharding.put(mesh, name, a)
         out = {"req": put(self.req),
                "nonzero_req": put(self.nonzero_req),
                "mem_pressure_blocked": put(self.mem_pressure_blocked),
@@ -890,19 +933,24 @@ class PodBatchTensors:
                "mask_idx": put(self.mask_idx),
                "score_idx": put(self.score_idx),
                "nom_row": put(self.nom_row),
-               "unique_masks": mask_put(self.unique_masks),
-               "unique_scores": mask_put(self.unique_scores),
+               "unique_masks": mask_put("unique_masks", self.unique_masks),
+               "unique_scores": mask_put("unique_scores",
+                                         self.unique_scores),
                "resource_weights": put(self.resource_weights)}
         if self.spread_base is not None:
             import jax.numpy as jnp
             out["spread_gidx"] = put(self.spread_gidx)
             out["spread_match"] = put(self.spread_match)
-            out["spread_base"] = mask_put(self.spread_base)
+            out["spread_base"] = mask_put("spread_base", self.spread_base)
             out["spread_zone"] = put(self.spread_zone)
             out["spread_zinit"] = put(self.spread_zinit)
             out["spread_weight"] = jnp.float32(self.spread_weight)
         if self.anti_dom is not None:
-            out["anti_dom"] = mask_put(self.anti_dom)
+            # the dom table may already sit on device, epoch-cached and
+            # sharded by the topology index (set_topology_terms dom_dev)
+            out["anti_dom"] = self.anti_dom_dev \
+                if self.anti_dom_dev is not None \
+                else mask_put("anti_dom", self.anti_dom)
             out["anti_cnt0"] = put(self.anti_cnt0)
             out["anti_tids"] = put(self.anti_tids)
             out["aff_tids"] = put(self.aff_tids)
@@ -912,9 +960,9 @@ class PodBatchTensors:
                 out["canti_tids"] = put(self.canti_tids)
         if self.soft_dom is not None:
             import jax.numpy as jnp
-            out["soft_dom"] = mask_put(self.soft_dom)
+            out["soft_dom"] = mask_put("soft_dom", self.soft_dom)
             out["soft_cnt0"] = put(self.soft_cnt0)
-            out["soft_base"] = mask_put(self.soft_base)
+            out["soft_base"] = mask_put("soft_base", self.soft_base)
             out["soft_base_idx"] = put(self.soft_base_idx)
             out["soft_read_tids"] = put(self.soft_read_tids)
             out["soft_read_w"] = put(self.soft_read_w)

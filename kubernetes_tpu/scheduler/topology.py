@@ -139,6 +139,14 @@ class TopologyIndex:
         self._table_cache: Dict[Tuple, Tuple[int, int, np.ndarray, int]] = {}
         self.table_builds = 0
         self.table_hits = 0
+        #: (term-id tuple, padded T) -> (dom_epoch, capacity, device
+        #: table sharded by the name rules, n_doms) — the sharded drain's
+        #: upload cache: repeat batches over a stable node topology reuse
+        #: ONE device-resident [T, N] table instead of re-uploading per
+        #: batch (term_table_device)
+        self._table_dev_cache: Dict[Tuple, Tuple[int, int, object, int]] = {}
+        self.table_dev_builds = 0
+        self.table_dev_hits = 0
         self._vec_cache: Dict[Tuple, np.ndarray] = {}
         self._vec_cache_version = -1
         # (namespace, labels-canon) -> frozenset of matching tids; pods
@@ -546,6 +554,44 @@ class TopologyIndex:
                 self._table_cache.clear()
             self._table_cache[terms] = (self.dom_epoch, cap, dom, n_domains)
         return dom, n_domains
+
+    def term_table_device(self, terms: Tuple[int, ...], mesh,
+                          use_cache: bool = True, dom=None,
+                          n_domains: Optional[int] = None):
+        """(padded [T, capacity] dom table ON DEVICE sharded by the
+        name-keyed rules, n_domains) — the device half of term_table for
+        the sharded drain. T is bucketed exactly like
+        PodBatchTensors.set_topology_terms (power of two, min 8) so the
+        cached upload can be handed to it as dom_dev. Epoch-cached with
+        the same (dom_epoch, capacity) key as the host table: steady
+        pod churn re-uses one device-resident table across every batch
+        of a drain; only a node-topology change re-uploads. A caller
+        that already built the host table passes (dom, n_domains) so a
+        cache-disabled run (KTPU_TOPO_TABLE_CACHE=0) does not build it
+        twice."""
+        from .sharding import put
+        from .tensorize import _bucket
+        cap = self.mirror.t.capacity
+        T = _bucket(len(terms), minimum=8)
+        key = (terms, T)
+        if use_cache:
+            hit = self._table_dev_cache.get(key)
+            if hit is not None and hit[0] == self.dom_epoch \
+                    and hit[1] == cap:
+                self.table_dev_hits += 1
+                return hit[2], hit[3]
+        if dom is None or n_domains is None:
+            dom, n_domains = self.term_table(terms, use_cache=use_cache)
+        dom_p = np.full((T, cap), -1, np.int32)
+        dom_p[:dom.shape[0]] = dom
+        dev = put(mesh, "anti_dom", dom_p)
+        self.table_dev_builds += 1
+        if use_cache:
+            if len(self._table_dev_cache) > 64:
+                self._table_dev_cache.clear()
+            self._table_dev_cache[key] = (self.dom_epoch, cap, dev,
+                                          n_domains)
+        return dev, n_domains
 
     def node_domain_vector(self, tk: str) -> np.ndarray:
         """[capacity] int32 node-row -> topology-domain id for `tk` (-1

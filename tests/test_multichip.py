@@ -120,7 +120,7 @@ def test_full_drain_on_mesh_matches_single_device():
                  for p in client.pods().list()}
         return n, binds
 
-    n_single, single = run(None)
+    n_single, single = run(1)   # explicit single-device (KTPU_MESH-immune)
     mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
     with mesh:
         n_mesh, mesh_binds = run(mesh)
@@ -223,7 +223,7 @@ def test_full_drain_on_2d_mesh_matches_single_device():
         return n, {p.metadata.name: p.spec.node_name
                    for p in client.pods().list()}
 
-    n_single, single = run(None)
+    n_single, single = run(1)   # explicit single-device (KTPU_MESH-immune)
     mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
                 ("pods", "nodes"))
     with mesh:
