@@ -1,6 +1,6 @@
 # Convenience entries (the reference's hack/ equivalents).
 
-.PHONY: lint lint-changed test test-tier1 bench-sharded
+.PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -19,3 +19,10 @@ test:
 bench-sharded:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python bench.py sharded
+
+# affinity-shape bench: class-scan vs classic (KTPU_CLASS_SCAN=0) across
+# node/pod/anti/spread/soft/nominated fixtures + sharded parity points
+# for the three newly folded shapes (BENCH_r08's source)
+bench-affinity:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python bench.py affinity

@@ -103,6 +103,20 @@ def make_pod(i, variant="uniform"):
                     label_selector=api.LabelSelector(
                         match_labels={"color": f"c{i % 100}"}),
                     topology_key=api.wellknown.LABEL_HOSTNAME)]))
+    elif variant == "preferred-affinity":
+        # soft-heavy: preferred inter-pod anti-affinity on a 16-color
+        # group label — the in-scan credit-channel workload (the batch
+        # shape that used to disable the class route)
+        pod.metadata.labels["grp"] = f"g{i % 16}"
+        pod.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    api.WeightedPodAffinityTerm(
+                        weight=10,
+                        pod_affinity_term=api.PodAffinityTerm(
+                            label_selector=api.LabelSelector(
+                                match_labels={"grp": f"g{i % 16}"}),
+                            topology_key=api.wellknown.LABEL_HOSTNAME))]))
     elif variant == "taints":
         # two thirds tolerate the dedicated taint; one third is confined
         # to the untainted half
@@ -111,6 +125,30 @@ def make_pod(i, variant="uniform"):
                 key="dedicated", operator="Equal", value="gpu",
                 effect="NoSchedule")]
     return pod
+
+
+def _install_variant_extras(client, sched, variant, n_nodes):
+    """Post-construction wiring for the spread-heavy and nominated-heavy
+    variants (shared by run_config and the sharded parity harness).
+
+    spread: a Service selecting every bench pod, handed to the scorer as
+    a direct lister (the informer wiring is measure_parity's job; the
+    throughput configs feed the cache directly). nominated: phantom
+    preemptor reservations on a quarter of the nodes — the kernel's
+    phantom-usage overlay is live for every batch."""
+    if variant == "spread":
+        from kubernetes_tpu.scheduler import priorities as prios_mod
+        svc = api.Service(
+            metadata=api.ObjectMeta(name="bench", namespace="default"),
+            spec=api.ServiceSpec(selector={"app": "bench"}))
+        client.services().create(svc)
+        sched.algorithm.scorer.listers = prios_mod.SpreadListers(
+            services=lambda ns: [svc])
+    elif variant == "nominated":
+        for i in range(0, n_nodes, 4):
+            ghost = make_pod(4_000_000 + i, "uniform")
+            ghost.metadata.name = f"ghost-{i}"
+            sched.queue.nominated.add(ghost, f"node-{i}")
 
 
 def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
@@ -133,6 +171,7 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
     b = batch or BATCH
     sched = Scheduler(client, batch_size=b, mesh=mesh)
     t_setup = time.time()
+    _install_variant_extras(client, sched, variant, n_nodes)
     for i in range(n_nodes):
         node = make_node(i)
         client.nodes().create(node)
@@ -191,6 +230,7 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
     algo.reset_phase_stats()
     topo = algo.topology
     tb0, th0 = topo.table_builds, topo.table_hits
+    mb0, mh0 = topo.mask_row_builds, topo.mask_row_hits
     fb0 = {r: sched.metrics.topo_inscan_fallbacks.value(reason=r)
            for r in ("term_cap", "kmax", "soft_terms", "soft_kmax",
                      "soft_gang")}
@@ -205,6 +245,10 @@ def run_config(n_nodes, n_pods, variant, batch=None, seed_pods=0,
         "repair_reassign_s": round(ps["repair_s"], 4),
         "table_builds": topo.table_builds - tb0,
         "table_hits": topo.table_hits - th0,
+        # the incremental [U, N] affinity-mask maintenance (ISSUE 14):
+        # builds ~ O(presence changes), hits ~ O(batches)
+        "mask_row_builds": topo.mask_row_builds - mb0,
+        "mask_row_hits": topo.mask_row_hits - mh0,
         "profile_builds": ps["profile_builds"],
         "profile_hits": ps["profile_hits"],
         "inscan_fallbacks": {
@@ -1063,6 +1107,7 @@ def measure_sharded_parity(variant, n_pods, n_nodes, shards=8):
     def run(mesh):
         client = Client(validate=False)
         sched = Scheduler(client, batch_size=4096, mesh=mesh)
+        _install_variant_extras(client, sched, variant, n_nodes)
         for i in range(n_nodes):
             node = make_node(i, variant)
             client.nodes().create(node)
@@ -1427,6 +1472,115 @@ def trace_main():
     }))
 
 
+#: `bench.py affinity` variants: the classic trio plus the three batch
+#: shapes ISSUE 14 folded into the class-indexed scan (spread groups,
+#: soft credit channels, nominated reservations)
+AFFINITY_MAIN_VARIANTS = ("node-affinity", "pod-affinity",
+                          "pod-anti-affinity", "spread",
+                          "preferred-affinity", "nominated")
+#: the new shapes also get a sharded parity+rate point (the shard_map
+#: kernel is the only kernel now — prove it off the classic trio too)
+AFFINITY_SHARDED_VARIANTS = ("spread", "preferred-affinity", "nominated")
+
+
+AFF_RUNS = int(os.environ.get("BENCH_AFF_RUNS", "3"))
+
+
+def _affinity_point(variant, classic=False):
+    """One (variant, kernel-path) measurement at the affinity shape:
+    best of BENCH_AFF_RUNS fills (single fills at this small shape swing
+    ±20% run to run on the shared container). `classic=True` pins
+    KTPU_CLASS_SCAN=0 — the pre-fold baseline."""
+    import gc
+    prev = os.environ.get("KTPU_CLASS_SCAN")
+    # BOTH legs pin the knob (not just the classic one): an exported
+    # KTPU_CLASS_SCAN=0 must not silently turn this into classic-vs-classic
+    os.environ["KTPU_CLASS_SCAN"] = "0" if classic else "1"
+    try:
+        seed = AFF_NODES if variant == "pod-affinity" else 0
+        best = None
+        for _ in range(max(1, AFF_RUNS)):
+            r, n_sched, sched_v, _, _ = run_config(
+                AFF_NODES, AFF_PODS, variant, seed_pods=seed)
+            phases = getattr(sched_v, "bench_phases", None)
+            del sched_v
+            gc.collect()
+            if best is None or r > best[0]:
+                best = (r, n_sched, phases)
+        return round(best[0], 1), best[1], best[2]
+    finally:
+        if prev is None:
+            os.environ.pop("KTPU_CLASS_SCAN", None)
+        else:
+            os.environ["KTPU_CLASS_SCAN"] = prev
+
+
+def affinity_main():
+    """`bench.py affinity` — every affinity-shaped fixture measured
+    class-scan vs classic (the before/after of folding spread, soft
+    credits, and nominated reservations into the class-indexed kernel),
+    plus sharded parity+rate points for the three new shapes. The
+    headline value is the MINIMUM class-vs-classic speedup across the
+    three newly folded shapes (the ISSUE 14 acceptance reads >= 2x at
+    the 2k x 1k shape)."""
+    import gc
+
+    def scan_rate(n, phases):
+        """Kernel-side pods/s (scheduled / device scan wait): the
+        end-to-end drain is commit/bind-bound on a small host, so the
+        kernel's own speedup is reported separately."""
+        w = (phases or {}).get("device_scan_wait_s") or 0
+        return round(n / w, 1) if w else None
+
+    detail = {}
+    for variant in AFFINITY_MAIN_VARIANTS:
+        fast, n_fast, phases = _affinity_point(variant)
+        classic, n_classic, phases_c = _affinity_point(variant,
+                                                       classic=True)
+        ksr = scan_rate(n_fast, phases)
+        ksr_c = scan_rate(n_classic, phases_c)
+        detail[variant] = {
+            "class_scan_pods_per_sec": fast,
+            "classic_pods_per_sec": classic,
+            "speedup": round(fast / classic, 2) if classic else None,
+            "scan_only_class_pods_per_sec": ksr,
+            "scan_only_classic_pods_per_sec": ksr_c,
+            "scan_only_speedup": (round(ksr / ksr_c, 2)
+                                  if ksr and ksr_c else None),
+            "scheduled": n_fast,
+            "scheduled_classic": n_classic,
+            "phases": phases,
+        }
+        gc.collect()
+    sharded = {}
+    for variant in AFFINITY_SHARDED_VARIANTS:
+        p = measure_sharded_parity(variant, SHARD_PARITY_PODS,
+                                   SHARD_PARITY_NODES)
+        if p is not None:
+            sharded[variant] = p
+        gc.collect()
+    new_shapes = ("spread", "preferred-affinity", "nominated")
+    speedups = [detail[v]["speedup"] for v in new_shapes
+                if detail[v]["speedup"] is not None]
+    sharded_parity_min = min((p["rate"] for p in sharded.values()),
+                             default=None)
+    print(json.dumps({
+        "metric": "affinity class-scan vs classic speedup, min over "
+                  f"spread/soft/nominated ({AFF_PODS} pods x "
+                  f"{AFF_NODES} nodes)",
+        "value": min(speedups) if speedups else 0.0,
+        "unit": "x",
+        "detail": {"nodes": AFF_NODES, "pods": AFF_PODS,
+                   "variants": detail,
+                   "sharded": sharded,
+                   "sharded_parity_min": sharded_parity_min,
+                   "kernel_note": "classic = KTPU_CLASS_SCAN=0 (the "
+                                  "pre-ISSUE-14 routing for these "
+                                  "shapes); decisions are bit-identical "
+                                  "between the two paths"},
+    }))
+
+
 def serving_main():
     """`bench.py serving` — just the churn section: the p50/p95/p99
     pod-startup-latency-vs-arrival-rate curve on the wire config."""
@@ -1447,6 +1601,8 @@ if __name__ == "__main__":
         serving_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "sharded":
         sharded_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "affinity":
+        affinity_main()
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

@@ -121,6 +121,12 @@ class PendingBatch:
     #: filter+score, cross-shard argmax) — schedule_finish attributes its
     #: fetch wait to scheduler_shard_sync_seconds
     sharded: bool = False
+    #: structural signatures of the in-scan spread / soft tables (None
+    #: when absent): a successor may chain THROUGH this batch's carried
+    #: counts only when its own tables resolve to the same structure —
+    #: see schedule_launch's carry-chaining gate
+    spread_sig: Optional[Tuple] = None
+    soft_sig: Optional[Tuple] = None
 
 
 class _RepairReassigner:
@@ -326,6 +332,13 @@ class BatchScheduler:
         #: profile caches (the tier-1 cached==uncached smoke's control)
         self.topo_table_cache = _os.environ.get(
             "KTPU_TOPO_TABLE_CACHE", "1") != "0"
+        #: KTPU_CLASS_SCAN=0 pins non-gang batches to the classic per-pod
+        #: kernel — the parity control for the class-indexed fast path
+        #: (bench.py affinity measures class-scan vs classic with it)
+        self.class_scan = _os.environ.get("KTPU_CLASS_SCAN", "1") != "0"
+        #: launches that actually chained on a predecessor's device usage
+        #: (tests pin that spread/soft batches keep chaining)
+        self.chained_launches = 0
         #: residual-sig -> (profile_epoch, AffinityProfile): template
         #: profile resolution survives across batches until a profile-
         #: relevant topology change (new term, zero-crossing count)
@@ -657,10 +670,11 @@ class BatchScheduler:
         batch.set_static_scores(
             np.arange(len(pods), dtype=np.int32), base + ext)
 
-    #: max batch size for pods whose soft scores drift in-batch;
-    #: env-tunable. SelectorSpread is handled IN-SCAN by the kernel
-    #: (running group counts), so only preferred inter-pod (anti-)affinity
-    #: — whose topology credits still freeze at batch start — sub-chunks.
+    #: max batch size for pods whose soft scores would drift in-batch;
+    #: env-tunable. SelectorSpread and preferred inter-pod (anti-)affinity
+    #: both run IN-SCAN (running group counts / credit accumulators, on
+    #: every kernel incl. the gang kernel's trial carry), so sub-chunking
+    #: engages only when a batch OVERFLOWS the in-scan caps.
     SOFT_SCORE_CHUNK = 256
 
     def topo_scan_likely(self, pods: List[Pod]) -> bool:
@@ -704,16 +718,17 @@ class BatchScheduler:
                         .preferred_during_scheduling_ignored_during_execution))
                 for p in pods)
             if has_pref:
-                if self.gang is not None:
-                    from .gang import pod_group_key
-                    if any(pod_group_key(p) is not None for p in pods):
-                        # gang batches route the all-or-nothing kernel,
-                        # which runs frozen (batch-start) soft rows — keep
-                        # the pre-table chunking so credits refresh
-                        # between sub-batches, and keep it visible
-                        self._count_inscan_fallback("soft_gang")
-                        return chunk
                 if self._soft_plan_cached(pods) is None:
+                    # channel-union overflow: sub-chunk so frozen credits
+                    # refresh between launches. Gang batches used to chunk
+                    # UNCONDITIONALLY here (soft_gang); the gang kernel's
+                    # trial/committed soft accumulators lifted that, so
+                    # the counter now marks only gang batches that STILL
+                    # overflow the in-scan caps — wired, not silent
+                    if self.gang is not None:
+                        from .gang import pod_group_key
+                        if any(pod_group_key(p) is not None for p in pods):
+                            self._count_inscan_fallback("soft_gang")
                     return chunk
         # spread carriers beyond the in-scan group cap would otherwise run
         # the whole batch on frozen counts — chunk so they refresh
@@ -740,19 +755,26 @@ class BatchScheduler:
     SPREAD_GROUP_CAP = 7
 
     def _assign_spread_groups(self, pods: List[Pod],
-                              batch: PodBatchTensors) -> bool:
+                              batch: PodBatchTensors) -> Optional[Tuple]:
         """Group pods by (namespace, labels) whose selectors make them
         spread carriers; install per-group base counts + zone ids so the
         kernel scores SelectorSpread from RUNNING counts (the serial
-        semantics — selector_spreading.go:277 re-counts per pod)."""
+        semantics — selector_spreading.go:277 re-counts per pod).
+
+        Returns the batch's spread chain SIGNATURE (ordered group
+        template keys + everything the carried [G, N] counts' meaning
+        depends on), or None when no spread tables ride. Two batches
+        with equal signatures name group g identically, so a chained
+        launch may seed its count carry from the predecessor's finals."""
         listers = self.scorer.listers
         weight = self.scorer.weights.get("SelectorSpreadPriority", 0)
         if listers is None or not weight:
-            return False
+            return None
         from . import priorities as prios
         self.scorer._refresh_epoch()
         base_rows: List[np.ndarray] = []
         group_sel: List[Tuple[str, list]] = []   # (namespace, selectors)
+        group_keys: List[Tuple] = []             # (ns, labels) per group
         memo: Dict[Tuple, Optional[int]] = {}
         for i, pod in enumerate(pods):
             key = (pod.metadata.namespace,
@@ -769,11 +791,26 @@ class BatchScheduler:
                         base_rows.append(np.asarray(counts, np.float32))
                         group_sel.append((pod.metadata.namespace,
                                           meta.pod_selectors))
+                        group_keys.append(key)
                 memo[key] = g
             if g is not None:
                 batch.spread_gidx[i] = g
         if not base_rows:
-            return False
+            return None
+        # canonical group order: slot g is sorted-template-key order, not
+        # first-pod order — batches popping the same templates in a
+        # rotated pod order land on the SAME signature, so the chained
+        # count carry stays consumable. Pure renumbering: every per-group
+        # structure below permutes consistently, decisions are invariant
+        order = sorted(range(len(base_rows)), key=lambda g: group_keys[g])
+        remap = {old: new for new, old in enumerate(order)}
+        base_rows = [base_rows[g] for g in order]
+        group_sel = [group_sel[g] for g in order]
+        group_keys = [group_keys[g] for g in order]
+        gidx = batch.spread_gidx
+        for i in range(len(pods)):
+            if gidx[i] >= 0:
+                gidx[i] = remap[int(gidx[i])]
         # cross-group match matrix: a winner must bump every group whose
         # selectors match its labels, not only its own (ns, labels) group
         G = len(base_rows)
@@ -793,7 +830,9 @@ class BatchScheduler:
             match[i] = row
         batch.set_spread(np.stack(base_rows), self.scorer._zone_ids,
                          self.scorer._n_zones, float(weight), match=match)
-        return True
+        return (tuple(group_keys), self.scorer._n_zones, float(weight),
+                self.mirror.epoch, self.scorer.spread_sel_gen,
+                self.mirror.t.capacity)
 
     #: in-scan topology term cap per batch; bigger batches fall back to
     #: the repair overlay + reassignment path entirely
@@ -1099,6 +1138,20 @@ class BatchScheduler:
             return None
         if not chan_list:
             return None  # no in-batch credit can move: static rows suffice
+        # canonical template order (repr: residual sigs mix None/str/tuple
+        # and are not directly comparable) — like the channel sort below,
+        # this keeps rotated-pod-order batches on one chain signature
+        # (soft_base row r must mean the same template batch to batch).
+        # Pure renumbering; per-template structures permute consistently
+        tkeys = list(tmpl_key)
+        torder = sorted(range(len(tmpl_pods)),
+                        key=lambda t: repr(tkeys[t]))
+        tremap = {old: new for new, old in enumerate(torder)}
+        tmpl_pods = [tmpl_pods[t] for t in torder]
+        tmpl_pref = [tmpl_pref[t] for t in torder]
+        tmpl_carry = [tmpl_carry[t] for t in torder]
+        tmpl_of = np.asarray([tremap[int(t)] for t in tmpl_of], np.int32)
+        tkeys = [tkeys[t] for t in torder]
         if len(chan_list) > self.SOFT_TERM_CAP:
             self._count_inscan_fallback("soft_terms")
             return None
@@ -1137,19 +1190,27 @@ class BatchScheduler:
         return {"chan_list": chan_list, "tmpl_of": tmpl_of,
                 "tmpl_pods": tmpl_pods, "reads": tmpl_reads,
                 "writes": tmpl_writes, "kmax": max(1, kmax),
-                "weight": float(w), "hard_w": hard_w}
+                "weight": float(w), "hard_w": hard_w,
+                # canonically ordered template keys: part of the soft
+                # chain signature (soft_base row r must mean the same
+                # template on both sides of a chained launch)
+                "tmpl_sigs": tuple(tkeys)}
 
     def _assign_soft_terms(self, pods: List[Pod],
-                           batch: PodBatchTensors) -> bool:
+                           batch: PodBatchTensors) -> Optional[Tuple]:
         """Install in-scan preferred inter-pod (anti-)affinity credit
         tables: the kernel then re-scores soft credits per pod from
         running accumulators (the serial reference's re-score via
         assume-between-iterations), which lifts the SOFT_SCORE_CHUNK
-        sub-batching for the common small-term-union case."""
+        sub-batching for the common small-term-union case.
+
+        Returns the batch's soft chain SIGNATURE (channel order +
+        template order + everything the carried accumulators' meaning
+        depends on), or None when no tables ride."""
         plan = self._soft_plan_cached(pods)
         self._soft_plan_memo = None   # batch consumed; drop the list ref
         if plan is None:
-            return False
+            return None
         idx = self.topology
         dom, n_domains = idx.term_table(
             tuple(tid for _, tid in plan["chan_list"]),
@@ -1178,7 +1239,9 @@ class BatchScheduler:
         batch.set_soft_terms(dom, n_domains, base, plan["tmpl_of"],
                              read_tids, read_w, write_tids, write_w,
                              plan["weight"])
-        return True
+        return (tuple(plan["chan_list"]), plan["tmpl_sigs"],
+                plan["kmax"], plan["weight"], plan["hard_w"],
+                n_domains, self.mirror.epoch, self.mirror.t.capacity)
 
     def _make_reassigner(self, batch: Optional[PodBatchTensors],
                          stale_winners):
@@ -1457,19 +1520,23 @@ class BatchScheduler:
         w = self.scorer.weights
         batch.resource_weights[0] = w.get("LeastRequestedPriority", 1)
         batch.resource_weights[1] = w.get("BalancedResourceAllocation", 1)
-        # gang batches skip the in-scan spread/topology/soft tables — the
+        # gang batches skip the in-scan spread/topology tables — the
         # gang kernel's trial/commit scan does not carry them; repair
         # (with whole-gang demotion) validates affinity interactions,
-        # matching the pre-in-scan semantics. Nominated reservations DO
-        # ride along (both kernels take the same phantom overlay — a mixed
-        # batch's singletons must not steal a preemptor's freed space).
-        spread_present = False
-        soft_present = False
+        # matching the pre-in-scan semantics. Soft credit tables DO ride
+        # gang batches (trial/committed accumulators in the gang carry —
+        # what lifted the soft_gang sub-batching), and nominated
+        # reservations ride both kernels as the same phantom overlay (a
+        # mixed batch's singletons must not steal a preemptor's freed
+        # space).
+        spread_sig = None
         topo_cover = "fallback"
         if gang_units is None:
-            spread_present = self._assign_spread_groups(pods, batch)
+            spread_sig = self._assign_spread_groups(pods, batch)
             topo_cover = self._assign_topology_terms(pods, batch, profiles)
-            soft_present = self._assign_soft_terms(pods, batch)
+        soft_sig = self._assign_soft_terms(pods, batch)
+        spread_present = spread_sig is not None
+        soft_present = soft_sig is not None
         self.phase_stats["term_prep_s"] += _time.perf_counter() - t_prep
         if tr is not None:
             tr.record("scheduler", "tensorize", t_tz, tr.now(),
@@ -1485,12 +1552,12 @@ class BatchScheduler:
                     batch.nom_row[i] = row
         static = self.scorer.static_scores(pods, batch)
         has_prio_ext = any(e.config.prioritize_verb for e in self.extenders)
-        # hysteresis: while static scores (or in-scan spread groups / soft
-        # credit tables, whose base rows must fold each batch's winners)
-        # are in play, later launches refuse the chain up front instead of
-        # discarding work
-        self._static_likely = static is not None or has_prio_ext \
-            or spread_present or soft_present
+        # hysteresis: while host-computed static scores are in play, later
+        # launches refuse the chain up front instead of discarding work.
+        # In-scan spread/soft tables no longer force the flush: their
+        # running counts CHAIN as carried device state (gated below), so
+        # the old recompute-from-batch-start invalidation is gone
+        self._static_likely = static is not None or has_prio_ext
         if has_prio_ext:
             if chaining:
                 return None  # host scores would lag the uncommitted chain
@@ -1499,21 +1566,23 @@ class BatchScheduler:
             if chaining:
                 return None
             batch.set_static_scores(*static)
-        if chaining and (spread_present or soft_present):
-            # spread base counts / soft base rows were computed from the
-            # committed state; a chained launch's usage includes
-            # UNCOMMITTED winners they don't — relaunch sequentially
+        if chaining and (spread_present or soft_present) and \
+                not self._chain_carries(chain, batch, spread_sig, soft_sig):
+            # the predecessor's carried counts don't structurally match
+            # this batch's tables — relaunch sequentially from host truth
             return None
         if chaining and not self.mirror.device_ready():
             return None  # tensorize grew the column axis; chain handle stale
-        if gang_units is None and nom_dev is None and not spread_present \
-                and not soft_present:
+        if gang_units is None and self.class_scan:
             # the incremental class-indexed scan: per-(template, score-row)
             # masked-score rows in the carry, one column refresh per winner
-            # (kernels/batch.py _schedule_batch_classes)
+            # (kernels/batch.py _schedule_batch_classes). Spread groups,
+            # soft credits, and nominated reservations ride the carry /
+            # phantom overlay, so EVERY non-gang batch takes the fast path
             batch.enable_class_scan()
         if chaining:
             node_cfg, usage = self.mirror.device_cfg(), chain.new_usage
+            self.chained_launches += 1
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
         sharded = False
@@ -1522,7 +1591,7 @@ class BatchScheduler:
             assign_d, scores_d, new_usage = gang_schedule_batch(
                 node_cfg, usage, batch.device(self.mirror.mesh),
                 self._gang_device_table(gang_units, batch), nom_dev)
-        elif batch._class_tables is not None and nom_dev is None \
+        elif batch._class_tables is not None \
                 and sharding_mod.use_shard_map(self.mirror.mesh,
                                                self.mirror.t.capacity):
             # the sharded drain's hot path: per-shard filter+score with a
@@ -1534,7 +1603,7 @@ class BatchScheduler:
                 self.sched_metrics.sharded_batches.inc()
             assign_d, scores_d, new_usage = schedule_batch_sharded(
                 self.mirror.mesh, node_cfg, usage,
-                batch.device(self.mirror.mesh))
+                batch.device(self.mirror.mesh), nom_dev)
         else:
             assign_d, scores_d, new_usage = schedule_batch(
                 node_cfg, usage, batch.device(self.mirror.mesh), nom_dev)
@@ -1552,8 +1621,44 @@ class BatchScheduler:
                             chained=chaining,
                             usage_epoch=self.mirror.usage_epoch,
                             gang_units=gang_units,
+                            spread_sig=spread_sig, soft_sig=soft_sig,
                             inscan_cover=(affinity_chainable
                                           and topo_cover != "fallback"))
+
+    def _chain_carries(self, chain: "PendingBatch", batch: PodBatchTensors,
+                       spread_sig: Optional[Tuple],
+                       soft_sig: Optional[Tuple]) -> bool:
+        """Gate for chaining THROUGH in-scan spread/soft tables.
+
+        The kernel's spread counts and soft credit accumulators ride the
+        chained usage handle ("spread" / "soft_cnt" finals), accumulating
+        every in-chain winner over the ANCHOR batch's base rows. A
+        successor may consume them only when its own tables resolve to
+        the same STRUCTURE (group/channel/template order, zones, weights
+        — the chain signatures), so slot g/s means the same thing on both
+        sides. When the gate passes, this batch's freshly computed base
+        rows are REPLACED with the chain predecessor's (transitively the
+        anchor's): commits landing mid-chain fold those same winners into
+        freshly computed rows, and anchor-base + chained-counts already
+        accounts for every one of them exactly once — the sum equals the
+        sequential path's recompute, which is what the chained-vs-
+        unchained spread parity test pins."""
+        nu = chain.new_usage
+        if not isinstance(nu, dict):
+            return False
+        if spread_sig is not None and (
+                chain.spread_sig != spread_sig or "spread" not in nu):
+            return False
+        if soft_sig is not None and (
+                chain.soft_sig != soft_sig or "soft_cnt" not in nu):
+            return False
+        if spread_sig is not None:
+            batch.spread_base = chain.batch.spread_base
+            batch.spread_zone = chain.batch.spread_zone
+            batch.spread_zinit = chain.batch.spread_zinit
+        if soft_sig is not None:
+            batch.soft_base = chain.batch.soft_base
+        return True
 
     def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
         """Back half: fetch results, host repair, adopt chained usage."""
@@ -1618,8 +1723,13 @@ class BatchScheduler:
             # write): an invalidate_usage after this batch launched means
             # its usage input carries the phantom state that invalidation
             # dropped — re-adopting would resurrect it, so it is refused.
-            self.mirror.adopt_usage(pending.new_usage,
-                                    epoch=pending.usage_epoch)
+            # Only the mirror's three usage tensors are adopted — the
+            # spread/soft carry finals riding new_usage exist solely for
+            # the NEXT chained launch (PendingBatch.new_usage keeps them).
+            self.mirror.adopt_usage(
+                {k: pending.new_usage[k]
+                 for k in ("used", "nonzero_used", "pod_count")},
+                epoch=pending.usage_epoch)
         return out
 
     def _enforce_gang_atomicity(self, results: List[ScheduleResult],

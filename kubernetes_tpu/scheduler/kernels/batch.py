@@ -142,8 +142,19 @@ _BATCH_INVARIANT = ("unique_masks", "unique_scores", "resource_weights",
                     "soft_dom", "soft_cnt0", "soft_base", "soft_weight")
 
 
+def _zone_onehot(zone_of: jnp.ndarray, zinit: jnp.ndarray) -> jnp.ndarray:
+    """[Z, N] f32 one-hot of the zone-id vector, built ONCE per kernel
+    call: the per-step zone sums become a matvec (zoh @ cf) instead of a
+    scatter-add — XLA CPU serializes scatters, and the scan pays that
+    cost per step. Counts are integer-valued f32, so the matvec's sum
+    order cannot change the result (bit-identical to the scatter)."""
+    z_idx = jnp.arange(zinit.shape[0], dtype=zone_of.dtype)
+    return (zone_of[None, :] == z_idx[:, None]).astype(jnp.float32)
+
+
 def _spread_score(cnt_g: jnp.ndarray, fits: jnp.ndarray,
-                  zone_of: jnp.ndarray, zinit: jnp.ndarray) -> jnp.ndarray:
+                  zone_of: jnp.ndarray, zinit: jnp.ndarray,
+                  zoh: jnp.ndarray) -> jnp.ndarray:
     """One pod's [N] SelectorSpread score from running group counts —
     the serial reduce (priorities.selector_spread_reduce /
     selector_spreading.go): invert node counts to 0-10 normalized over the
@@ -152,7 +163,7 @@ def _spread_score(cnt_g: jnp.ndarray, fits: jnp.ndarray,
     zone max). int() truncation == floor for these non-negatives."""
     cf = jnp.where(fits, cnt_g, 0.0)
     maxc = jnp.max(cf)
-    zs = zinit.at[zone_of].add(cf)
+    zs = zinit + zoh @ cf
     z_idx = jnp.arange(zs.shape[0])
     maxz = jnp.max(jnp.where(z_idx > 0, zs, 0.0))
     # f32 max, not jnp.any: a boolean reduce over the mesh-sharded node
@@ -205,6 +216,7 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    zoh = _zone_onehot(zone_of, zinit)
 
     def one(pod):
         mask = unique_masks[pod["mask_idx"]]
@@ -215,7 +227,7 @@ def filter_score(node_cfg: dict, usage: dict, pod_batch: dict
         g = pod.get("spread_gidx", jnp.int32(-1))
         use_spread = jnp.where(g >= 0, 1.0, 0.0)
         score = score + spread_w * use_spread * _spread_score(
-            spread_base[jnp.maximum(g, 0)], fits, zone_of, zinit)
+            spread_base[jnp.maximum(g, 0)], fits, zone_of, zinit, zoh)
         return fits, jnp.where(fits, score, NEG)
     return jax.vmap(one)(per_pod)
 
@@ -406,7 +418,31 @@ def _tie_penalized(masked, rows, seq):
     return masked - h.astype(jnp.float32) * jnp.float32(0.5 / 65536.0)
 
 
-def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
+def _soft_write(soft_dom, soft_cnt, pod, best, ok):
+    """The winner's soft-credit writes: +1 per matched read channel,
+    +weight per carried preferred/required-affinity channel, at the
+    chosen node's domains. ONE copy for the classic, class-indexed, and
+    gang kernels (bit-identity contract, like _topo_scatter)."""
+    wtids = pod["soft_write_tids"]                    # [Ks]
+    wt = jnp.maximum(wtids, 0)
+    wd = soft_dom[wt, best]                           # [Ks]
+    wval = jnp.where((wtids >= 0) & (wd >= 0) & ok,
+                     pod["soft_write_w"], 0.0)
+    return soft_cnt.at[wt, jnp.maximum(wd, 0)].add(wval)
+
+
+def _nom_feas_usage(usage: dict, nom: dict) -> dict:
+    """Usage with the phantom nominated reservations folded into the
+    FEASIBILITY columns (used/pod_count) only — scores stay on real usage
+    (nonzero_used), matching PrioritizeNodes ranking against the snapshot
+    and the classic kernel's eff_used/eff_count arithmetic."""
+    return {"used": usage["used"] + nom["used"],
+            "nonzero_used": usage["nonzero_used"],
+            "pod_count": usage["pod_count"] + nom["count"]}
+
+
+def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict,
+                            nom: dict = None):
     """The class-indexed incremental scan: pods sharing a (template,
     score-row) class share a precomputed masked-score ROW; a scan step
     gathers its pod's row, argmaxes, and refreshes only the winner's
@@ -416,9 +452,25 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
     steps instead of the r05 alignment-split workaround.
 
     Semantics and f32 arithmetic are bit-identical to the classic path
-    (tests/test_topo_cache.py pins decisions); routed only for batches
-    without nominated reservations, spread groups, or in-scan soft
-    credits (those keep per-pod state the column refresh can't share)."""
+    (tests/test_topo_cache.py + tests/test_class_fastpath.py pin
+    decisions). Every non-gang batch shape rides here now:
+
+      - spread groups: per-group running counts in the carry, the
+        winner's spread_match row bumping every matching group —
+        identical to the classic kernel's in-scan spread.
+      - soft inter-pod credits: the per-(term, domain) channel
+        accumulators in the carry, read/written per pod.
+      - nominated reservations: the phantom {used, count} overlay is
+        folded into the masked-score table's FEASIBILITY at build time
+        and at every winner-column refresh; a pod's own reservation at
+        its nominated row is re-credited by recomputing that ONE column
+        with the self-subtracted overlay (the classic kernel's self_oh
+        arithmetic, so the f32 ops match bit for bit).
+
+    A chained launch seeds the spread/soft carries from the predecessor's
+    finals (usage["spread"] / usage["soft_cnt"], riding the same device
+    handle as the chained usage — core.schedule_launch gates this on the
+    anchor's base tables still applying)."""
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     cls = {k: pod_batch[k] for k in ("class_req", "class_nz",
@@ -427,19 +479,52 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
     anti_dom = pod_batch.get("anti_dom")
     has_topo = anti_dom is not None
     has_dir2 = has_topo and "cmatch_tids" in pod_batch
+    has_spread = pod_batch.get("spread_base") is not None
+    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    zoh = _zone_onehot(zone_of, zinit)
+    soft = _soft_tables(pod_batch)
+    has_soft = soft is not None
+    if has_soft:
+        soft_dom, soft_cnt0, soft_base, soft_w = soft
+    has_nom = nom is not None
     rows = jnp.arange(N, dtype=jnp.int32)
-    ms0 = _class_ms_init(node_cfg, usage, cls, unique_masks,
-                         unique_scores, rw)
+    ms0 = _class_ms_init(node_cfg,
+                         _nom_feas_usage(usage, nom) if has_nom else usage,
+                         cls, unique_masks, unique_scores, rw)
 
     def one_pod(carry, pod):
         u = pod["class_idx"]
-        masked = carry["ms"][u]                                    # [N]
+        base = carry["ms"][u]                                      # [N]
+        if has_nom:
+            # self-exemption: the pod's own nominated row is recomputed
+            # with eff = (used + nom) - own req / count - 1 — the same
+            # f32 op order as the classic kernel's self_oh subtraction
+            r = pod.get("nom_row", jnp.int32(-1))
+            rc = jnp.clip(r, 0, N - 1)
+            corr = _class_col(
+                node_cfg, cls, unique_masks, unique_scores, rw,
+                carry["used"][rc] + nom["used"][rc] - cls["class_req"][u],
+                carry["nz_used"][rc],
+                carry["pod_count"][rc] + nom["count"][rc] - 1.0, rc)[u]
+            base = jnp.where((r >= 0) & (rows == r), corr, base)
+        fits = base > _NEG_THRESHOLD
         if has_topo:
             # both (anti-)affinity directions + waived co-location, from
             # the running counters (_topo_bad — shared with the classic
             # kernel so the mask arithmetic can't diverge)
-            masked = jnp.where(_topo_bad(anti_dom, carry, pod, has_dir2),
-                               NEG, masked)
+            fits = fits & ~_topo_bad(anti_dom, carry, pod, has_dir2)
+        score = base
+        if has_soft:
+            raw = _soft_raw(soft_dom, carry["soft_cnt"], soft_base, pod)
+            score = score + jnp.where(pod["soft_base_idx"] >= 0,
+                                      _soft_score(raw, fits, soft_w), 0.0)
+        if has_spread:
+            g = pod.get("spread_gidx", jnp.int32(-1))
+            use_spread = jnp.where(g >= 0, 1.0, 0.0)
+            score = score + spread_w * use_spread * _spread_score(
+                carry["spread"][jnp.maximum(g, 0)], fits, zone_of, zinit,
+                zoh)
+        masked = jnp.where(fits, score, NEG)
         best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
             .astype(jnp.int32)
         chosen = masked[best]
@@ -448,13 +533,28 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
         used = carry["used"].at[best].add(ok_f * cls["class_req"][u])
         nz_used = carry["nz_used"].at[best].add(ok_f * cls["class_nz"][u])
         pod_count = carry["pod_count"].at[best].add(ok_f)
-        col = _class_col(node_cfg, cls, unique_masks, unique_scores, rw,
-                         used[best], nz_used[best], pod_count[best], best)
+        if has_nom:
+            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                             rw, used[best] + nom["used"][best],
+                             nz_used[best],
+                             pod_count[best] + nom["count"][best], best)
+        else:
+            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                             rw, used[best], nz_used[best],
+                             pod_count[best], best)
         out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
                "ms": carry["ms"].at[:, best].set(col)}
+        if has_spread:
+            sm = pod.get("spread_match")
+            if sm is None:
+                sm = jnp.zeros((carry["spread"].shape[0],), jnp.float32)
+            out["spread"] = carry["spread"].at[:, best].add(sm * ok_f)
         if has_topo:
             out.update(_topo_scatter(anti_dom, carry, pod, best, ok,
                                      has_dir2))
+        if has_soft:
+            out["soft_cnt"] = _soft_write(soft_dom, carry["soft_cnt"],
+                                          pod, best, ok)
         assign = jnp.where(ok, best, jnp.int32(-1))
         return out, (assign, chosen)
 
@@ -465,6 +565,12 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
         carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
         if has_dir2:
             carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    if has_spread:
+        sp0 = usage.get("spread")
+        carry0["spread"] = sp0 if sp0 is not None else spread_base
+    if has_soft:
+        sc0 = usage.get("soft_cnt")
+        carry0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
     P = per_pod["seq"].shape[0]
     want = max(1, _STEP_GROUP)
     G = min(1 << (want.bit_length() - 1), P)
@@ -481,10 +587,14 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict):
     per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
                  for k, v in per_pod.items()}
     final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
-    return (assign_g.reshape(P), scores_g.reshape(P),
-            {"used": final["used"],
-             "nonzero_used": final["nz_used"],
-             "pod_count": final["pod_count"]})
+    new_usage = {"used": final["used"],
+                 "nonzero_used": final["nz_used"],
+                 "pod_count": final["pod_count"]}
+    if has_spread:
+        new_usage["spread"] = final["spread"]
+    if has_soft:
+        new_usage["soft_cnt"] = final["soft_cnt"]
+    return assign_g.reshape(P), scores_g.reshape(P), new_usage
 
 
 @jax.jit
@@ -511,15 +621,17 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     ranks against the snapshot).
 
     Dispatch (trace-time, by pytree structure): batches carrying class
-    tables (tensorize.PodBatchTensors.enable_class_scan) and no nominated
-    reservations route to the incremental class-indexed scan; everything
-    else — spread groups, soft in-scan credits, nominations — keeps the
-    classic per-pod recompute."""
-    if "class_req" in pod_batch and nom is None:
-        return _schedule_batch_classes(node_cfg, usage, pod_batch)
+    tables (tensorize.PodBatchTensors.enable_class_scan) route to the
+    incremental class-indexed scan — spread groups, soft in-scan
+    credits, and nominated reservations now ride it as carried state.
+    The classic per-pod recompute below remains as the one-source parity
+    control (KTPU_CLASS_SCAN=0, hand-built batches in tests)."""
+    if "class_req" in pod_batch:
+        return _schedule_batch_classes(node_cfg, usage, pod_batch, nom)
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    zoh = _zone_onehot(zone_of, zinit)
     soft = _soft_tables(pod_batch)
     has_soft = soft is not None
     if has_soft:
@@ -573,7 +685,7 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         gi = jnp.maximum(g, 0)
         use_spread = jnp.where(g >= 0, 1.0, 0.0)
         score = score + spread_w * use_spread * _spread_score(
-            carry["spread"][gi], fits, zone_of, zinit)
+            carry["spread"][gi], fits, zone_of, zinit, zoh)
         masked = jnp.where(fits, score, NEG)
         best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
             .astype(jnp.int32)
@@ -600,25 +712,25 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         if has_soft:
             # the winner's credit writes: +1 per matched read channel,
             # +weight per carried preferred/required-affinity channel
-            wtids = pod["soft_write_tids"]                    # [Ks]
-            wt = jnp.maximum(wtids, 0)
-            wd = soft_dom[wt, best]                           # [Ks]
-            wval = jnp.where((wtids >= 0) & (wd >= 0) & ok,
-                             pod["soft_write_w"], 0.0)
-            out["soft_cnt"] = carry["soft_cnt"].at[
-                wt, jnp.maximum(wd, 0)].add(wval)
+            out["soft_cnt"] = _soft_write(soft_dom, carry["soft_cnt"],
+                                          pod, best, ok)
         assign = jnp.where(ok, best, jnp.int32(-1))
         return out, (assign, masked[best])
 
+    # chained launches seed the spread/soft carries from the
+    # predecessor's finals (same contract as the class-indexed path)
+    sp0 = usage.get("spread")
     carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
-              "pod_count": usage["pod_count"], "spread": spread_base}
+              "pod_count": usage["pod_count"],
+              "spread": sp0 if sp0 is not None else spread_base}
     if has_topo:
         carry0["topo_cnt"] = pod_batch["anti_cnt0"]
         carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
         if has_dir2:
             carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
     if has_soft:
-        carry0["soft_cnt"] = soft_cnt0
+        sc0 = usage.get("soft_cnt")
+        carry0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
     # STEP GROUPING: the scan is latency-bound — each step's compute
     # ([N]-vector ops) is tiny next to the per-step sequencing overhead,
     # so a P-step scan costs ~P * step_latency regardless of N. Packing G
@@ -644,10 +756,14 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
                  for k, v in per_pod.items()}
     final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
-    return (assign_g.reshape(P), scores_g.reshape(P),
-            {"used": final["used"],
-             "nonzero_used": final["nz_used"],
-             "pod_count": final["pod_count"]})
+    new_usage = {"used": final["used"],
+                 "nonzero_used": final["nz_used"],
+                 "pod_count": final["pod_count"]}
+    if pod_batch.get("spread_base") is not None:
+        new_usage["spread"] = final["spread"]
+    if has_soft:
+        new_usage["soft_cnt"] = final["soft_cnt"]
+    return assign_g.reshape(P), scores_g.reshape(P), new_usage
 
 
 # ------------------------------------------------------------- sharded scan
@@ -673,15 +789,68 @@ def schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
 # score and its (anti-)affinity domain ids are broadcast from the owner
 # (re-deriving the score from the penalized max would re-round).
 #
-# GSPMD (plain jit over sharded inputs) remains the path for batch
-# shapes the class scan excludes — spread groups, soft credits,
-# nominated reservations, gangs — and for KTPU_SHARD_MAP=0 (the
-# pjit-vs-shard_map selection knob).
+# GSPMD (plain jit over sharded inputs) remains the path for gang
+# batches and for KTPU_SHARD_MAP=0 (the pjit-vs-shard_map selection
+# knob). Spread groups, soft credits, and nominated reservations ride
+# the shard_map kernel as carried/overlaid state:
+#
+#   spread — group counts replicate? No: the [G, N] count rows shard on
+#     the node axis like spread_base; the per-step normalization needs
+#     the GLOBAL max count and zone sums, which are one pmax + one psum
+#     of integer-valued f32 (exact in any order, so bit-identical).
+#   soft — the [Ts, Ds] channel accumulators replicate; the winner's
+#     domain ids broadcast from the owning shard (pmax over -1 padding,
+#     the _topo_scatter_sharded recipe), so every shard applies the
+#     identical scatter-add. Min-max normalization is a pmin/pmax pair.
+#   nominated — the phantom overlay shards with the mirror rows
+#     (P("nodes")); the self-exemption column recomputes on the owning
+#     shard and drops everywhere else.
 
 _INT32_MAX = jnp.int32(2147483647)
 
 
-def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
+def _spread_score_sharded(cnt_g, fits, zone_of, zinit, zoh):
+    """_spread_score under shard_map: cnt_g/fits/zone_of/zoh are the
+    LOCAL node slice; the max count, zone sums, and zone presence reduce
+    across shards. All reduced values are integer-valued f32 (counts),
+    so psum/pmax are order-insensitive and the result is bit-identical
+    to the single-device reduce."""
+    from ..sharding import NODE_AXIS
+    cf = jnp.where(fits, cnt_g, 0.0)
+    maxc = lax.pmax(jnp.max(cf), NODE_AXIS)
+    zs = zinit + lax.psum(zoh @ cf, NODE_AXIS)
+    z_idx = jnp.arange(zs.shape[0])
+    maxz = jnp.max(jnp.where(z_idx > 0, zs, 0.0))
+    have_zones = lax.pmax(
+        jnp.max(jnp.where(fits & (zone_of > 0), 1.0, 0.0)), NODE_AXIS) > 0
+    node_s = jnp.where(maxc > 0,
+                       MAX_PRIORITY * (maxc - cnt_g) / jnp.maximum(maxc, 1.0),
+                       MAX_PRIORITY)
+    zone_s = jnp.where((zone_of > 0) & (maxz > 0),
+                       MAX_PRIORITY * (maxz - zs[zone_of])
+                       / jnp.maximum(maxz, 1.0),
+                       MAX_PRIORITY)
+    blended = jnp.where(have_zones,
+                        node_s * (1.0 - ZONE_WEIGHTING)
+                        + ZONE_WEIGHTING * zone_s,
+                        node_s)
+    return jnp.floor(blended)
+
+
+def _soft_score_sharded(raw, fits, weight):
+    """_soft_score with the min-max normalization domain reduced across
+    shards (f32 min/max are exact, so bit-identical)."""
+    from ..sharding import NODE_AXIS
+    mn = lax.pmin(jnp.min(jnp.where(fits, raw, jnp.inf)), NODE_AXIS)
+    mx = lax.pmax(jnp.max(jnp.where(fits, raw, -jnp.inf)), NODE_AXIS)
+    span_ok = (mx > mn) & jnp.isfinite(mn)
+    norm = jnp.floor(MAX_PRIORITY * (raw - mn)
+                     / jnp.maximum(mx - mn, jnp.float32(1e-30)) + 4e-6)
+    return jnp.where(span_ok, weight * norm, 0.0)
+
+
+def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict,
+                        nom: dict = None):
     """shard_map body: every node-axis array here is the LOCAL shard."""
     from ..sharding import NODE_AXIS
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
@@ -694,15 +863,52 @@ def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
     anti_dom = pod_batch.get("anti_dom")
     has_topo = anti_dom is not None
     has_dir2 = has_topo and "cmatch_tids" in pod_batch
-    ms0 = _class_ms_init(node_cfg, usage, cls, unique_masks,
-                         unique_scores, rw)
+    has_spread = pod_batch.get("spread_base") is not None
+    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, Nl)
+    zoh = _zone_onehot(zone_of, zinit)
+    soft = _soft_tables(pod_batch)
+    has_soft = soft is not None
+    if has_soft:
+        soft_dom, soft_cnt0, soft_base, soft_w = soft
+    has_nom = nom is not None
+    ms0 = _class_ms_init(node_cfg,
+                         _nom_feas_usage(usage, nom) if has_nom else usage,
+                         cls, unique_masks, unique_scores, rw)
 
     def one_pod(carry, pod):
         u = pod["class_idx"]
-        masked = carry["ms"][u]                                    # [Nl]
+        base = carry["ms"][u]                                      # [Nl]
+        if has_nom:
+            # self-exemption column on the owning shard only (nom_row is
+            # a GLOBAL row id); other shards drop the write
+            r = pod.get("nom_row", jnp.int32(-1))
+            lrn = r - offset
+            own_n = (r >= 0) & (lrn >= 0) & (lrn < Nl)
+            lrc = jnp.clip(lrn, 0, Nl - 1)
+            corr = _class_col(
+                node_cfg, cls, unique_masks, unique_scores, rw,
+                carry["used"][lrc] + nom["used"][lrc]
+                - cls["class_req"][u],
+                carry["nz_used"][lrc],
+                carry["pod_count"][lrc] + nom["count"][lrc] - 1.0, lrc)[u]
+            base = base.at[jnp.where(own_n, lrn, Nl)].set(corr,
+                                                          mode="drop")
+        fits = base > _NEG_THRESHOLD
         if has_topo:
-            masked = jnp.where(_topo_bad(anti_dom, carry, pod, has_dir2),
-                               NEG, masked)
+            fits = fits & ~_topo_bad(anti_dom, carry, pod, has_dir2)
+        score = base
+        if has_soft:
+            raw = _soft_raw(soft_dom, carry["soft_cnt"], soft_base, pod)
+            score = score + jnp.where(
+                pod["soft_base_idx"] >= 0,
+                _soft_score_sharded(raw, fits, soft_w), 0.0)
+        if has_spread:
+            g = pod.get("spread_gidx", jnp.int32(-1))
+            use_spread = jnp.where(g >= 0, 1.0, 0.0)
+            score = score + spread_w * use_spread * _spread_score_sharded(
+                carry["spread"][jnp.maximum(g, 0)], fits, zone_of, zinit,
+                zoh)
+        masked = jnp.where(fits, score, NEG)
         # tie-break hash on the GLOBAL row id — identical inputs to the
         # single-device kernel's (row, seq) penalty
         penalized = _tie_penalized(masked, rows_g, pod["seq"])
@@ -725,13 +931,38 @@ def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
         pod_count = carry["pod_count"].at[lb_w].add(ok_f, mode="drop")
         # winner-column refresh, owner-local (non-owners compute a
         # garbage column from the clamped row and drop the write)
-        col = _class_col(node_cfg, cls, unique_masks, unique_scores, rw,
-                         used[lbc], nz_used[lbc], pod_count[lbc], lbc)
+        if has_nom:
+            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                             rw, used[lbc] + nom["used"][lbc],
+                             nz_used[lbc],
+                             pod_count[lbc] + nom["count"][lbc], lbc)
+        else:
+            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                             rw, used[lbc], nz_used[lbc], pod_count[lbc],
+                             lbc)
         out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
                "ms": carry["ms"].at[:, lb_w].set(col, mode="drop")}
+        if has_spread:
+            sm = pod.get("spread_match")
+            if sm is None:
+                sm = jnp.zeros((carry["spread"].shape[0],), jnp.float32)
+            out["spread"] = carry["spread"].at[:, lb_w].add(sm * ok_f,
+                                                            mode="drop")
         if has_topo:
             out.update(_topo_scatter_sharded(anti_dom, carry, pod, lbc,
                                              owner, ok, has_dir2))
+        if has_soft:
+            # the winner's domain ids live on the owning shard: one pmax
+            # broadcast (-1 padding loses to any real dom id), then every
+            # shard applies the identical replicated scatter-add
+            wtids = pod["soft_write_tids"]
+            wt = jnp.maximum(wtids, 0)
+            wd = lax.pmax(jnp.where(owner, soft_dom[wt, lbc],
+                                    jnp.int32(-1)), NODE_AXIS)
+            wval = jnp.where((wtids >= 0) & (wd >= 0) & ok,
+                             pod["soft_write_w"], 0.0)
+            out["soft_cnt"] = carry["soft_cnt"].at[
+                wt, jnp.maximum(wd, 0)].add(wval)
         assign = jnp.where(ok, best, jnp.int32(-1))
         return out, (assign, chosen)
 
@@ -742,6 +973,12 @@ def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
         carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
         if has_dir2:
             carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    if has_spread:
+        sp0 = usage.get("spread")
+        carry0["spread"] = sp0 if sp0 is not None else spread_base
+    if has_soft:
+        sc0 = usage.get("soft_cnt")
+        carry0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
     P = per_pod["seq"].shape[0]
     want = max(1, _STEP_GROUP)
     G = min(1 << (want.bit_length() - 1), P)
@@ -758,10 +995,14 @@ def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict):
     per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
                  for k, v in per_pod.items()}
     final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
-    return (assign_g.reshape(P), scores_g.reshape(P),
-            {"used": final["used"],
-             "nonzero_used": final["nz_used"],
-             "pod_count": final["pod_count"]})
+    new_usage = {"used": final["used"],
+                 "nonzero_used": final["nz_used"],
+                 "pod_count": final["pod_count"]}
+    if has_spread:
+        new_usage["spread"] = final["spread"]
+    if has_soft:
+        new_usage["soft_cnt"] = final["soft_cnt"]
+    return assign_g.reshape(P), scores_g.reshape(P), new_usage
 
 
 def _topo_scatter_sharded(anti_dom, carry, pod, lbc, owner, ok, has_dir2):
@@ -793,25 +1034,39 @@ def _topo_scatter_sharded(anti_dom, carry, pod, lbc, owner, ok, has_dir2):
 
 @partial(jax.jit, static_argnums=(0,))
 def schedule_batch_sharded(mesh, node_cfg: dict, usage: dict,
-                           pod_batch: dict):
+                           pod_batch: dict, nom: dict = None):
     """schedule_batch for class-table batches on a 1-D "nodes" mesh:
     the shard-mapped scan above, with every input placed by the
     name-keyed partition rules (sharding.spec_for). Same returns as
     schedule_batch; decisions bit-identical (tier-1 CPU-sharded smoke +
-    the bench's sharded parity fixtures pin this)."""
+    the bench's sharded parity fixtures pin this). `nom` is the phantom
+    nominated-reservation overlay, sharded with the mirror rows."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     from ..sharding import NODE_AXIS, spec_for
     cfg_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in node_cfg.items()}
     usage_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in usage.items()}
     batch_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in pod_batch.items()}
-    out_specs = (P(), P(), {"used": P(NODE_AXIS, None),
-                            "nonzero_used": P(NODE_AXIS, None),
-                            "pod_count": P(NODE_AXIS)})
+    usage_out = {"used": P(NODE_AXIS, None),
+                 "nonzero_used": P(NODE_AXIS, None),
+                 "pod_count": P(NODE_AXIS)}
+    if "spread_base" in pod_batch:
+        usage_out["spread"] = P(None, NODE_AXIS)
+    if "soft_dom" in pod_batch:
+        usage_out["soft_cnt"] = P()   # replicated accumulators
+    out_specs = (P(), P(), usage_out)
+    if nom is None:
+        fn = shard_map(lambda c, u, b: _sharded_class_scan(c, u, b),
+                       mesh=mesh,
+                       in_specs=(cfg_specs, usage_specs, batch_specs),
+                       out_specs=out_specs, check_rep=False)
+        return fn(node_cfg, usage, pod_batch)
+    nom_specs = {k: spec_for(k, jnp.ndim(v)) for k, v in nom.items()}
     fn = shard_map(_sharded_class_scan, mesh=mesh,
-                   in_specs=(cfg_specs, usage_specs, batch_specs),
+                   in_specs=(cfg_specs, usage_specs, batch_specs,
+                             nom_specs),
                    out_specs=out_specs, check_rep=False)
-    return fn(node_cfg, usage, pod_batch)
+    return fn(node_cfg, usage, pod_batch, nom)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))

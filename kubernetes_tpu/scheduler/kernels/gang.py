@@ -63,6 +63,7 @@ import numpy as np
 from jax import lax
 
 from .batch import (COL_CPU, COL_MEM, NEG, _pod_feasible, _pod_score,
+                    _soft_raw, _soft_score, _soft_tables, _soft_write,
                     _split_batch, _tie_penalized)
 
 #: entries per scan step (unrolled inside, same op sequence — see
@@ -99,15 +100,23 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     launch/finish plumbing (pack_results, usage adoption) is shared.
     new_usage reflects only COMMITTED gangs. Gang batches never carry the
     in-scan spread/topology tables (the core refuses those combinations
-    before routing here); `nom` is the same phantom nominated-reservation
-    overlay schedule_batch takes — a mixed batch's singletons must not
-    steal a preemptor's freed space just because a gang member rode along.
+    before routing here), but soft inter-pod credit tables DO ride: the
+    per-(term, domain) accumulators live in the trial/committed usage
+    dicts, so a rejected gang's credit writes vanish with its trial —
+    which is what let core drop the gang SOFT_SCORE_CHUNK sub-batching.
+    `nom` is the same phantom nominated-reservation overlay
+    schedule_batch takes — a mixed batch's singletons must not steal a
+    preemptor's freed space just because a gang member rode along.
     """
     per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
     N = node_cfg["alloc"].shape[0]
     P = per_pod["seq"].shape[0]
     dom_tab = gang_tab["dom_tab"]
     rows = jnp.arange(N, dtype=jnp.int32)
+    soft = _soft_tables(pod_batch)
+    has_soft = soft is not None
+    if has_soft:
+        soft_dom, soft_cnt0, soft_base, soft_w = soft
     if nom is None:
         nom = {"used": jnp.zeros_like(usage["used"]),
                "count": jnp.zeros_like(usage["pod_count"])}
@@ -146,18 +155,29 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         fits = _pod_feasible(node_cfg, eff_used, eff_count,
                              pod, mask & dmask)
         score = _pod_score(node_cfg, trial["nonzero_used"], pod, static, rw)
+        if has_soft:
+            # credits read from the TRIAL accumulators: an open gang's
+            # earlier members are visible, a rejected gang's never were
+            raw = _soft_raw(soft_dom, trial["soft_cnt"], soft_base, pod)
+            score = score + jnp.where(
+                pod["soft_base_idx"] >= 0,
+                _soft_score(raw, fits, soft_w), 0.0)
         masked = jnp.where(fits, score, NEG)
         # identical tie-break to schedule_batch (selectHost rotation)
         best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
             .astype(jnp.int32)
         ok = fits[best] & pod["active"] & valid
         oh_f = ((rows == best) & ok).astype(jnp.float32)
-        trial = {
+        new_trial = {
             "used": trial["used"] + oh_f[:, None] * pod["req"][None, :],
             "nonzero_used": trial["nonzero_used"]
             + oh_f[:, None] * pod["nonzero_req"][None, :],
             "pod_count": trial["pod_count"] + oh_f,
         }
+        if has_soft:
+            new_trial["soft_cnt"] = _soft_write(
+                soft_dom, trial["soft_cnt"], pod, best, ok)
+        trial = new_trial
         gang_dom = jnp.where(valid & ok & constrained & (gang_dom < 0),
                              dom_row[best], gang_dom)
         # a padding entry never vetoes its (padding) gang
@@ -173,6 +193,10 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
 
     usage0 = {"used": usage["used"], "nonzero_used": usage["nonzero_used"],
               "pod_count": usage["pod_count"]}
+    if has_soft:
+        # chained launches seed from the predecessor's committed finals
+        sc0 = usage.get("soft_cnt")
+        usage0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
     carry0 = (usage0, usage0, jnp.int32(-1), jnp.bool_(True))
     entries = {"pod_idx": gang_tab["pod_idx"], "start": gang_tab["start"],
                "end": gang_tab["end"], "dom_idx": gang_tab["entry_dom_idx"],
@@ -263,6 +287,18 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
         nom_used = np.asarray(nom["used"], np.float32)
         nom_cnt = np.asarray(nom["count"], np.float32)
     nom_row = np.asarray(pod_batch["nom_row"], np.int64)
+    # soft inter-pod credit tables (same trial/commit life as usage)
+    has_soft = pod_batch.get("soft_dom") is not None
+    if has_soft:
+        soft_dom = np.asarray(pod_batch["soft_dom"], np.int64)
+        soft_cnt = np.asarray(pod_batch["soft_cnt0"], np.float32).copy()
+        soft_base = np.asarray(pod_batch["soft_base"], np.float32)
+        soft_bidx = np.asarray(pod_batch["soft_base_idx"], np.int64)
+        soft_rt = np.asarray(pod_batch["soft_read_tids"], np.int64)
+        soft_rw = np.asarray(pod_batch["soft_read_w"], np.float32)
+        soft_wt = np.asarray(pod_batch["soft_write_tids"], np.int64)
+        soft_ww = np.asarray(pod_batch["soft_write_w"], np.float32)
+        soft_w = np.float32(pod_batch["soft_weight"])
 
     assign = np.full((P,), -1, np.int32)
     scores = np.full((P,), NEG32, np.float32)
@@ -283,6 +319,7 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
         trial_used = used.copy()
         trial_nz = nz.copy()
         trial_cnt = cnt.copy()
+        trial_soft = soft_cnt.copy() if has_soft else None
         gang_dom = pin
         gang_ok = True
         placed: list = []
@@ -326,6 +363,25 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
             ba = np.where((cpu_frac >= 1.0) | (mem_frac >= 1.0),
                           np.float32(0.0), ba)
             score = rw[0] * lr + rw[1] * ba + unique_scores[score_idx[i]]
+            if has_soft and soft_bidx[i] >= 0:
+                # _soft_raw / _soft_score in f32, same op order
+                rt = soft_rt[i]
+                t = np.maximum(rt, 0)
+                drow = soft_dom[t]                          # [Ks, N]
+                at = np.take_along_axis(trial_soft[t],
+                                        np.maximum(drow, 0), axis=1)
+                valid_r = (rt[:, None] >= 0) & (drow >= 0)
+                raw = soft_base[max(int(soft_bidx[i]), 0)] + \
+                    (soft_rw[i][:, None]
+                     * np.where(valid_r, at, np.float32(0.0))).sum(axis=0)
+                mn = np.min(np.where(fits, raw, np.float32(np.inf)))
+                mx = np.max(np.where(fits, raw, np.float32(-np.inf)))
+                if mx > mn and np.isfinite(mn):
+                    norm = np.floor(
+                        np.float32(10.0) * (raw - mn)
+                        / np.maximum(mx - mn, np.float32(1e-30))
+                        + np.float32(4e-6))
+                    score = score + soft_w * norm
             masked = np.where(fits, score, NEG32)
             h = ((rows64 * -1640531527 + int(seq[i]) * 40503)
                  & 0xFFFF).astype(np.float32)
@@ -337,13 +393,24 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
                 trial_used[best] += reqs[i]
                 trial_nz[best] += nzreqs[i]
                 trial_cnt[best] += np.float32(1.0)
+                if has_soft:
+                    wt = soft_wt[i]
+                    wtc = np.maximum(wt, 0)
+                    wd = soft_dom[wtc, best]
+                    wval = np.where((wt >= 0) & (wd >= 0), soft_ww[i],
+                                    np.float32(0.0))
+                    np.add.at(trial_soft, (wtc, np.maximum(wd, 0)), wval)
                 if dom_idx >= 0 and gang_dom < 0:
                     gang_dom = int(dom_row[best])
             else:
                 gang_ok = False
         if gang_ok:
             used, nz, cnt = trial_used, trial_nz, trial_cnt
+            if has_soft:
+                soft_cnt = trial_soft
             for i, best in placed:
                 assign[i] = best
-    return assign, scores, {"used": used, "nonzero_used": nz,
-                            "pod_count": cnt}
+    new_usage = {"used": used, "nonzero_used": nz, "pod_count": cnt}
+    if has_soft:
+        new_usage["soft_cnt"] = soft_cnt
+    return assign, scores, new_usage

@@ -116,6 +116,10 @@ class ScoreCompiler:
         self._any_prefer_taints = False
         self._any_avoid_annotations = False
         self._cluster_has_affinity_pods = False
+        #: bumped by invalidate_spread_selectors (Service/RC/RS/SS
+        #: events): part of the spread chain signature, so a selector
+        #: source changing mid-chain refuses the chained spread carry
+        self.spread_sel_gen = 0
 
     def set_weights(self, weights: Dict[str, int],
                     hard_pod_affinity_weight: Optional[int] = None) -> None:
@@ -273,6 +277,7 @@ class ScoreCompiler:
         on a node-quiet cluster would leave its templates memoized as
         selector-less and silently skip spread scoring."""
         self._spread_sel_memo = {}
+        self.spread_sel_gen += 1
 
     def _pod_has_spread_selectors(self, pod: Pod) -> bool:
         """SelectorSpread contributes only when some service/controller

@@ -34,16 +34,17 @@ import numpy as np
 NODE_AXIS = "nodes"
 
 #: tensors whose LEADING axis is the node axis: the mirror's per-node
-#: cfg/usage rows, the kernel usage carry, and nominated reservations
+#: cfg/usage rows, the kernel usage carry, nominated reservations, and
+#: the spread zone-id vector
 _NODE_LEADING = re.compile(
     r"^(alloc|used|nz_used|nonzero_used|pod_count|max_pods|node_ok"
-    r"|mem_pressure|valid|count)$")
+    r"|mem_pressure|valid|count|spread_zone)$")
 
 #: tensors whose TRAILING axis is the node axis: the deduplicated
-#: mask/score tables, spread/soft base rows, and the topology/gang
-#: [T, N] node->domain tables
+#: mask/score tables, spread/soft base rows, the chained spread-count
+#: carry, and the topology/gang [T, N] node->domain tables
 _NODE_TRAILING = re.compile(
-    r"^(unique_masks|unique_scores|spread_base|soft_base|anti_dom"
+    r"^(unique_masks|unique_scores|spread_base|spread|soft_base|anti_dom"
     r"|soft_dom|dom_tab)$")
 
 

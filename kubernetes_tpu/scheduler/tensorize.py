@@ -827,10 +827,9 @@ class PodBatchTensors:
         """Build the (template, score-row) class tables for the kernel's
         incremental class-indexed scan (kernels/batch.py
         _schedule_batch_classes). Called AFTER static scores are set —
-        score_idx is part of the class key. The caller guarantees the
-        batch carries no spread groups, soft credits, or nominated
-        reservations (those keep per-pod state the class path can't
-        share)."""
+        score_idx is part of the class key. Spread groups, soft credits,
+        and nominated reservations ride the class scan as per-pod
+        carried/overlaid state, so every non-gang batch builds these."""
         if not self._tmpl_req:
             return
         P = self.req.shape[0]
@@ -942,7 +941,9 @@ class PodBatchTensors:
             out["spread_gidx"] = put(self.spread_gidx)
             out["spread_match"] = put(self.spread_match)
             out["spread_base"] = mask_put("spread_base", self.spread_base)
-            out["spread_zone"] = put(self.spread_zone)
+            # the zone-id vector is node-axis data: it shards with the
+            # mirror rows so the shard_map kernel's local slice aligns
+            out["spread_zone"] = mask_put("spread_zone", self.spread_zone)
             out["spread_zinit"] = put(self.spread_zinit)
             out["spread_weight"] = jnp.float32(self.spread_weight)
         if self.anti_dom is not None:

@@ -149,6 +149,28 @@ class TopologyIndex:
         self.table_dev_hits = 0
         self._vec_cache: Dict[Tuple, np.ndarray] = {}
         self._vec_cache_version = -1
+        #: (kind, tid) -> [capacity] bool "some pod of `kind` sits in this
+        #: node's domain" — the required_masks building block, maintained
+        #: INCREMENTALLY from (term, domain) count zero-crossings instead
+        #: of being regathered from count vectors every batch. Pod churn
+        #: that only moves a count between two positive values touches
+        #: nothing; a 0<->positive crossing rewrites the crossing domain's
+        #: rows of the one affected vector. Node-topology changes
+        #: (dom_epoch) and capacity growth invalidate wholesale.
+        self._presence: Dict[Tuple[str, int], np.ndarray] = {}
+        #: per-vector change counters (the mask-row cache's dependency key)
+        self._presence_ver: Dict[Tuple[str, int], int] = {}
+        self._presence_key: Tuple[int, int] = (-1, -1)
+        #: bumped on every wholesale presence invalidation (dom_epoch /
+        #: capacity) so stale mask-row deps can never alias fresh ones
+        self._presence_gen = 0
+        #: profile-term-content -> (deps, [capacity] bool row): the final
+        #: per-template [N] mask row, reused across batches while none of
+        #: its terms' presence vectors moved — the steady-state cost of
+        #: required_masks drops to dict lookups
+        self._mask_row_cache: Dict[Tuple, Tuple[Tuple, np.ndarray]] = {}
+        self.mask_row_builds = 0
+        self.mask_row_hits = 0
         # (namespace, labels-canon) -> frozenset of matching tids; pods
         # stamped from one template share the entry, so selector matching
         # runs once per template, not once per pod (invalidated when the
@@ -348,6 +370,7 @@ class TopologyIndex:
             v = counts.get(dom, 0) - w
             if v <= 0:
                 counts.pop(dom, None)
+                self._presence_update(kind, tid, dom, False)
             else:
                 counts[dom] = v
             if kind == K_MATCH:
@@ -368,7 +391,10 @@ class TopologyIndex:
 
         def credit(kind: str, term: _Term, dom: int, w: float) -> None:
             counts = self._counts[kind].setdefault(term.tid, {})
-            counts[dom] = counts.get(dom, 0) + w
+            prev = counts.get(dom, 0)
+            counts[dom] = prev + w
+            if prev <= 0:
+                self._presence_update(kind, term.tid, dom, True)
             contrib.append((kind, term.tid, dom, w))
             if kind == K_MATCH:
                 t = self._match_total.get(term.tid)
@@ -475,6 +501,62 @@ class TopologyIndex:
                     prof.carried_anti.append(tid)
                     prof.constrained = True
         return prof
+
+    def _presence_sync(self) -> bool:
+        """Wholesale-invalidate the presence vectors when the node->domain
+        layout or the row capacity moved (the only changes the per-domain
+        delta updates cannot express). Returns True when a flush happened."""
+        key = (self.dom_epoch, self.mirror.t.capacity)
+        if self._presence_key == key:
+            return False
+        self._presence_key = key
+        self._presence.clear()
+        self._presence_ver.clear()
+        self._presence_gen += 1
+        return True
+
+    def _presence_update(self, kind: str, tid: int, dom: int,
+                         present: bool) -> None:
+        """A (term, domain) count crossed zero: rewrite that domain's rows
+        of the materialized presence vector (if one exists). O(N) per
+        CROSSING — steady pod churn within occupied domains costs zero,
+        where the per-batch regather this replaces paid O(terms × N)
+        per batch unconditionally."""
+        if self._presence_key != (self.dom_epoch, self.mirror.t.capacity):
+            return  # stale wholesale; the next access rebuilds anyway
+        vec = self._presence.get((kind, tid))
+        if vec is None:
+            return
+        nd = self._node_dom_vec(self._by_id[tid].tk)
+        vec[nd[:len(vec)] == dom] = present
+        self._presence_ver[(kind, tid)] = \
+            self._presence_ver.get((kind, tid), 0) + 1
+
+    def presence_vec(self, kind: str, tid: int) -> np.ndarray:
+        """[capacity] bool — `kind` count > 0 in this node's domain for
+        term `tid` (False where the topology label is absent). Built once,
+        then maintained by _presence_update deltas. Callers must not
+        mutate the returned array."""
+        self._presence_sync()
+        key = (kind, tid)
+        vec = self._presence.get(key)
+        if vec is not None:
+            return vec
+        term = self._by_id[tid]
+        nd = self._node_dom_vec(term.tk)
+        cap = self.mirror.t.capacity
+        counts = self._counts[kind].get(tid)
+        if not counts:
+            vec = np.zeros((cap,), bool)
+        else:
+            ndom = len(self._doms[term.tk])
+            dense = np.zeros((ndom + 1,), bool)
+            for dom, v in counts.items():
+                dense[dom] = v > 0
+            vec = dense[np.where(nd >= 0, nd, ndom)[:cap]]
+        self._presence[key] = vec
+        self._presence_ver.setdefault(key, 0)
+        return vec
 
     def _vec(self, kind: str, tid: int) -> np.ndarray:
         """[capacity] f32 counts of `kind` for term `tid`, gathered over the
@@ -604,10 +686,52 @@ class TopologyIndex:
         self._doms.setdefault(tk, {})
         return self._node_dom_vec(tk)
 
+    def _profile_mask_row(self, prof: AffinityProfile) -> np.ndarray:
+        """One profile's [capacity] feasible-node mask from the
+        incrementally maintained presence vectors, cached until any of
+        its terms' vectors move (a count-delta zero-crossing or a
+        wholesale node-topology flush). Steady-state batches pay dict
+        lookups instead of the O(k·N) boolean recombination; callers
+        must not mutate the returned row."""
+        self._presence_sync()   # settle the gen BEFORE recording deps
+        key = (tuple(prof.req_aff), tuple(prof.req_anti),
+               tuple(prof.carried_anti))
+        deps = [self._presence_gen, self.mirror.t.capacity]
+        for tid, _waived in prof.req_aff:
+            deps.append(self._presence_ver.get((K_MATCH, tid), 0))
+        for tid in prof.req_anti:
+            deps.append(self._presence_ver.get((K_MATCH, tid), 0))
+        for tid in prof.carried_anti:
+            deps.append(self._presence_ver.get((K_CARRY_ANTI, tid), 0))
+        deps = tuple(deps)
+        hit = self._mask_row_cache.get(key)
+        if hit is not None and hit[0] == deps:
+            self.mask_row_hits += 1
+            return hit[1]
+        row = np.ones((self.mirror.t.capacity,), bool)
+        for tid, waived in prof.req_aff:
+            # presence is False wherever the label is absent, but a
+            # WAIVED term still requires the node to carry the key
+            row &= self.has_dom_vec(self._by_id[tid].tk)
+            if not waived:
+                row &= self.presence_vec(K_MATCH, tid)
+        for tid in prof.req_anti:
+            row &= ~self.presence_vec(K_MATCH, tid)
+        for tid in prof.carried_anti:
+            row &= ~self.presence_vec(K_CARRY_ANTI, tid)
+        if len(self._mask_row_cache) > 4096:
+            self._mask_row_cache.clear()
+        self._mask_row_cache[key] = (deps, row)
+        self.mask_row_builds += 1
+        return row
+
     def required_masks(self, profiles: List[AffinityProfile]) -> np.ndarray:
-        """[U, capacity] bool — each profile's feasible-node mask. Routes
+        """[U, capacity] bool — each profile's feasible-node mask, from
+        the incrementally maintained (term, domain) presence vectors
+        (count-delta zero-crossings, not per-batch regathers). Routes
         through the device matmul kernel (kernels/affinity.py) when
-        templates × terms × nodes is big enough for the MXU to win."""
+        templates × terms × nodes is big enough for the MXU to win.
+        Callers must not mutate the returned rows."""
         U = len(profiles)
         cap = self.mirror.t.capacity
         terms: List[Tuple[str, int]] = []
@@ -631,10 +755,11 @@ class TopologyIndex:
         T = len(terms)
         if T == 0:
             return np.ones((U, cap), bool)
-        present = np.stack([self._vec(kind, tid) > 0 for kind, tid in terms])
-        has_dom = np.stack([self.has_dom_vec(self._by_id[tid].tk)
-                            for _, tid in terms])
         if U * T * cap >= DEVICE_EVAL_THRESHOLD:
+            present = np.stack([self.presence_vec(kind, tid)
+                                for kind, tid in terms])
+            has_dom = np.stack([self.has_dom_vec(self._by_id[tid].tk)
+                                for _, tid in terms])
             sel_dom = np.zeros((U, T), np.float32)   # aff: node needs tk
             sel_present = np.zeros((U, T), np.float32)  # non-waived: match
             sel_absent = np.zeros((U, T), np.float32)   # anti: match forbids
@@ -651,24 +776,11 @@ class TopologyIndex:
             from .kernels.affinity import affinity_masks
             return np.asarray(affinity_masks(
                 has_dom, present, sel_dom, sel_present, sel_absent))
-        # host path: profiles touch a handful of terms each, so direct
-        # per-profile boolean ANDs are O(sum(k) * N) — the dense [U, T] x
-        # [T, N] matmul this replaces paid O(U * T * N) for the same mask
-        # (identical semantics: viol == 0 <=> every condition holds)
-        pr = present & has_dom
-        out = np.ones((U, cap), bool)
-        for u, prof in enumerate(profiles):
-            row = out[u]
-            for tid, waived in prof.req_aff:
-                t = t_index[(K_MATCH, tid)]
-                row &= has_dom[t]
-                if not waived:
-                    row &= pr[t]
-            for tid in prof.req_anti:
-                row &= ~pr[t_index[(K_MATCH, tid)]]
-            for tid in prof.carried_anti:
-                row &= ~pr[t_index[(K_CARRY_ANTI, tid)]]
-        return out
+        # host path: per-profile cached mask rows — a batch whose
+        # templates' presence vectors haven't moved since the last batch
+        # recombines NOTHING (the stacked copy is the only O(U·N) left)
+        return np.stack([self._profile_mask_row(prof)
+                         for prof in profiles])
 
     def score_vector(self, pod: Pod,
                      hard_pod_affinity_weight: float) -> Optional[np.ndarray]:

@@ -427,6 +427,50 @@ class TestKernelParity:
                 assert np.allclose(np.asarray(u_k[key]), u_ref[key]), \
                     (seed, key)
 
+    def test_randomized_gangs_with_soft_credits_match_reference(self):
+        """Gang batches now carry the in-scan soft credit tables
+        (trial/committed accumulators): randomized instances with soft
+        reads/writes must match the scalar reference — including the
+        rollback of a rejected gang's credit writes."""
+        import jax.numpy as jnp
+        from kubernetes_tpu.scheduler.kernels.gang import (
+            gang_schedule_batch, gang_schedule_reference)
+        dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+        N = P = 16
+        Ts, Ks, Ds, Sb = 4, 2, 8, 2
+        for seed in range(8):
+            rng = np.random.default_rng(100 + seed)
+            nc, us, pb, gt = _random_instance(
+                rng, N=N, P=P, gang_sizes=(4, 3, 2, 1), constrained=(0,))
+            # integer-valued f32 tables keep kernel-vs-numpy arithmetic
+            # exact (weights and counts are integers in production too)
+            pb["soft_dom"] = rng.integers(-1, Ds, (Ts, N)).astype(np.int32)
+            pb["soft_cnt0"] = np.zeros((Ts, Ds), np.float32)
+            pb["soft_base"] = rng.integers(-5, 6, (Sb, N)) \
+                .astype(np.float32)
+            pb["soft_base_idx"] = rng.integers(-1, Sb, (P,)) \
+                .astype(np.int32)
+            pb["soft_read_tids"] = rng.integers(-1, Ts, (P, Ks)) \
+                .astype(np.int32)
+            pb["soft_read_w"] = rng.integers(-3, 4, (P, Ks)) \
+                .astype(np.float32)
+            pb["soft_write_tids"] = rng.integers(-1, Ts, (P, Ks)) \
+                .astype(np.int32)
+            pb["soft_write_w"] = rng.integers(0, 4, (P, Ks)) \
+                .astype(np.float32)
+            pb["soft_weight"] = np.float32(1.0)
+            a_ref, s_ref, u_ref = gang_schedule_reference(nc, us, pb, gt)
+            assert "soft_cnt" in u_ref
+            a_k, s_k, u_k = gang_schedule_batch(dev(nc), dev(us), dev(pb),
+                                                dev(gt))
+            assert (np.asarray(a_k) == a_ref).all(), \
+                f"seed {seed} assignment mismatch"
+            m = a_ref >= 0
+            assert np.allclose(np.asarray(s_k)[m], s_ref[m]), seed
+            for key in u_ref:
+                assert np.allclose(np.asarray(u_k[key]), u_ref[key]), \
+                    (seed, key)
+
     def test_all_or_nothing_in_kernel(self):
         """A gang with one impossible member places nobody, and the usage
         tensors stay untouched by its trial placements."""

@@ -66,6 +66,20 @@ def _fixture(client_cls, variant, n_nodes=24, n_pods=96):
                             label_selector=api.LabelSelector(
                                 match_labels={"g": f"g{i % 8}"}),
                             topology_key=api.wellknown.LABEL_HOSTNAME)]))
+        elif variant == "soft-affinity":
+            # preferred (soft) inter-pod anti-affinity: the in-scan credit
+            # accumulators ride the shard_map carry, min-max normalized
+            # with a cross-shard pmin/pmax pair
+            pod.spec.affinity = api.Affinity(
+                pod_anti_affinity=api.PodAntiAffinity(
+                    preferred_during_scheduling_ignored_during_execution=[
+                        api.WeightedPodAffinityTerm(
+                            weight=10,
+                            pod_affinity_term=api.PodAffinityTerm(
+                                label_selector=api.LabelSelector(
+                                    match_labels={"g": f"g{i % 8}"}),
+                                topology_key=api.wellknown
+                                .LABEL_HOSTNAME))]))
         elif variant == "anti-affinity-dir2" and i % 2 == 0:
             # carriers anti-affine to the app label every pod wears: the
             # odd pods are PURE MATCHERS, so the direction-2 carry table
@@ -85,12 +99,28 @@ def _drain(mesh, variant, batch_size=32, n_nodes=24, n_pods=96):
     """mesh=1 is the EXPLICIT single-device baseline (resolve_mesh maps
     n<=1 to no mesh without consulting KTPU_MESH — a mesh-flipped
     environment must not contaminate the bit-identity control)."""
+    from kubernetes_tpu import api
+    from kubernetes_tpu.api import Quantity
     from kubernetes_tpu.scheduler import Scheduler
     from kubernetes_tpu.state import Client
     client, nodes, pods = _fixture(Client, variant, n_nodes, n_pods)
     sched = Scheduler(client, batch_size=batch_size, mesh=mesh)
     for n in nodes:
         sched.cache.add_node(n)
+    if variant == "nominated":
+        # a phantom preemptor reserves most of n0; two queued pods hold
+        # their own nominations (the self-exemption rows) — the overlay
+        # shards P("nodes") with the mirror
+        ghost = api.Pod(
+            metadata=api.ObjectMeta(name="ghost", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(requests={
+                    "cpu": Quantity("3500m"),
+                    "memory": Quantity("7Gi")}))]))
+        sched.queue.nominated.add(ghost, "n0")
+        sched.queue.nominated.add(pods[0], "n1")
+        sched.queue.nominated.add(pods[1], "n2")
     for p in pods:
         sched.queue.add(p)
     sched.algorithm.refresh()
@@ -116,6 +146,70 @@ def test_sharded_drain_bit_identical(variant):
     assert sched.metrics.sharded_batches.value() > 0
     cfg, usage = sched.algorithm.mirror.device_cfg_usage()
     assert len(next(iter(usage.values())).sharding.device_set) == 8
+
+
+@pytest.mark.parametrize("shards", [4, 8])
+@pytest.mark.parametrize("variant", ["soft-affinity", "nominated"])
+def test_new_shapes_sharded_bit_identical(variant, shards):
+    """ISSUE 14: soft credits and nominated reservations route the
+    shard_map class scan now (they used to fall back to GSPMD / the
+    classic kernel) — binds bit-identical to the single-device drain on
+    4- and 8-shard CPU meshes, and the shard kernel really ran."""
+    n1, single, s1 = _drain(1, variant)
+    if variant == "nominated":
+        assert s1.algorithm._nom_dev is not None   # overlay engaged
+    mesh = _mesh(shards)
+    with mesh:
+        n2, sharded, sched = _drain(mesh, variant)
+    assert n1 == n2 > 0
+    assert single == sharded
+    assert sched.metrics.sharded_batches.value() > 0
+
+
+@pytest.mark.parametrize("shards", [4, 8])
+def test_spread_sharded_bit_identical(shards):
+    """Spread groups on the shard_map class scan: running group counts
+    shard on the node axis with a psum/pmax zone reduce — binds must be
+    bit-identical to the single-device drain."""
+    import time as _time
+    from kubernetes_tpu import api
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.state import Client
+
+    def run(mesh):
+        client = Client()
+        client.services().create(api.Service(
+            metadata=api.ObjectMeta(name="m", namespace="default"),
+            spec=api.ServiceSpec(selector={"app": "m"})))
+        sched = Scheduler(client, batch_size=32, mesh=mesh)
+        sched.informers.start()
+        try:
+            sched.informers.wait_for_cache_sync()
+            _c, nodes, pods = _fixture(lambda: client, "uniform")
+            deadline = _time.time() + 60
+            while sched.queue.num_pending() < len(pods) or \
+                    len(sched.cache.node_names()) < len(nodes):
+                if _time.time() > deadline:
+                    raise RuntimeError("informer sync stalled")
+                _time.sleep(0.01)
+            # the Service's selector really makes these spread carriers
+            assert sched.algorithm.scorer.listers.selectors_for_pod(
+                pods[0])
+            sched.algorithm.refresh()
+            n = sched.drain_pipelined()
+            binds = {p.metadata.name: p.spec.node_name
+                     for p in client.pods().list()}
+            return n, binds, sched.metrics.sharded_batches.value()
+        finally:
+            sched.informers.stop()
+
+    n1, single, _ = run(1)
+    mesh = _mesh(shards)
+    with mesh:
+        n2, sharded, n_shard_batches = run(mesh)
+    assert n1 == n2 > 0
+    assert single == sharded
+    assert n_shard_batches > 0
 
 
 def test_shard_map_vs_gspmd_selection(monkeypatch):
