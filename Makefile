@@ -1,6 +1,7 @@
 # Convenience entries (the reference's hack/ equivalents).
 
-.PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity
+.PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
+	bench-preempt
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -26,3 +27,10 @@ bench-sharded:
 bench-affinity:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		python bench.py affinity
+
+# preemption-storm bench: batched victim-pricing kernel vs the serial
+# control (KTPU_PREEMPT_KERNEL=0), kernel-vs-oracle decision parity,
+# whole-gang domain pricing, and the autoscaler slice drill
+# (BENCH_r09's source)
+bench-preempt:
+	JAX_PLATFORMS=cpu python bench.py preempt

@@ -1596,6 +1596,206 @@ def serving_main():
     }))
 
 
+def preempt_main():
+    """`bench.py preempt` — the preemption-storm bench (ISSUE 15):
+    an overcommitted cluster with mixed priority bands, PDB-guarded
+    victims, and bound gangs; high-priority preemptors arrive one per
+    cycle, each plan's evictions applied to the cache so the storm
+    evolves. Sections of the JSON line:
+
+      - storm: preemption plans/sec, kernel vs serial — the SAME seeded
+        fixture replayed per mode (KTPU_PREEMPT_KERNEL=0 is the serial
+        control the ISSUE names)
+      - parity: kernel-vs-numpy-oracle identity on the evolving fixture
+        (winner row + chosen victim set + PDB violations), fraction of
+        decisions identical — the bit-identity acceptance
+      - gang_preempt: whole-gang domain-pricing plans/sec
+      - gang_capacity: the acceptance drill — a parked gang on an
+        overcommitted ChaosHarness binds via an autoscaler-provisioned
+        slice, run twice on one seed, event logs compared byte-for-byte
+    """
+    import numpy as np
+    from kubernetes_tpu.api.policy import (PodDisruptionBudget,
+                                           PodDisruptionBudgetSpec,
+                                           PodDisruptionBudgetStatus)
+    from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.scheduler.core import BatchScheduler
+
+    N = int(os.environ.get("BENCH_PREEMPT_NODES", "400"))
+    P = int(os.environ.get("BENCH_PREEMPT_PODS", "150"))
+    SLICE = "tpu/slice"
+
+    def build(seed=0):
+        rng = np.random.default_rng(seed)
+        cache = Cache()
+        pdbs = []
+        k = 0
+        for i in range(N):
+            node = make_node(i)
+            node.metadata.labels[SLICE] = f"s{i // 8}"
+            cache.add_node(node)
+            for j in range(3):
+                prio = int(rng.choice((0, 10, 100)))
+                labels = {"band": f"b{prio}"}
+                if i % 4 == 0 and j == 0:
+                    labels[LABEL_POD_GROUP] = f"vg{i // 4}"
+                pod = api.Pod(
+                    metadata=api.ObjectMeta(
+                        name=f"v{k}", namespace="default", labels=labels),
+                    spec=api.PodSpec(
+                        node_name=f"node-{i}", priority=prio,
+                        containers=[api.Container(
+                            name="c", image="img",
+                            resources=api.ResourceRequirements(
+                                requests={
+                                    "cpu": Quantity(
+                                        f"{int(rng.integers(10, 14))}00m"),
+                                    "memory": Quantity("2Gi")}))]))
+                pod.status.start_time = \
+                    f"2026-08-01T00:{k % 60:02d}:00Z"
+                cache.add_pod(pod)
+                k += 1
+        pdbs.append(PodDisruptionBudget(
+            metadata=api.ObjectMeta(name="pdb-b0", namespace="default"),
+            spec=PodDisruptionBudgetSpec(
+                selector=api.LabelSelector(match_labels={"band": "b0"})),
+            status=PodDisruptionBudgetStatus(disruptions_allowed=N // 2)))
+        return cache, pdbs
+
+    def preemptor(i):
+        return api.Pod(
+            metadata=api.ObjectMeta(name=f"hi{i}", namespace="default"),
+            spec=api.PodSpec(priority=1000, containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity("2"),
+                              "memory": Quantity("3Gi")}))]))
+
+    def run_storm(kernel):
+        cache, pdbs = build()
+        sched = BatchScheduler(cache, pdb_lister=lambda: pdbs)
+        sched.preempt_kernel = kernel
+        t0 = time.perf_counter()
+        plans = victims = 0
+        for i in range(P):
+            plan = sched.preempt(preemptor(i))
+            if plan is not None:
+                plans += 1
+                victims += len(plan.victims)
+                for v in plan.victims:
+                    cache.remove_pod(v)
+        elapsed = time.perf_counter() - t0
+        return {"preemptors": P, "plans": plans, "victims": victims,
+                "plans_per_sec": round(plans / max(elapsed, 1e-9), 1),
+                "elapsed_s": round(elapsed, 2)}
+
+    storm_kernel = run_storm(True)
+    storm_serial = run_storm(False)
+
+    # parity on the evolving fixture: every decision compared against
+    # the numpy oracle at the tables level
+    from kubernetes_tpu.scheduler.kernels import preempt as pk
+    cache, pdbs = build()
+    sched = BatchScheduler(cache, pdb_lister=lambda: pdbs)
+    same = total = 0
+    for i in range(P):
+        sched.refresh()
+        infos = sched.snapshot.node_infos
+        pod = preemptor(i)
+        tabs = pk.build_victim_tables(
+            pod, sorted(infos.items()), infos, pdbs)
+        if tabs is None:
+            continue
+        a = tabs.arrays
+        w_k, ch_k, _k, nv_k = pk.price_nodes(
+            a["free0"], a["cfree0"], a["need"], a["need_cnt"], a["freed"],
+            a["fcnt"], a["valid"], a["pdb"], a["top"], a["psum"],
+            a["gcnt"], a["startr"], a["row_valid"])
+        w_r, ch_r, _kr, nv_r = pk.price_nodes_reference(a)
+        total += 1
+        if int(w_k) == int(w_r) and \
+                bool(np.array_equal(np.asarray(ch_k), ch_r)) and \
+                bool(np.array_equal(np.asarray(nv_k), nv_r)):
+            same += 1
+        if int(w_r) >= 0:
+            for v in tabs.expand(int(w_r), ch_r[int(w_r)]):
+                cache.remove_pod(v)
+    parity = round(same / max(total, 1), 4)
+
+    # whole-gang domain pricing rate
+    cache, pdbs = build()
+    sched = BatchScheduler(cache, pdb_lister=lambda: pdbs)
+    members = [api.Pod(
+        metadata=api.ObjectMeta(name=f"gm{i}", namespace="default",
+                                labels={LABEL_POD_GROUP: "benchgang"}),
+        spec=api.PodSpec(priority=1000, containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("2"),
+                          "memory": Quantity("3Gi")}))]))
+        for i in range(8)]
+    reps = max(1, P // 10)
+    t0 = time.perf_counter()
+    gang_plans = 0
+    for _ in range(reps):
+        if sched.preempt_gang(members, 8, SLICE) is not None:
+            gang_plans += 1
+    gang_elapsed = time.perf_counter() - t0
+    gang_preempt = {"repeats": reps, "plans": gang_plans,
+                    "plans_per_sec": round(
+                        reps / max(gang_elapsed, 1e-9), 1)}
+
+    # the acceptance drill: parked gang -> autoscaler slice, twice,
+    # byte-identical event logs
+    from kubernetes_tpu.chaos import ChaosHarness
+    drill_runs = []
+    for _ in range(2):
+        h = ChaosHarness(seed=9, nodes=4, nodes_per_slice=2,
+                         error_rate=0.0, autoscaler=True,
+                         autoscaler_cooldown=120.0)
+        try:
+            h.start()
+            h._create_gang(6, 3000)
+            for step in range(24):
+                h.injector.advance(step)
+                h._tick()
+            pods = h.admin.pods().list(namespace=None)
+            bound = sorted(
+                (p.metadata.name, p.spec.node_name) for p in pods
+                if p.metadata.name.startswith("gang-1-")
+                and p.spec.node_name)
+            drill_runs.append({"bound": bound,
+                               "events": list(h.injector.events)})
+        finally:
+            h.close()
+    gang_capacity = {
+        "members_bound": len(drill_runs[0]["bound"]),
+        "via": "autoscaler_slice",
+        "deterministic": drill_runs[0] == drill_runs[1],
+    }
+
+    print(json.dumps({
+        "metric": f"preempt storm plans/sec ({P} preemptors x {N} "
+                  f"overcommitted nodes, mixed bands + PDBs + gang "
+                  f"victims)",
+        "value": storm_kernel["plans_per_sec"],
+        "unit": "plans/s",
+        "detail": {
+            "storm": {"kernel": storm_kernel, "serial": storm_serial,
+                      "speedup": round(
+                          storm_kernel["plans_per_sec"]
+                          / max(storm_serial["plans_per_sec"], 1e-9), 2),
+                      "control": "KTPU_PREEMPT_KERNEL=0"},
+            "parity": {"rate": parity, "decisions": total,
+                       "oracle": "kernels/preempt.py "
+                                 "price_nodes_reference"},
+            "gang_preempt": gang_preempt,
+            "gang_capacity": gang_capacity,
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
@@ -1603,6 +1803,8 @@ if __name__ == "__main__":
         sharded_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "affinity":
         affinity_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "preempt":
+        preempt_main()
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

@@ -1,0 +1,13 @@
+"""Gang-aware cluster autoscaling — ROADMAP direction 3's second half.
+
+A parked gang is a capacity DEMAND with a shape (minMember x per-member
+resources x one ICI domain); this package turns that shape into whole
+provisioned slices instead of drip-fed nodes that never clear minMember.
+"""
+
+from .controller import (AutoscalerMetrics, ClusterAutoscaler,
+                         GROUP_ANNOTATION, PROVISIONED_LABEL,
+                         scheduler_demand_source)
+
+__all__ = ["AutoscalerMetrics", "ClusterAutoscaler", "GROUP_ANNOTATION",
+           "PROVISIONED_LABEL", "scheduler_demand_source"]

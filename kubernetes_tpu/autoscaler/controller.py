@@ -1,0 +1,438 @@
+"""ClusterAutoscaler — provision whole ICI slices for parked-gang demand.
+
+Modeled on the cluster-autoscaler's scale-up/scale-down loop
+(kubernetes/autoscaler RunOnce: unschedulable pods -> node-group
+provisioning; scale-down after a cooldown of emptiness), reshaped around
+the gang-scheduling reality this repo's ROADMAP names: drip-feeding one
+node at a time at a parked TPU slice never clears minMember, so the
+scale-up unit here is a SLICE — ceil(minMember / member-slots-per-node)
+nodes created in one pass, all carrying one fresh topology-domain value
+under the gang's topology key, so the gang kernel's one-ICI-domain
+constraint is satisfiable the moment the nodes sync.
+
+Demand flows in through a pluggable ``demand_source`` callable (the
+scheduler-side protocol: GangManager.demand_shapes joined against
+UnschedulableAttribution — see ``scheduler_demand_source``); without one
+the controller falls back to deriving shapes from its own Pod/PodGroup
+informers (pending members >= minMember for longer than
+``pending_threshold`` on the injected clock). All writes go through the
+NORMAL client — informers, the chaos injector, and virtual kubelets see
+real Node adds/deletes, never a side channel.
+
+Scale-down: a provisioned node (``PROVISIONED_LABEL``) that has been
+empty of bound pods for ``cooldown`` seconds is deleted, unless its
+domain is still wanted by live demand. Everything steps off an injected
+clock (``step()`` is one deterministic pass), so ChaosHarness /
+ServingHarness drive it synchronously under their same-seed contracts;
+``run()``/``stop()`` wrap step() in the usual controller thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api.core import Node, NodeCondition
+from ..api.meta import ObjectMeta
+from ..api.quantity import Quantity
+from ..api.scheduling import PodGroup
+from ..state.informer import SharedInformerFactory
+from ..utils.clock import Clock, REAL_CLOCK, now_iso
+from ..utils.errlog import SwallowedErrors
+from ..utils.metrics import Registry
+
+#: set on every node this controller creates — the scale-down sweep only
+#: ever touches its own nodes
+PROVISIONED_LABEL = "autoscaler.ktpu/provisioned"
+#: which gang's demand shape a provisioned node answers
+GROUP_ANNOTATION = "autoscaler.ktpu/for-gang"
+
+
+class AutoscalerMetrics:
+    def __init__(self, registry: Registry = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self.slices_provisioned = r.counter(
+            "autoscaler_slices_provisioned_total",
+            "Whole ICI slices (node groups sharing one topology domain) "
+            "provisioned for parked-gang demand")
+        self.scaledown_nodes = r.counter(
+            "autoscaler_scaledown_nodes_total",
+            "Provisioned nodes deleted after the empty-node cooldown")
+        self.parked_demand = r.gauge(
+            "autoscaler_parked_demand_gauge",
+            "Pending member pods across the gangs currently presenting "
+            "an unsatisfied capacity-demand shape")
+
+
+def scheduler_demand_source(get_scheduler: Callable[[], object]
+                            ) -> Callable[[], List[dict]]:
+    """The scheduler-side demand protocol: GangManager.demand_shapes
+    filtered to gangs the scheduler has actually FAILED to place — some
+    member carries an UnschedulableAttribution record whose reason is a
+    real placement failure (not the PodGroupNotReady park, which means
+    members are missing, not capacity). `get_scheduler` is a late-bound
+    accessor so harnesses that crash-replace the scheduler keep feeding
+    the replacement's state."""
+    def source() -> List[dict]:
+        sched = get_scheduler()
+        if sched is None or getattr(sched, "gang", None) is None:
+            return []
+        att = getattr(sched, "attribution", None)
+        out = []
+        for shape in sched.gang.demand_shapes():
+            if att is None:
+                out.append(shape)
+                continue
+            for key in shape.get("members", ()):
+                rec = att.get(key)
+                if rec is not None and rec["reason"] != "PodGroupNotReady":
+                    out.append(dict(shape, reason=rec["reason"]))
+                    break
+        return out
+    return source
+
+
+class ClusterAutoscaler:
+    """One control loop: scale_up unsatisfied demand shapes into whole
+    slices, scale_down provisioned nodes that stayed empty past the
+    cooldown."""
+
+    name = "clusterautoscaler"
+
+    def __init__(self, client,
+                 informers: Optional[SharedInformerFactory] = None,
+                 demand_source: Optional[Callable[[], List[dict]]] = None,
+                 clock: Clock = REAL_CLOCK,
+                 node_cpu: str = "4", node_mem: str = "32Gi",
+                 node_pods: int = 110,
+                 node_scalars: Optional[Dict[str, int]] = None,
+                 pending_threshold: float = 60.0,
+                 cooldown: float = 120.0,
+                 scan_interval: float = 10.0,
+                 max_nodes: int = 64,
+                 metrics: Optional[AutoscalerMetrics] = None,
+                 robustness=None,
+                 maintain_heartbeats: bool = True):
+        from ..api.core import Pod
+        self.client = client
+        self.informers = informers or SharedInformerFactory(client)
+        self.demand_source = demand_source
+        self.clock = clock
+        self.node_cpu = node_cpu
+        self.node_mem = node_mem
+        self.node_pods = node_pods
+        self.node_scalars = dict(node_scalars or {})
+        self.pending_threshold = pending_threshold
+        self.cooldown = cooldown
+        self.scan_interval = scan_interval
+        self.max_nodes = max_nodes
+        #: refresh the Ready heartbeat on provisioned nodes each step:
+        #: no kubelet runs on them in-process, and without a beat the
+        #: NodeLifecycleController would mark them NotReady after its
+        #: grace period while their gang's demand blocks scale-down.
+        #: Harnesses pass False — their virtual kubelets own heartbeats
+        #: (and the chaos injector's node kills must stay authoritative)
+        self.maintain_heartbeats = maintain_heartbeats
+        self.metrics = metrics if metrics is not None else AutoscalerMetrics()
+        self._swallowed = SwallowedErrors("clusterautoscaler", robustness)
+        self._pod_informer = self.informers.informer_for(Pod)
+        self._node_informer = self.informers.informer_for(Node)
+        self._pg_informer = self.informers.informer_for(PodGroup)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: gang key -> provision record {"domain", "nodes", "created"}:
+        #: a shape with a live record is satisfied-in-flight; re-created
+        #: gangs get a fresh slice generation
+        self._provisioned: Dict[str, dict] = {}
+        self._slice_gen = 0
+        #: node name -> clock time first observed empty (scale-down)
+        self._empty_since: Dict[str, float] = {}
+        #: gang key -> clock time first observed whole-but-pending
+        #: (informer-fallback ripeness)
+        self._first_seen: Dict[str, float] = {}
+        #: the last scale-up/scale-down decision, for /debug/pending
+        self.last_decision: Optional[dict] = None
+
+    # ----------------------------------------------------------- demand
+
+    def demand(self) -> List[dict]:
+        """Current demand shapes (see module docstring for the two
+        sources)."""
+        if self.demand_source is not None:
+            return list(self.demand_source())
+        return self._informer_demand()
+
+    def _informer_demand(self) -> List[dict]:
+        """Fallback derivation from this controller's own informers: a
+        gang whose pending (unbound, non-terminal) members cover
+        minMember and have stayed pending past pending_threshold."""
+        from ..api import helpers
+        from ..api.scheduling import pod_group_key
+        from ..scheduler.nodeinfo import pod_resource
+        now = self.clock.now()
+        pending: Dict[str, List] = {}
+        for p in self._pod_informer.indexer.list():
+            if p.spec.node_name or helpers.pod_is_terminal(p):
+                continue
+            gk = pod_group_key(p)
+            if gk is not None:
+                pending.setdefault(gk, []).append(p)
+        out: List[dict] = []
+        live = set()
+        for pg in self._pg_informer.indexer.list():
+            gkey = pg.metadata.key()
+            members = pending.get(gkey, [])
+            mm = max(1, pg.spec.min_member)
+            if len(members) < mm:
+                continue
+            live.add(gkey)
+            first = self._first_seen.setdefault(gkey, now)
+            if now - first < self.pending_threshold:
+                continue
+            members.sort(key=lambda p: p.metadata.key())
+            r = pod_resource(members[0])
+            out.append({
+                "gang": gkey, "min_member": mm,
+                "pending": len(members),
+                "members": [p.metadata.key() for p in members],
+                "topology_key": pg.spec.topology_key,
+                "cpu_m": r.milli_cpu, "memory": r.memory,
+                "scalars": dict(r.scalar_resources)})
+        for gkey in [k for k in self._first_seen if k not in live]:
+            del self._first_seen[gkey]
+        return sorted(out, key=lambda s: s["gang"])
+
+    # ---------------------------------------------------------- scaling
+
+    def _member_slots_per_node(self, shape: dict) -> int:
+        """How many members of this shape one template node holds."""
+        alloc = {"cpu": Quantity(self.node_cpu).milli_value(),
+                 "memory": Quantity(self.node_mem).value()}
+        slots = self.node_pods
+        if shape["cpu_m"] > 0:
+            slots = min(slots, alloc["cpu"] // shape["cpu_m"])
+        if shape["memory"] > 0:
+            slots = min(slots, alloc["memory"] // shape["memory"])
+        for name, v in shape.get("scalars", {}).items():
+            if v > 0:
+                slots = min(slots, self.node_scalars.get(name, 0) // v)
+        return int(slots)
+
+    def _node_object(self, name: str, gang: str, topology_key: str,
+                     domain: str) -> Node:
+        alloc = {"cpu": Quantity(self.node_cpu),
+                 "memory": Quantity(self.node_mem),
+                 "pods": Quantity(str(self.node_pods))}
+        for sname, v in self.node_scalars.items():
+            alloc[sname] = Quantity(str(v))
+        labels = {PROVISIONED_LABEL: "true"}
+        if topology_key:
+            labels[topology_key] = domain
+        node = Node(metadata=ObjectMeta(
+            name=name, labels=labels,
+            annotations={GROUP_ANNOTATION: gang}))
+        node.status.capacity = dict(alloc)
+        node.status.allocatable = dict(alloc)
+        node.status.conditions = [NodeCondition(
+            type="Ready", status="True", reason="KubeletReady",
+            last_heartbeat_time=now_iso(self.clock))]
+        return node
+
+    def _provisioned_node_count(self) -> int:
+        return sum(1 for n in self._node_informer.indexer.list()
+                   if PROVISIONED_LABEL in (n.metadata.labels or {}))
+
+    def _live_node_names(self) -> set:
+        return {n.metadata.name for n in self._node_informer.indexer.list()}
+
+    def _scale_up(self, shapes: List[dict], now: float) -> None:
+        live_nodes = self._live_node_names()
+        for shape in sorted(shapes, key=lambda s: s["gang"]):
+            gang = shape["gang"]
+            rec = self._provisioned.get(gang)
+            if rec is not None:
+                # a slice is already in flight for this gang: finish any
+                # creates a fault interrupted, then wait for the gang to
+                # land (scale-down reaps the slice once it empties again)
+                missing = [n for n in rec["nodes"]
+                           if n not in rec["created"]]
+                if missing:
+                    self._create_nodes(rec, missing, shape)
+                continue
+            slots = self._member_slots_per_node(shape)
+            if slots < 1:
+                self._decide(now, "skip", gang=gang,
+                             reason="member does not fit the node "
+                                    "template")
+                continue
+            n_nodes = -(-shape["min_member"] // slots)  # ceil
+            if self._provisioned_node_count() + n_nodes > self.max_nodes:
+                # bounded provisioning is VISIBLE, never silent: the
+                # refusal is the recorded decision (and the demand gauge
+                # stays up)
+                self._decide(now, "skip", gang=gang,
+                             reason=f"max_nodes {self.max_nodes} would "
+                                    f"be exceeded by {n_nodes} nodes")
+                continue
+            self._slice_gen += 1
+            domain = f"ca-slice-{self._slice_gen}"
+            safe = gang.replace("/", "-")
+            names = [f"ca-{safe}-g{self._slice_gen}-{i}"
+                     for i in range(n_nodes)]
+            # skip names an earlier generation may have left behind
+            names = [n for n in names if n not in live_nodes]
+            rec = {"domain": domain, "nodes": names, "created": set(),
+                   "topology_key": shape["topology_key"], "at": now}
+            self._provisioned[gang] = rec
+            self._create_nodes(rec, names, shape)
+            self.metrics.slices_provisioned.inc()
+            self._decide(now, "scale_up", gang=gang, domain=domain,
+                         nodes=list(names),
+                         min_member=shape["min_member"],
+                         slots_per_node=self._member_slots_per_node(shape))
+
+    def _create_nodes(self, rec: dict, names: List[str],
+                      shape: dict) -> None:
+        for name in names:
+            try:
+                self.client.nodes().create(self._node_object(
+                    name, shape["gang"], shape["topology_key"],
+                    rec["domain"]))
+                rec["created"].add(name)
+                self._swallowed.ok("create_node")
+            except Exception as e:
+                from ..state.store import AlreadyExistsError
+                # AlreadyExists after a retried pass counts as created;
+                # transient API faults retry on the next step
+                if isinstance(e, AlreadyExistsError):
+                    rec["created"].add(name)
+                    self._swallowed.ok("create_node")
+                else:
+                    self._swallowed.swallow("create_node", e)
+
+    def _scale_down(self, shapes: List[dict], now: float) -> None:
+        wanted_gangs = {s["gang"] for s in shapes}
+        bound: Dict[str, int] = {}
+        for p in self._pod_informer.indexer.list():
+            if p.spec.node_name:
+                bound[p.spec.node_name] = bound.get(p.spec.node_name, 0) + 1
+        provisioned = sorted(
+            (n for n in self._node_informer.indexer.list()
+             if PROVISIONED_LABEL in (n.metadata.labels or {})),
+            key=lambda n: n.metadata.name)
+        live = {n.metadata.name for n in provisioned}
+        # drop provision records whose gang landed AND whose nodes are
+        # gone (scale-down completed) so a re-created gang re-provisions
+        for gang, rec in list(self._provisioned.items()):
+            if gang not in wanted_gangs and \
+                    not (set(rec["nodes"]) & live):
+                del self._provisioned[gang]
+        for node in provisioned:
+            name = node.metadata.name
+            if bound.get(name, 0) > 0:
+                self._empty_since.pop(name, None)
+                continue
+            gang = (node.metadata.annotations or {}).get(GROUP_ANNOTATION)
+            if gang in wanted_gangs:
+                # its demand is still parked (e.g. waiting for siblings
+                # to sync): never reap a slice out from under it
+                self._empty_since.pop(name, None)
+                continue
+            first = self._empty_since.setdefault(name, now)
+            if now - first < self.cooldown:
+                continue
+            try:
+                self.client.nodes().delete(name)
+                self._swallowed.ok("delete_node")
+                self._empty_since.pop(name, None)
+                self.metrics.scaledown_nodes.inc()
+                self._decide(now, "scale_down", node=name,
+                             empty_for=now - first)
+            except Exception as e:
+                from ..state.store import NotFoundError
+                if isinstance(e, NotFoundError):
+                    self._swallowed.ok("delete_node")
+                    self._empty_since.pop(name, None)
+                else:
+                    self._swallowed.swallow("delete_node", e)
+        for name in [n for n in self._empty_since if n not in live]:
+            del self._empty_since[name]
+
+    def _decide(self, now: float, action: str, **detail) -> None:
+        self.last_decision = {"action": action, "time": now, **detail}
+
+    # ------------------------------------------------------------- loop
+
+    def step(self) -> None:
+        """One deterministic pass on the injected clock: read demand,
+        provision unsatisfied shapes, reap cooled-down empty nodes."""
+        now = self.clock.now()
+        shapes = self.demand()
+        self.metrics.parked_demand.set(
+            sum(s.get("pending", s.get("min_member", 0)) for s in shapes))
+        self._scale_up(shapes, now)
+        if self.maintain_heartbeats:
+            self._heartbeat_provisioned()
+        self._scale_down(shapes, now)
+
+    def _heartbeat_provisioned(self) -> None:
+        """Keep this controller's kubelet-less nodes Ready (the stand-in
+        for the machine agent a provisioned VM would run)."""
+        for node in sorted((n for n in self._node_informer.indexer.list()
+                            if PROVISIONED_LABEL in
+                            (n.metadata.labels or {})),
+                           key=lambda n: n.metadata.name):
+            def beat(cur):
+                for cond in cur.status.conditions:
+                    if cond.type == "Ready":
+                        cond.status = "True"
+                        cond.reason = "KubeletReady"
+                        cond.last_heartbeat_time = now_iso(self.clock)
+                        return cur
+                cur.status.conditions.append(NodeCondition(
+                    type="Ready", status="True", reason="KubeletReady",
+                    last_heartbeat_time=now_iso(self.clock)))
+                return cur
+            try:
+                self.client.nodes().patch(node.metadata.name, beat)
+                self._swallowed.ok("heartbeat_node")
+            except Exception as e:
+                from ..state.store import NotFoundError
+                if isinstance(e, NotFoundError):
+                    self._swallowed.ok("heartbeat_node")
+                else:
+                    self._swallowed.swallow("heartbeat_node", e)
+
+    def pending_report(self) -> dict:
+        """The /debug/pending contribution: current demand shapes and
+        the last provisioning decision."""
+        shapes = self.demand()
+        return {"component": self.name,
+                "demand": [{k: v for k, v in s.items() if k != "members"}
+                           for s in shapes],
+                "provisioned": {g: {"domain": rec["domain"],
+                                    "nodes": sorted(rec["created"])}
+                                for g, rec in
+                                sorted(self._provisioned.items())},
+                "lastDecision": self.last_decision}
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.scan_interval):
+            try:
+                self.step()
+                self._swallowed.ok("step")
+            except Exception as e:
+                # an informer mid-resync or a faulted read pass: the
+                # next interval re-reads everything from scratch
+                self._swallowed.swallow("step", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

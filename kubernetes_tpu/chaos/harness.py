@@ -261,7 +261,11 @@ class ChaosHarness:
                  with_tears: bool = False,
                  ha: bool = False,
                  replica: bool = False,
-                 mesh=None):
+                 mesh=None,
+                 autoscaler: bool = False,
+                 autoscaler_cooldown: float = 60.0,
+                 autoscaler_max_nodes: int = 64,
+                 preempt_storm: bool = False):
         self.seed = seed
         #: jax.sharding.Mesh for the scheduler's drain (None = single
         #: device). The determinism contract must survive sharding: the
@@ -289,6 +293,11 @@ class ChaosHarness:
         #: election on the shared FakeClock; kill_leader/suppress_lease
         #: join the schedule
         self.ha = ha
+        #: preempt_storm draws a priority band per created workload, so
+        #: an overcommitted run exercises victim pricing + whole-gang
+        #: preemption; flag-conditional draws keep flag-off schedules
+        #: byte-identical to earlier PRs'
+        self.preempt_storm = preempt_storm
         self.clock = FakeClock()
         #: the WALL clock for settle/promote barriers (informer and
         #: follower threads pump in real time regardless of the virtual
@@ -395,6 +404,31 @@ class ChaosHarness:
             self._sched_factory = SharedInformerFactory(self.client)
             self.scheduler = self._build_scheduler(self._sched_factory)
             self._build_controllers(self.factory)
+        #: gang-aware capacity management under chaos: the autoscaler
+        #: consumes the CURRENT scheduler's parked-gang demand (late
+        #: bound — restart_scheduler swaps the instance), provisions
+        #: slices through the faulted client (informers and the fault
+        #: oracle see real node adds), and steps deterministically on
+        #: the shared FakeClock inside _tick
+        self.autoscaler = None
+        self._ca_factory = None
+        if autoscaler:
+            from ..autoscaler import ClusterAutoscaler, \
+                scheduler_demand_source
+            # its own factory: controller-manager restarts replace
+            # self.factory, but the autoscaler (like a separate
+            # cluster-autoscaler deployment) survives them
+            self._ca_factory = SharedInformerFactory(self.client)
+            self.autoscaler = ClusterAutoscaler(
+                self.client, self._ca_factory,
+                demand_source=scheduler_demand_source(
+                    lambda: self.scheduler),
+                clock=self.clock, cooldown=autoscaler_cooldown,
+                max_nodes=autoscaler_max_nodes,
+                node_pods=110, robustness=self.metrics,
+                # the virtual kubelets own heartbeats here — and the
+                # injector's node kills must stay authoritative
+                maintain_heartbeats=False)
 
     def _build_scheduler(self, factory: SharedInformerFactory,
                          client=None) -> Scheduler:
@@ -424,10 +458,11 @@ class ChaosHarness:
             self._make_controllers(factory)
 
     def _factories(self) -> List[SharedInformerFactory]:
+        extra = [self._ca_factory] if self._ca_factory is not None else []
         if self.ha:
             return [f for f, *_ in self._cm_instances.values()] + \
-                   [f for f, _ in self._sched_instances.values()]
-        return [self.factory, self._sched_factory]
+                   [f for f, _ in self._sched_instances.values()] + extra
+        return [self.factory, self._sched_factory] + extra
 
     # --------------------------------------------------------- ha wiring
 
@@ -776,6 +811,9 @@ class ChaosHarness:
             self.podgroups.client = new_client
             self.podgc.client = new_client
             self.factory.repoint(new_client)
+        if self.autoscaler is not None:
+            self.autoscaler.client = new_client
+            self._ca_factory.repoint(new_client)
         # the standby journals what it applied: the WAL-replay invariant
         # now checks the promoted store against ITS OWN journal
         self.wal_path = self.wal_path + ".replica"
@@ -820,6 +858,8 @@ class ChaosHarness:
             if self.ha:
                 ev["election"] = rng.choice(("kube-scheduler",
                                              "kube-controller-manager"))
+            if self.preempt_storm:
+                ev["priority"] = rng.choice((0, 10, 100, 1000))
             out.append(ev)
         return out
 
@@ -910,10 +950,12 @@ class ChaosHarness:
         action = ev["action"]
         node = f"node-{ev['node']}"
         if action == "create_gang":
-            self._create_gang(ev["size"], ev["cpu_m"])
+            self._create_gang(ev["size"], ev["cpu_m"],
+                              priority=ev.get("priority"))
             report.gangs_created += 1
         elif action == "create_singleton":
-            self._create_pod(self._next_pod_name("solo"), ev["cpu_m"])
+            self._create_pod(self._next_pod_name("solo"), ev["cpu_m"],
+                             priority=ev.get("priority"))
         elif action == "kill_node":
             if self._node_exists(node) and self.injector.node_alive(node):
                 self.injector.kill_node(node)
@@ -980,7 +1022,8 @@ class ChaosHarness:
         self._pod_counter += 1
         return f"{prefix}-{self._pod_counter}"
 
-    def _create_gang(self, size: int, cpu_m: int) -> None:
+    def _create_gang(self, size: int, cpu_m: int,
+                     priority: Optional[int] = None) -> None:
         self._gang_counter += 1
         gname = f"gang-{self._gang_counter}"
         self.admin.pod_groups("default").create(PodGroup(
@@ -988,11 +1031,13 @@ class ChaosHarness:
             spec=PodGroupSpec(min_member=size, topology_key=SLICE_LABEL,
                               schedule_timeout_seconds=self.gang_timeout)))
         for i in range(size):
-            self._create_pod(f"{gname}-w{i}", cpu_m, group=gname)
+            self._create_pod(f"{gname}-w{i}", cpu_m, group=gname,
+                             priority=priority)
         self.injector.record("create_gang", gname, size)
 
     def _create_pod(self, name: str, cpu_m: int,
-                    group: Optional[str] = None) -> None:
+                    group: Optional[str] = None,
+                    priority: Optional[int] = None) -> None:
         from ..api.core import (Container, PodSpec, ResourceRequirements)
         labels = {}
         if group is not None:
@@ -1001,7 +1046,7 @@ class ChaosHarness:
         pod = Pod(
             metadata=ObjectMeta(name=name, namespace="default",
                                 labels=labels),
-            spec=PodSpec(containers=[Container(
+            spec=PodSpec(priority=priority, containers=[Container(
                 name="c", image="img",
                 resources=ResourceRequirements(
                     requests={"cpu": Quantity(f"{cpu_m}m"),
@@ -1041,6 +1086,12 @@ class ChaosHarness:
             except Exception:
                 pass
             self.scheduler.cache.cleanup_expired_assumed_pods()
+            self._settle()
+        if self.autoscaler is not None:
+            # after the scheduler's cycle so demand reflects this tick's
+            # failed attempts; step() swallows-and-counts its own API
+            # faults, so a faulted pass retries next tick
+            self.autoscaler.step()
             self._settle()
         if cm_active:
             for pg in self.admin.pod_groups().list(namespace=None):

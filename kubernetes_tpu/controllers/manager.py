@@ -105,6 +105,12 @@ class ControllerManager:
                 client, self.informers, cluster_ca[0])
         self.podgroup = PodGroupController(client, self.informers,
                                            metrics=self.robustness)
+        # gang-aware capacity management: provisions whole ICI slices for
+        # parked-gang demand shapes (autoscaler/controller.py); inert on
+        # clusters without gangs stuck past the pending threshold
+        from ..autoscaler import ClusterAutoscaler
+        self.clusterautoscaler = ClusterAutoscaler(
+            client, self.informers, robustness=self.robustness)
         self.podgc = PodGCController(
             client, self.informers,
             terminated_threshold=terminated_pod_gc_threshold,
@@ -122,7 +128,8 @@ class ControllerManager:
             self.clusterrole_aggregation, self.nodeipam,
             self.pvc_protection, self.pv_protection, self.ttl,
             self.attachdetach, self.pv_expander,
-            self.bootstrapsigner, self.tokencleaner, self.podgroup]
+            self.bootstrapsigner, self.tokencleaner, self.podgroup,
+            self.clusterautoscaler]
         if self.csrapproving is not None:
             self.controllers += [self.csrapproving, self.csrsigning,
                                  self.root_ca_publisher]

@@ -336,6 +336,14 @@ class BatchScheduler:
         #: kernel — the parity control for the class-indexed fast path
         #: (bench.py affinity measures class-scan vs classic with it)
         self.class_scan = _os.environ.get("KTPU_CLASS_SCAN", "1") != "0"
+        #: KTPU_PREEMPT_KERNEL=0 pins preemption to the serial per-node
+        #: victim search (preemption.py) — the measured control for the
+        #: batched victim-pricing kernel (kernels/preempt.py)
+        self.preempt_kernel = _os.environ.get(
+            "KTPU_PREEMPT_KERNEL", "1") != "0"
+        #: (node, generation, prio, ...) -> victim units: amortizes the
+        #: preemption tensorize across a storm (kernels/preempt.py)
+        self._preempt_unit_cache: Dict[Tuple, list] = {}
         #: launches that actually chained on a predecessor's device usage
         #: (tests pin that spread/soft batches keep chaining)
         self.chained_launches = 0
@@ -1771,6 +1779,12 @@ class BatchScheduler:
         gang_id = np.arange(P, dtype=np.int32)
         entry_dom = np.full((P,), -1, np.int32)
         pin_dom = np.full((P,), -1, np.int32)
+        # capacity-aware domain feasibility inputs: the gang's in-batch
+        # member count and elementwise-max member request, read by the
+        # kernel at each gang's start entry (kernels/gang.py has_cap)
+        need = np.zeros((P,), np.float32)
+        greq = np.zeros((P, batch.req.shape[1]), np.float32)
+        req_np = np.asarray(batch.req)
         dom_index: Dict[str, int] = {}
         dom_rows: List[np.ndarray] = []
         t = 0
@@ -1791,6 +1805,7 @@ class BatchScheduler:
                     # slice vanished) — the id matches nothing and the
                     # members wait for the permit timeout to clear the pin
                     p_id = self.topology._dom_id(tk, pin)
+            unit_greq = req_np[idxs].max(axis=0) if idxs else None
             for j, i in enumerate(idxs):
                 pod_idx[t] = i
                 start[t] = j == 0
@@ -1798,6 +1813,8 @@ class BatchScheduler:
                 gang_id[t] = u
                 entry_dom[t] = d
                 pin_dom[t] = p_id
+                need[t] = len(idxs)
+                greq[t] = unit_greq
                 t += 1
         start[t:] = True
         end[t:] = True
@@ -1810,6 +1827,7 @@ class BatchScheduler:
         out = {"pod_idx": put(pod_idx), "start": put(start),
                "end": put(end), "gang_id": put(gang_id),
                "entry_dom_idx": put(entry_dom), "pin_dom": put(pin_dom),
+               "need": put(need), "greq": put(greq),
                # node axis shards with the mirror, by the name-keyed rule
                "dom_tab": self.mirror.put_named("dom_tab", dom_tab)}
         return out
@@ -1915,13 +1933,7 @@ class BatchScheduler:
         hv = self.terms.hostname_vector(pod)
         if hv is not None:
             vec = vec & hv
-        all_preds = self._fits_predicates(pod)
-
-        def fits(p, meta, ni) -> bool:
-            ok, _ = preds.pod_fits_on_node(p, meta, ni, all_preds)
-            return ok
         pdbs = list(self.pdb_lister())
-        base_meta = preds.PredicateMetadata(pod, infos)
         candidates = []
         for row in np.nonzero(vec)[0]:
             name = self.mirror.name_of.get(int(row))
@@ -1929,6 +1941,19 @@ class BatchScheduler:
             if ni is None or not pre.resource_screen(pod, ni):
                 continue
             candidates.append((name, ni))
+        if self.preempt_kernel:
+            # batched victim-pricing kernel: all candidates tensorized at
+            # once (no CAP truncation — the scan is O(N·V) device work,
+            # not per-node python clones)
+            return self._preempt_kernel_plan(pod, candidates, infos, pdbs)
+        # serial reprieve path only: full-predicate fit closure + the
+        # cluster-wide metadata its per-node clones derive from
+        all_preds = self._fits_predicates(pod)
+
+        def fits(p, meta, ni) -> bool:
+            ok, _ = preds.pod_fits_on_node(p, meta, ni, all_preds)
+            return ok
+        base_meta = preds.PredicateMetadata(pod, infos)
         if len(candidates) > self.PREEMPT_CANDIDATE_CAP:
             self._count_capped_scan("preempt_candidates", len(candidates))
             # cost bound: the clone + reprieve loop per candidate is host
@@ -2014,6 +2039,104 @@ class BatchScheduler:
             node_name=node, victims=victims, num_pdb_violations=nviol,
             nominated_to_clear=pre.nominated_pods_to_clear(
                 pod, node, self.nominated.pods_for_node(node)))
+
+    def _preempt_kernel_plan(self, pod: Pod, candidates, infos, pdbs):
+        """The batched path: tensorize every candidate's victims into
+        band-sorted [N, V] pricing tables, run the masked prefix-sum fit
+        scan + lexicographic winner on device, expand the winner's
+        chosen prefix back into pods. PDB-violating victims ride the
+        last-resort band; gang victims are priced as whole PodGroups."""
+        from .kernels import preempt as pk
+        tabs = pk.build_victim_tables(pod, candidates, infos, pdbs,
+                                      unit_cache=self._preempt_unit_cache)
+        if tabs is None:
+            return None
+        from . import preemption as pre
+        a = tabs.arrays
+        winner_d, chosen_d, _k, nviol_d = pk.price_nodes(
+            a["free0"], a["cfree0"], a["need"], a["need_cnt"], a["freed"],
+            a["fcnt"], a["valid"], a["pdb"], a["top"], a["psum"],
+            a["gcnt"], a["startr"], a["row_valid"])
+        winner = int(winner_d)
+        if winner < 0:
+            return None
+        victims = tabs.expand(winner, np.asarray(chosen_d[winner]))
+        if not victims:
+            return None
+        node = tabs.names[winner]
+        return pre.PreemptionPlan(
+            node_name=node, victims=victims,
+            num_pdb_violations=int(nviol_d[winner]),
+            nominated_to_clear=pre.nominated_pods_to_clear(
+                pod, node, self.nominated.pods_for_node(node)))
+
+    def preempt_gang(self, members: List[Pod], min_member: int,
+                     topology_key: str):
+        """Whole-gang preemption: price `min_member` member placements
+        against every ICI domain at once (kernels/preempt.py
+        price_domains) and return a GangPreemptionPlan — the victims to
+        evict plus a nomination per member spread across the winning
+        domain's freed nodes, so the nominated-reservation overlay
+        shields the whole slice until the gang lands. Pure computation;
+        the shell performs the API writes. Returns None when no domain
+        can ever hold the gang."""
+        if not members or min_member < 1:
+            return None
+        from . import preemption as pre
+        from .kernels import preempt as pk
+        self.refresh()
+        infos = self.snapshot.node_infos
+        rep = members[0]
+        t = self.mirror.t
+        vec = (self.terms.tolerations_vector(rep)
+               & self.terms.node_selector_vector(rep)
+               & t.node_ok & t.valid)
+        candidates = []
+        for row in np.nonzero(vec)[0]:
+            name = self.mirror.name_of.get(int(row))
+            ni = infos.get(name) if name else None
+            if ni is None or ni.node is None:
+                continue
+            dom = ni.node.metadata.labels.get(topology_key) \
+                if topology_key else ""
+            if dom is None:
+                continue  # the label is the slice membership card
+            candidates.append((name, ni, dom))
+        pdbs = list(self.pdb_lister())
+        tabs = pk.build_domain_tables(members, candidates, infos, pdbs,
+                                      min_member)
+        if tabs is None:
+            return None
+        a = tabs.arrays
+        winner_d, chosen_d, nviol_d = pk.price_domains(
+            a["base"], a["need"], a["dslots"], a["valid"], a["pdb"],
+            a["top"], a["psum"], a["gcnt"], a["startr"], a["row_valid"])
+        winner = int(winner_d)
+        if winner < 0:
+            return None
+        chosen = np.asarray(chosen_d[winner])
+        victims = tabs.expand(winner, chosen)
+        # spread the members over the domain's post-eviction slots in
+        # sorted node order — the nomination layout
+        nominations: List[Tuple[Pod, str]] = []
+        ordered = sorted(members, key=lambda p: p.metadata.key())
+        it = iter(ordered)
+        done = False
+        for node, slots in tabs.node_slots(winner, chosen):
+            for _ in range(slots):
+                m = next(it, None)
+                if m is None:
+                    done = True
+                    break
+                nominations.append((m, node))
+            if done:
+                break
+        if len(nominations) < min(min_member, len(ordered)):
+            return None  # the slot estimate shrank under us; retry later
+        return pre.GangPreemptionPlan(
+            domain=tabs.domains[winner], victims=victims,
+            nominations=nominations,
+            num_pdb_violations=int(nviol_d[winner]))
 
     #: nodes examined per failure diagnosis; the reference pays full-cluster
     #: cost per ATTEMPT inside its parallelized hot loop, but here explain()

@@ -154,10 +154,20 @@ class CacheDebugger:
                      "lastCycle": rec["cycle"] if rec else None,
                      "lastFailureTime": rec["time"] if rec else None}
             out.append(entry)
-        return {"component": sched.scheduler_name,
-                "pending": len(pods),
-                "truncated": max(0, len(pods) - limit),
-                "pods": out}
+        report = {"component": sched.scheduler_name,
+                  "pending": len(pods),
+                  "truncated": max(0, len(pods) - limit),
+                  "pods": out}
+        # parked-gang demand shapes (minMember x member request x ICI
+        # domain): the signal the autoscaler consumes, surfaced here so
+        # "why is my slice pending" is answerable next to the per-pod
+        # attribution
+        gang = getattr(sched, "gang", None)
+        if gang is not None:
+            report["gangDemand"] = [
+                {k: v for k, v in s.items() if k != "members"}
+                for s in gang.demand_shapes()]
+        return report
 
     def install(self, signum: int = signal.SIGUSR2) -> None:
         """SIGUSR2 -> dump + comparison to stderr (ref: debugger.go

@@ -171,6 +171,50 @@ class GangManager:
                 sum(1 for g in self._gangs.values() if g.waiting),
                 stage="permit")
 
+    def min_member(self, gkey: str) -> Optional[int]:
+        """Public minMember lookup (None while the PodGroup is absent)."""
+        return self._min_member(gkey)
+
+    def pending_members(self, gkey: str) -> List[Pod]:
+        """The gang's pending (incl. parked) member pods in sorted-key
+        order — whole-gang preemption's placement list."""
+        with self._lock:
+            g = self._gangs.get(gkey)
+            if g is None:
+                return []
+            return [g.pending[k] for k in sorted(g.pending)]
+
+    def demand_shapes(self) -> List[dict]:
+        """Every stuck gang as a capacity-demand SHAPE: minMember x the
+        representative member request x one ICI domain (topology key).
+        The autoscaler's scale-up signal and /debug/pending's parked-gang
+        report both read this — a parked gang is not just a queue state,
+        it is a slice the cluster does not have."""
+        from .nodeinfo import pod_resource
+        out: List[dict] = []
+        with self._lock:
+            for gkey in sorted(self._gangs):
+                g = self._gangs[gkey]
+                if not g.pending:
+                    continue
+                pg = self._spec(gkey)
+                if pg is None:
+                    continue
+                rep = g.pending[sorted(g.pending)[0]]
+                r = pod_resource(rep)
+                out.append({
+                    "gang": gkey,
+                    "min_member": max(1, pg.spec.min_member),
+                    "pending": len(g.pending),
+                    "parked": len(g.parked),
+                    "reserved": g.reserved_count(),
+                    "members": sorted(g.pending),
+                    "topology_key": pg.spec.topology_key,
+                    "cpu_m": r.milli_cpu,
+                    "memory": r.memory,
+                    "scalars": dict(r.scalar_resources)})
+        return out
+
     # ------------------------------------------------------ queue hooks
 
     def pod_pending(self, pod: Pod) -> List[str]:

@@ -113,6 +113,13 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
     P = per_pod["seq"].shape[0]
     dom_tab = gang_tab["dom_tab"]
     rows = jnp.arange(N, dtype=jnp.int32)
+    # capacity-aware per-domain feasibility (gang_tab need/greq present):
+    # at each gang boundary, a domain is ELIGIBLE only when its nodes'
+    # member-slots against committed usage cover the whole gang — the
+    # first placed member can no longer pin the gang into a domain that
+    # cannot hold everyone. Absent keys keep the greedy-pin behavior
+    # (hand-built fixtures, older callers).
+    has_cap = "need" in gang_tab
     soft = _soft_tables(pod_batch)
     has_soft = soft is not None
     if has_soft:
@@ -122,7 +129,7 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
                "count": jnp.zeros_like(usage["pod_count"])}
 
     def one_entry(carry, e):
-        committed, trial, gang_dom, gang_ok = carry
+        committed, trial, gang_dom, gang_ok, gang_elig = carry
         # gang boundary: open a fresh trial window over committed state
         fresh = e["start"]
         trial = {k: jnp.where(fresh, committed[k], trial[k])
@@ -140,9 +147,49 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         # pinned a domain — inside that domain
         constrained = e["dom_idx"] >= 0
         dom_row = dom_tab[jnp.maximum(e["dom_idx"], 0)]
+        if has_cap:
+            # per-node member-slots against COMMITTED usage (f32 floors,
+            # mirrored by the oracle), summed per domain; eligibility =
+            # the domain holds the whole gang. Applied at the boundary of
+            # constrained, un-pinned gangs; when NO domain passes, fall
+            # back to the greedy pin so feasibility never regresses.
+            greq = e["greq"]
+            qmask = greq > 0
+            # the nominated phantom overlay counts here exactly like the
+            # per-member fit (eff_used below): a domain whose free space
+            # is shielded by preemptors' reservations cannot hold this
+            # gang. (A gang holding its OWN nominations may see its
+            # reserved domain as full — the any-eligible fallback, or an
+            # honestly eligible other domain, still places it, and its
+            # per-member self-credit applies at fit time.)
+            free = node_cfg["alloc"] - (committed["used"] + nom["used"])
+            per = jnp.where(
+                qmask[None, :],
+                jnp.floor(free / jnp.maximum(greq, jnp.float32(1e-9))
+                          [None, :]),
+                jnp.float32(jnp.inf))
+            slots = jnp.minimum(
+                per.min(axis=1),
+                jnp.floor(node_cfg["max_pods"]
+                          - (committed["pod_count"] + nom["count"])))
+            slots = jnp.maximum(slots, jnp.float32(0.0))
+            ok_node = node_cfg["node_ok"] & node_cfg["valid"] \
+                & (dom_row >= 0)
+            slots = jnp.where(ok_node, slots, jnp.float32(0.0))
+            domcap = jnp.zeros((N,), jnp.float32).at[
+                jnp.where(dom_row >= 0, dom_row, N)].add(
+                    slots, mode="drop")
+            elig = (domcap[jnp.maximum(dom_row, 0)] >= e["need"]) \
+                & (dom_row >= 0)
+            apply_f = constrained & (e["pin_dom"] < 0) \
+                & (e["need"] > 0) & elig.any()
+            gang_elig = jnp.where(fresh,
+                                  jnp.where(apply_f, elig, True),
+                                  gang_elig)
         dmask = jnp.where(constrained,
                           (dom_row >= 0) & ((gang_dom < 0)
-                                            | (dom_row == gang_dom)),
+                                            | (dom_row == gang_dom))
+                          & gang_elig,
                           True)
         # phantom nominated usage shields preemption's freed space, minus
         # the pod's own reservation at its nominated row (batch.py's
@@ -188,7 +235,7 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         committed = {k: jnp.where(commit, trial[k], committed[k])
                      for k in committed}
         assign = jnp.where(ok, best, jnp.int32(-1))
-        return ((committed, trial, gang_dom, gang_ok),
+        return ((committed, trial, gang_dom, gang_ok, gang_elig),
                 (assign, masked[best], gang_ok))
 
     usage0 = {"used": usage["used"], "nonzero_used": usage["nonzero_used"],
@@ -197,10 +244,14 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
         # chained launches seed from the predecessor's committed finals
         sc0 = usage.get("soft_cnt")
         usage0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
-    carry0 = (usage0, usage0, jnp.int32(-1), jnp.bool_(True))
+    carry0 = (usage0, usage0, jnp.int32(-1), jnp.bool_(True),
+              jnp.ones((N,), bool))
     entries = {"pod_idx": gang_tab["pod_idx"], "start": gang_tab["start"],
                "end": gang_tab["end"], "dom_idx": gang_tab["entry_dom_idx"],
                "pin_dom": gang_tab["pin_dom"]}
+    if has_cap:
+        entries["need"] = gang_tab["need"]
+        entries["greq"] = gang_tab["greq"]
     T = entries["pod_idx"].shape[0]
     G = min(1 << (max(1, _STEP_GROUP_GANG).bit_length() - 1), T)
 
@@ -215,7 +266,7 @@ def gang_schedule_batch(node_cfg: dict, usage: dict, pod_batch: dict,
 
     entries_g = {k: v.reshape((T // G, G) + v.shape[1:])
                  for k, v in entries.items()}
-    (committed, _, _, _), (assign_e, score_e, ok_e) = lax.scan(
+    (committed, _, _, _, _), (assign_e, score_e, ok_e) = lax.scan(
         step, carry0, entries_g)
     assign_e = assign_e.reshape(T)
     score_e = score_e.reshape(T)
@@ -303,7 +354,8 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
     assign = np.full((P,), -1, np.int32)
     scores = np.full((P,), NEG32, np.float32)
 
-    # regroup the flattened entry stream back into units
+    # regroup the flattened entry stream back into units (keeping each
+    # unit's start-entry index for the capacity-feasibility inputs)
     units: list = []
     gid = np.asarray(gang_tab["gang_id"])
     pod_idx = np.asarray(gang_tab["pod_idx"])
@@ -312,10 +364,14 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
     for t in range(len(pod_idx)):
         if gang_tab["start"][t]:
             units.append(([], int(entry_dom[t]), int(pin_dom[t]),
-                          int(gid[t])))
+                          int(gid[t]), t))
         units[-1][0].append(int(pod_idx[t]))
+    has_cap = "need" in gang_tab
+    if has_cap:
+        cap_need = np.asarray(gang_tab["need"], np.float32)
+        cap_greq = np.asarray(gang_tab["greq"], np.float32)
 
-    for members, dom_idx, pin, _ in units:
+    for members, dom_idx, pin, _, t_start in units:
         trial_used = used.copy()
         trial_nz = nz.copy()
         trial_cnt = cnt.copy()
@@ -324,12 +380,37 @@ def gang_schedule_reference(node_cfg: Dict[str, np.ndarray],
         gang_ok = True
         placed: list = []
         dom_row = dom_tab[max(dom_idx, 0)]
+        gang_elig = np.ones((N,), bool)
+        if has_cap and dom_idx >= 0 and pin < 0 \
+                and cap_need[t_start] > 0:
+            # capacity-aware per-domain feasibility — the kernel's
+            # boundary reduction, same f32 op order
+            greq = cap_greq[t_start]
+            qmask = greq > 0
+            free = alloc - (used + nom_used)
+            per = np.where(qmask[None, :],
+                           np.floor(free / np.maximum(
+                               greq, np.float32(1e-9))[None, :]),
+                           np.float32(np.inf))
+            slots = np.minimum(per.min(axis=1),
+                               np.floor(max_pods - (cnt + nom_cnt)))
+            slots = np.maximum(slots, np.float32(0.0))
+            ok_node = node_ok & node_valid & (dom_row >= 0)
+            slots = np.where(ok_node, slots, np.float32(0.0))
+            domcap = np.zeros((N,), np.float32)
+            np.add.at(domcap, dom_row[dom_row >= 0],
+                      slots[dom_row >= 0])
+            elig = (domcap[np.maximum(dom_row, 0)] >= cap_need[t_start]) \
+                & (dom_row >= 0)
+            if elig.any():
+                gang_elig = elig
         for i in members:
             if i < 0:
                 continue
             if dom_idx >= 0:
                 dmask = (dom_row >= 0) & ((gang_dom < 0)
-                                          | (dom_row == gang_dom))
+                                          | (dom_row == gang_dom)) \
+                    & gang_elig
             else:
                 dmask = np.ones((N,), bool)
             eff_used = trial_used + nom_used

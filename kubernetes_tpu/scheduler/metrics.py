@@ -58,6 +58,14 @@ class SchedulerMetrics:
         self.preemption_victims = r.counter(
             "scheduler_preemption_victims_total",
             "Pods evicted by preemption")
+        # gang members no longer skip preemption silently: each failed
+        # attempt by a gang member routes to WHOLE-GANG preemption
+        # (price minMember placements against one ICI domain) and is
+        # counted here — the old skip path's disappearance is observable
+        self.preemption_gang_routed = r.counter(
+            "scheduler_preemption_gang_routed_total",
+            "Unschedulable gang members routed to whole-gang preemption "
+            "(previously skipped outright)")
         self.pod_scheduling_errors = r.counter(
             "scheduler_pod_scheduling_errors_total",
             "Pods that failed a scheduling cycle with an error")

@@ -43,6 +43,18 @@ class PreemptionPlan:
     nominated_to_clear: List[Pod] = field(default_factory=list)
 
 
+@dataclass
+class GangPreemptionPlan:
+    """Whole-gang preemption (kernels/preempt.py price_domains): evict
+    `victims` (whole PodGroups expanded) and nominate each member to its
+    node inside the winning ICI domain — the freed space is shielded by
+    the nominated-reservation overlay until the gang binds."""
+    domain: str
+    victims: List[Pod]
+    nominations: List[Tuple[Pod, str]]   # (member, node name)
+    num_pdb_violations: int
+
+
 def pod_eligible_to_preempt_others(pod: Pod,
                                    node_infos: Dict[str, NodeInfo]) -> bool:
     """Ref: podEligibleToPreemptOthers (:1130-1150) — a pod that already

@@ -217,6 +217,10 @@ class Scheduler:
             self.robustness = RobustnessMetrics(self.metrics.registry)
         except ValueError:
             self.robustness = RobustnessMetrics()
+        from ..utils.errlog import SwallowedErrors
+        #: handled-and-dropped failures on the preemption write paths
+        #: (KTPU001 contract: log the first of a streak, count every one)
+        self._swallowed = SwallowedErrors("scheduler", self.robustness)
 
         def _node_label(node_name, label_key):
             ni = self.algorithm.snapshot.node_infos.get(node_name)
@@ -251,7 +255,6 @@ class Scheduler:
         self.algorithm.tracer = self.tracer
         self.scheduled_count = 0
         self.unschedulable_count = 0
-        self.preemption_count = 0
         self._add_all_event_handlers()
 
     # ------------------------------------------------- event handlers
@@ -1407,9 +1410,12 @@ class Scheduler:
         if self.disable_preemption:
             return
         if self.gang is not None and self.gang.is_member(pod):
-            # single-member preemption cannot help a gang (evicting for one
-            # worker leaves the gang short anyway) — whole-gang preemption
-            # is an open roadmap item
+            # single-member preemption cannot help a gang (evicting for
+            # one worker leaves the gang short anyway) — route the WHOLE
+            # gang through the domain-pricing kernel instead, and count
+            # the routing so the old silent skip's disappearance shows
+            self.metrics.preemption_gang_routed.inc()
+            self._try_preempt_gang(pod)
             return
         try:
             plan = self.algorithm.preempt(pod)
@@ -1454,7 +1460,82 @@ class Scheduler:
                     victim.metadata.name)
             except Exception:
                 pass
-        self.preemption_count += 1
+
+    def _try_preempt_gang(self, pod: Pod) -> None:
+        """Whole-gang preemption (ROADMAP direction 3): a parked gang is
+        a demand SHAPE — minMember placements of the member request
+        inside one ICI domain. Price every domain with the victim-
+        pricing kernel (core.preempt_gang), evict the chosen units
+        (whole PodGroups — evicting 1 of 4 workers buys nothing), and
+        nominate every member across the freed nodes so the
+        nominated-reservation overlay holds the slice until the gang's
+        members drain through the queue."""
+        from ..api.scheduling import pod_group_key
+        gkey = pod_group_key(pod)
+        if gkey is None or self.gang is None:
+            return
+        members = self.gang.pending_members(gkey)
+        if not members:
+            return
+        mm = self.gang.min_member(gkey)
+        if mm is None:
+            return  # PodGroup object gone; members park until it returns
+        # a standing nomination set means an earlier attempt already
+        # priced this gang and its victims are still terminating — wait
+        # for the deletions to reach the cache instead of re-evicting.
+        # The bar is min(minMember, members): a plan nominates at most
+        # that many (slot-limited domains, members arriving late), so
+        # demanding ALL members would re-price (and re-evict) every cycle
+        infos = self.algorithm.snapshot.node_infos
+        from .preemption import node_could_ever_fit
+        standing = 0
+        for m in members:
+            nn = self.queue.nominated.node_for(m.metadata.key())
+            if nn:
+                ni = infos.get(nn)
+                if ni is not None and node_could_ever_fit(m, ni):
+                    standing += 1
+                else:
+                    self.queue.nominated.delete(m)
+        if standing >= min(mm, len(members)):
+            return
+        try:
+            plan = self.algorithm.preempt_gang(members, mm,
+                                               self.gang.topology_key(gkey))
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return
+        if plan is None:
+            return
+        for member, node_name in plan.nominations:
+            def set_nominated(cur, node_name=node_name):
+                cur.status.nominated_node_name = node_name
+                return cur
+            try:
+                updated = self.client.pods(member.metadata.namespace).patch(
+                    member.metadata.name, set_nominated)
+                self._swallowed.ok("gang_nominate")
+            except Exception as e:
+                # member vanished mid-plan; the rest still nominate
+                self._swallowed.swallow("gang_nominate", e)
+                continue
+            self.queue.nominated.add(updated, node_name)
+            self.queue.update(member, updated)
+        self.metrics.preemption_attempts.inc()
+        self.metrics.preemption_victims.inc(len(plan.victims))
+        for victim in plan.victims:
+            self._record_event(
+                victim, "Preempted",
+                f"Preempted by gang {gkey} for domain {plan.domain}")
+            try:
+                self.client.pods(victim.metadata.namespace).delete(
+                    victim.metadata.name)
+                self._swallowed.ok("gang_evict")
+            except Exception as e:
+                # already deleted / API fault: the eviction retries on
+                # the gang's next failed attempt
+                self._swallowed.swallow("gang_evict", e)
 
     def _record_event(self, pod: Pod, reason: str, message: str) -> None:
         """Ref: client-go tools/record EventRecorder -> apiserver Events;

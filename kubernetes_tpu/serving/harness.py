@@ -86,7 +86,10 @@ class ServingHarness:
                  reset_rate: float = 0.0,
                  latency_rate: float = 0.0,
                  latency_max: float = 0.002,
-                 watch_drop_rate: float = 0.0):
+                 watch_drop_rate: float = 0.0,
+                 autoscaler: bool = False,
+                 autoscaler_cooldown: float = 60.0,
+                 autoscaler_max_nodes: int = 64):
         self.seed = seed
         self.n_nodes = nodes
         self.tick_s = tick_s
@@ -149,6 +152,24 @@ class ServingHarness:
                             watch_drop_rate))
         #: carried across scheduler restarts (the log lives on the shell)
         self._batch_caps: List[Tuple] = []
+        #: gang-aware capacity management under sustained load: same
+        #: deterministic stepping contract as the chaos harness
+        self.autoscaler = None
+        self._ca_factory = None
+        if autoscaler:
+            from ..autoscaler import ClusterAutoscaler, \
+                scheduler_demand_source
+            self._ca_factory = SharedInformerFactory(self.client)
+            self.autoscaler = ClusterAutoscaler(
+                self.client, self._ca_factory,
+                demand_source=scheduler_demand_source(
+                    lambda: self.scheduler),
+                clock=self.clock, cooldown=autoscaler_cooldown,
+                max_nodes=autoscaler_max_nodes,
+                node_cpu=self.node_cpu, node_mem=self.node_mem,
+                robustness=self.metrics,
+                # virtual kubelets own heartbeats in the harness
+                maintain_heartbeats=False)
 
     # ------------------------------------------------------------ build
 
@@ -170,7 +191,8 @@ class ServingHarness:
                                           clock=self.clock)
 
     def _factories(self) -> List[SharedInformerFactory]:
-        return [self.factory, self._sched_factory]
+        extra = [self._ca_factory] if self._ca_factory is not None else []
+        return [self.factory, self._sched_factory] + extra
 
     def start(self) -> None:
         if self._started:
@@ -290,6 +312,11 @@ class ServingHarness:
             # an injected fault mid-cycle: retries next tick
         self.scheduler.cache.cleanup_expired_assumed_pods()
         self._settle()
+        if self.autoscaler is not None:
+            # after the drain so demand reflects this tick's failed
+            # attempts; step() swallows-and-counts its own API faults
+            self.autoscaler.step()
+            self._settle()
         self._virtual_kubelets()
         self._settle()
         # deterministic SLO observation: the settled store, sorted keys

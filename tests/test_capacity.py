@@ -1,0 +1,368 @@
+"""Gang-aware capacity management: the ClusterAutoscaler subsystem and
+the capacity chaos soak (ISSUE 15 tentpole B + test satellites).
+
+Covers: demand-shape derivation (GangManager + UnschedulableAttribution
+through scheduler_demand_source, informer fallback), whole-slice
+provisioning through the normal client, cooldown scale-down, the
+/debug/pending surfaces, FakeClock determinism inside ChaosHarness
+(parked gang binds via an autoscaler-provisioned slice, same seed =>
+identical outcomes), and the preemption-storm chaos soak (slow).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.api.scheduling import PodGroup, PodGroupSpec
+from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
+from kubernetes_tpu.autoscaler import (ClusterAutoscaler,
+                                       GROUP_ANNOTATION,
+                                       PROVISIONED_LABEL,
+                                       scheduler_demand_source)
+from kubernetes_tpu.chaos import ChaosHarness
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.utils.clock import FakeClock
+
+SLICE = "tpu/slice"
+
+
+def make_gang(client, name, size, cpu="2", mem="1Gi", ns="default",
+              priority=None):
+    client.pod_groups(ns).create(PodGroup(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=PodGroupSpec(min_member=size, topology_key=SLICE)))
+    for i in range(size):
+        client.pods(ns).create(api.Pod(
+            metadata=api.ObjectMeta(
+                name=f"{name}-w{i}", namespace=ns,
+                labels={LABEL_POD_GROUP: name}),
+            spec=api.PodSpec(
+                priority=priority,
+                containers=[api.Container(
+                    name="c", image="img",
+                    resources=api.ResourceRequirements(
+                        requests={"cpu": Quantity(cpu),
+                                  "memory": Quantity(mem)}))])))
+
+
+def settle(informers, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        time.sleep(0.05)
+        return
+
+
+class TestClusterAutoscaler:
+    def _ca(self, client, **kw):
+        kw.setdefault("clock", FakeClock())
+        kw.setdefault("pending_threshold", 5.0)
+        kw.setdefault("cooldown", 10.0)
+        ca = ClusterAutoscaler(client, **kw)
+        ca.informers.start()
+        ca.informers.wait_for_cache_sync()
+        return ca
+
+    def test_provisions_whole_slice_for_parked_gang(self):
+        """ceil(minMember / slots-per-node) nodes, created through the
+        normal client, all sharing ONE fresh topology-domain value."""
+        client = Client()
+        ca = self._ca(client)
+        try:
+            make_gang(client, "g1", 4, cpu="2", mem="4Gi")
+            time.sleep(0.3)
+            ca.step()                 # first sight: not ripe yet
+            assert client.nodes().list() == []
+            ca.clock.step(6.0)
+            ca.step()
+            time.sleep(0.3)
+            nodes = client.nodes().list()
+            # template 4cpu/32Gi -> 2 member-slots -> 2 nodes
+            assert len(nodes) == 2
+            doms = {n.metadata.labels.get(SLICE) for n in nodes}
+            assert len(doms) == 1 and doms.pop().startswith("ca-slice-")
+            for n in nodes:
+                assert n.metadata.labels[PROVISIONED_LABEL] == "true"
+                assert n.metadata.annotations[GROUP_ANNOTATION] == \
+                    "default/g1"
+            assert ca.metrics.slices_provisioned.value() == 1
+            assert ca.last_decision["action"] == "scale_up"
+            # idempotent: demand still parked, slice in flight -> no
+            # second slice
+            ca.clock.step(1.0)
+            ca.step()
+            time.sleep(0.2)
+            assert len(client.nodes().list()) == 2
+        finally:
+            ca.informers.stop()
+
+    def test_scaledown_after_cooldown_only_without_demand(self):
+        """An empty provisioned node survives while its gang's demand is
+        live, and is reaped `cooldown` after the demand clears."""
+        client = Client()
+        ca = self._ca(client)
+        try:
+            make_gang(client, "g1", 2, cpu="2", mem="4Gi")
+            time.sleep(0.3)
+            ca.step()
+            ca.clock.step(6.0)
+            ca.step()
+            time.sleep(0.3)
+            assert len(client.nodes().list()) == 1
+            # demand still live: cooldown never starts
+            ca.clock.step(30.0)
+            ca.step()
+            time.sleep(0.2)
+            assert len(client.nodes().list()) == 1
+            # gang resolves (members deleted) -> cooldown -> reap
+            for i in range(2):
+                client.pods("default").delete(f"g1-w{i}")
+            time.sleep(0.3)
+            ca.step()
+            ca.clock.step(11.0)
+            ca.step()
+            time.sleep(0.3)
+            assert client.nodes().list() == []
+            assert ca.metrics.scaledown_nodes.value() == 1
+            assert ca.last_decision["action"] == "scale_down"
+        finally:
+            ca.informers.stop()
+
+    def test_max_nodes_refusal_is_recorded(self):
+        """Bounded provisioning is a visible decision, not a silent cap."""
+        client = Client()
+        ca = self._ca(client, max_nodes=1)
+        try:
+            make_gang(client, "g1", 4, cpu="2", mem="4Gi")
+            time.sleep(0.3)
+            ca.step()
+            ca.clock.step(6.0)
+            ca.step()
+            time.sleep(0.2)
+            assert client.nodes().list() == []
+            assert ca.last_decision["action"] == "skip"
+            assert "max_nodes" in ca.last_decision["reason"]
+            # the unsatisfied demand stays on the gauge
+            assert ca.metrics.parked_demand.value() == 4
+        finally:
+            ca.informers.stop()
+
+    def test_oversized_member_is_a_recorded_skip(self):
+        client = Client()
+        ca = self._ca(client)
+        try:
+            make_gang(client, "g1", 2, cpu="64", mem="4Gi")
+            time.sleep(0.3)
+            ca.step()
+            ca.clock.step(6.0)
+            ca.step()
+            assert client.nodes().list() == []
+            assert ca.last_decision["action"] == "skip"
+            assert "template" in ca.last_decision["reason"]
+        finally:
+            ca.informers.stop()
+
+    def test_pending_report_surface(self):
+        """pending_report carries the demand shapes and last decision —
+        the /debug/pending payload."""
+        client = Client()
+        ca = self._ca(client)
+        try:
+            make_gang(client, "g1", 2, cpu="2", mem="4Gi")
+            time.sleep(0.3)
+            ca.step()
+            ca.clock.step(6.0)
+            ca.step()
+            time.sleep(0.2)
+            rep = ca.pending_report()
+            assert rep["component"] == "clusterautoscaler"
+            assert rep["demand"][0]["gang"] == "default/g1"
+            assert rep["demand"][0]["min_member"] == 2
+            assert "members" not in rep["demand"][0]
+            assert rep["lastDecision"]["action"] == "scale_up"
+            assert rep["provisioned"]["default/g1"]["nodes"]
+        finally:
+            ca.informers.stop()
+
+
+class TestDebugPendingSurface:
+    def test_gang_demand_and_autoscaler_decision_on_the_wire(self):
+        """GET /debug/pending carries the scheduler's parked-gang demand
+        shapes AND the autoscaler's last provisioning decision."""
+        import json as jsonmod
+        import urllib.request
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        from kubernetes_tpu.state import Store
+        store = Store()
+        server = APIServer(store=store).start()
+        client = Client(store)
+        sched = Scheduler(client, batch_size=8)
+        ca = ClusterAutoscaler(client, pending_threshold=0.0,
+                               clock=FakeClock())
+        server.pending_providers.append(sched.debugger.pending_report)
+        server.pending_providers.append(ca.pending_report)
+        sched.start()
+        ca.informers.start()
+        ca.informers.wait_for_cache_sync()
+        try:
+            make_gang(client, "g1", 2, cpu="64", mem="4Gi")
+            deadline = time.time() + 20
+            demand = []
+            while time.time() < deadline:
+                demand = sched.gang.demand_shapes()
+                if demand:
+                    break
+                time.sleep(0.05)
+            assert demand
+            ca.clock.step(1.0)
+            ca.step()
+            with urllib.request.urlopen(
+                    server.address + "/debug/pending", timeout=10) as r:
+                body = jsonmod.loads(r.read().decode())
+            reports = {rep.get("component"): rep
+                       for rep in body["pending"]}
+            sched_rep = reports["default-scheduler"]
+            assert sched_rep["gangDemand"][0]["gang"] == "default/g1"
+            assert sched_rep["gangDemand"][0]["min_member"] == 2
+            ca_rep = reports["clusterautoscaler"]
+            assert ca_rep["lastDecision"]["action"] == "skip"
+            assert ca_rep["demand"][0]["gang"] == "default/g1"
+        finally:
+            sched.stop()
+            ca.informers.stop()
+            server.stop()
+
+
+class TestSchedulerDemandSource:
+    def test_attribution_gates_ripeness(self):
+        """Only gangs the scheduler has FAILED to place (a member with a
+        real attribution reason) present demand; the PodGroupNotReady
+        park (missing members) does not."""
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+        client = Client()
+        client.nodes().create(api.Node(
+            metadata=api.ObjectMeta(name="n0"),
+            status=api.NodeStatus(
+                capacity={"cpu": Quantity("1"),
+                          "memory": Quantity("1Gi"),
+                          "pods": Quantity("10")},
+                allocatable={"cpu": Quantity("1"),
+                             "memory": Quantity("1Gi"),
+                             "pods": Quantity("10")},
+                conditions=[api.NodeCondition(type="Ready",
+                                              status="True")])))
+        sched = Scheduler(client, batch_size=8)
+        source = scheduler_demand_source(lambda: sched)
+        sched.start()
+        try:
+            # a gang the cluster cannot hold: members attempt and fail
+            make_gang(client, "g1", 2, cpu="2", mem="4Gi")
+            deadline = time.time() + 20
+            shapes = []
+            while time.time() < deadline:
+                shapes = source()
+                if shapes:
+                    break
+                time.sleep(0.05)
+            assert shapes and shapes[0]["gang"] == "default/g1"
+            assert shapes[0]["min_member"] == 2
+            assert shapes[0]["cpu_m"] == 2000
+            assert shapes[0]["topology_key"] == SLICE
+            assert shapes[0]["reason"]
+            # below-minMember gang: parked, never attempted -> no demand
+            make_gang(client, "g2", 3, cpu="2", mem="4Gi")
+            client.pods("default").delete("g2-w2")
+            time.sleep(0.5)
+            gangs = {s["gang"] for s in source()}
+            assert "default/g2" not in gangs
+        finally:
+            sched.stop()
+
+
+class TestHarnessCapacity:
+    def _run_once(self, seed=3):
+        """Overcommitted ChaosHarness: a gang no existing slice can hold
+        binds via an autoscaler-provisioned slice, deterministically."""
+        h = ChaosHarness(seed=seed, nodes=4, nodes_per_slice=2,
+                         error_rate=0.0, autoscaler=True,
+                         autoscaler_cooldown=120.0, clock_step=5.0)
+        try:
+            h.start()
+            # 6 members x 3cpu: existing nodes (4cpu) hold 1 each across
+            # TWO 2-node slices — no single ICI domain can ever hold it
+            h._create_gang(6, 3000)
+            for step in range(24):
+                h.injector.advance(step)
+                h._tick()
+            pods = h.admin.pods().list(namespace=None)
+            gang_nodes = sorted(p.spec.node_name for p in pods
+                                if p.metadata.name.startswith("gang-1-"))
+            nodes = {n.metadata.name: n.metadata.labels
+                     for n in h.admin.nodes().list()}
+            from kubernetes_tpu.chaos.invariants import InvariantChecker
+            violations = InvariantChecker(
+                h.admin, scheduler=h.scheduler).check()
+            events = list(h.injector.events)
+            return gang_nodes, nodes, violations, events
+        finally:
+            h.close()
+
+    def test_parked_gang_binds_on_provisioned_slice(self):
+        gang_nodes, nodes, violations, _ = self._run_once()
+        assert violations == []
+        assert len(gang_nodes) == 6 and all(gang_nodes)
+        # every member landed on autoscaler-provisioned nodes sharing
+        # exactly one ICI domain
+        doms = {nodes[n].get(SLICE) for n in gang_nodes}
+        assert len(doms) == 1
+        assert doms.pop().startswith("ca-slice-")
+        for n in set(gang_nodes):
+            assert nodes[n].get(PROVISIONED_LABEL) == "true"
+
+    def test_same_seed_identical_outcome(self):
+        a = self._run_once(seed=11)
+        b = self._run_once(seed=11)
+        assert a == b
+
+    def test_preempt_storm_smoke(self):
+        """Tier-1 slice of the storm soak: mixed priority bands over an
+        overcommitted cluster, invariants green, identical event logs on
+        a same-seed rerun, and no partially-bound PodGroup survives (the
+        whole-group eviction contract)."""
+        logs = []
+        for _ in range(2):
+            h = ChaosHarness(seed=5, nodes=6, nodes_per_slice=3,
+                             error_rate=0.02, preempt_storm=True)
+            try:
+                report = h.run(n_events=40, quiesce_steps=20)
+                assert report.ok, report.violations
+                logs.append(report.events)
+            finally:
+                h.close()
+        assert logs[0] == logs[1]
+
+    @pytest.mark.slow
+    def test_preemption_storm_soak(self):
+        """The ISSUE 15 soak: a preemption storm (mixed priority bands,
+        arriving gangs) mixed with kill_leader + node crashes + the
+        autoscaler, 300 events. InvariantChecker green (which includes
+        no-partially-bound-PodGroup — a partially-EVICTED group would
+        trip it), zero double-binds, deterministic."""
+        logs = []
+        for _ in range(2):
+            h = ChaosHarness(seed=23, nodes=8, nodes_per_slice=4,
+                             error_rate=0.05, preempt_storm=True,
+                             ha=True, with_restarts=True,
+                             autoscaler=True,
+                             autoscaler_cooldown=300.0,
+                             autoscaler_max_nodes=24)
+            try:
+                report = h.run(n_events=300, quiesce_steps=40)
+                assert report.ok, report.violations
+                assert report.pods_bound > 0
+                logs.append(report.events)
+            finally:
+                h.close()
+        assert logs[0] == logs[1]

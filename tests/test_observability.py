@@ -209,6 +209,7 @@ class TestMetricsRegistry:
         scheduler's) registers into one MetricsRegistry with no
         signature collisions, and every family it declares reaches the
         exposition."""
+        from kubernetes_tpu.autoscaler import AutoscalerMetrics
         from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
         classes = [obj for name, obj in
                    inspect.getmembers(metrics_mod, inspect.isclass)
@@ -216,7 +217,7 @@ class TestMetricsRegistry:
         assert len(classes) >= 5  # Gang/Informer/Robustness/Serving/APIServer
         mr = MetricsRegistry()
         declared = set()
-        for cls in classes + [SchedulerMetrics]:
+        for cls in classes + [SchedulerMetrics, AutoscalerMetrics]:
             inst = cls()
             mr.add_registry(cls.__name__, inst.registry)
             with inst.registry._lock:

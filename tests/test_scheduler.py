@@ -854,7 +854,9 @@ class TestPreemption:
             # the victim is gone
             names = [p.metadata.name for p in client.pods().list()]
             assert "low" not in names
-            assert sched.preemption_count == 1
+            # the bare preemption_count attribute is gone: the registry
+            # family is the one source of preemption accounting
+            assert sched.metrics.preemption_attempts.value() == 1
             events = client.events("default").list()
             assert any(e.reason == "Preempted" for e in events)
         finally:
@@ -1025,6 +1027,9 @@ class TestPreemptionCostBound:
         import time as _t
         cache = self._full_cluster(5000)
         sched = BatchScheduler(cache)
+        # pin the SERIAL path: the cap + proxy under test here are its
+        # cost bound (the kernel path has no cap — tests/test_preempt.py)
+        sched.preempt_kernel = False
         sched.refresh()
         start = _t.time()
         n_preempted = 0
@@ -1051,6 +1056,7 @@ class TestPreemptionCostBound:
             cache.add_pod(make_pod(f"v{i}", cpu="800m", priority=prio,
                                    node=f"n{i}"))
         sched = BatchScheduler(cache)
+        sched.preempt_kernel = False  # the cap is a serial-path concept
         sched.refresh()
         assert sched.PREEMPT_CANDIDATE_CAP < 150
         plan = sched.preempt(make_pod("hp", cpu="500m", priority=100))
@@ -1088,6 +1094,8 @@ class TestPreemptionProxyEquivalence:
 
     def _plan(self, cache, cap):
         sched = BatchScheduler(cache)
+        # the proxy ranking under test only exists on the serial path
+        sched.preempt_kernel = False
         sched.PREEMPT_CANDIDATE_CAP = cap
         sched.refresh()
         # 1800m on 2000m nodes with >=400m always in use: the preemptor
@@ -1126,6 +1134,7 @@ class TestPreemptionProxyEquivalence:
                 selector=api.LabelSelector(match_labels={"app": "db"})))
         pdb.status.disruptions_allowed = 0
         sched = BatchScheduler(cache, pdb_lister=lambda: [pdb])
+        sched.preempt_kernel = False
         sched.PREEMPT_CANDIDATE_CAP = 1  # the proxy ALONE picks the pool
         sched.refresh()
         plan = sched.preempt(make_pod("boss", cpu="500m", priority=100))
