@@ -1,7 +1,7 @@
 # Convenience entries (the reference's hack/ equivalents).
 
 .PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
-	bench-preempt
+	bench-preempt bench-tenancy
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -34,3 +34,10 @@ bench-affinity:
 # (BENCH_r09's source)
 bench-preempt:
 	JAX_PLATFORMS=cpu python bench.py preempt
+
+# tenant-isolation bench: one abusive tenant's gang storm vs nine
+# steady tenants with DRF + active-gang quota on, the no-tenancy
+# control (KTPU_DRF=0, no quota), and DRF kernel-vs-oracle ordering
+# parity (BENCH_r10's source)
+bench-tenancy:
+	JAX_PLATFORMS=cpu python bench.py tenancy

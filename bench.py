@@ -1796,6 +1796,140 @@ def preempt_main():
     }))
 
 
+def tenancy_main():
+    """`bench.py tenancy` — the multi-tenant isolation bench (ISSUE 16).
+    Sections of the JSON line:
+
+      - isolation: the acceptance drill — one abusive tenant floods
+        gangs from a quota-capped namespace while nine tenants serve a
+        steady mix; with DRF + quota on, every steady tenant's p99 bind
+        latency stays within 1.5x of the same-seed no-abuse baseline.
+        KTPU_DRF=0 is the control.
+      - parity: randomized DRF batch ordering, device kernel vs the
+        serial numpy oracle — identical-permutation rate (bit-identity
+        acceptance, 1.0)
+      - gate: the gang-quota gate's view of the abuse namespace after
+        the storm (active <= limit)
+    """
+    import numpy as np
+    from kubernetes_tpu.tenancy import (ACTIVE_GANGS_KEY, DRFAccount,
+                                        TENANT_LABEL)
+
+    TENANTS = int(os.environ.get("BENCH_TENANCY_TENANTS", "9"))
+    EVENTS = int(os.environ.get("BENCH_TENANCY_EVENTS", "160"))
+    ABUSE = int(os.environ.get("BENCH_TENANCY_ABUSE_EVENTS", "60"))
+
+    def run_serving(abuse, drf, quota=True):
+        from kubernetes_tpu.serving.harness import ServingHarness
+        old = os.environ.get("KTPU_DRF")
+        os.environ["KTPU_DRF"] = "1" if drf else "0"
+        try:
+            h = ServingHarness(
+                seed=11, nodes=8, rate=12.0, tenants=TENANTS,
+                mix=(("singleton", 0.5), ("priority", 0.3),
+                     ("job", 0.2)),
+                quotas={"abuse": {ACTIVE_GANGS_KEY: "1"}}
+                if quota else None,
+                abuse_rate=16.0 if abuse else 0.0,
+                abuse_gang_sizes=(4, 6), gang_run_ticks=4)
+            try:
+                rep = h.run(n_events=EVENTS, max_ticks=600,
+                            quiesce_ticks=10,
+                            abuse_events=ABUSE if abuse else 0)
+                gate = h.scheduler.gang_quota.report()
+                return rep, gate
+            finally:
+                h.close()
+        finally:
+            if old is None:
+                os.environ.pop("KTPU_DRF", None)
+            else:
+                os.environ["KTPU_DRF"] = old
+
+    def steady_p99(rep):
+        out = {}
+        for cls, entry in rep.tenant_slo.get("classes", {}).items():
+            if cls.startswith("tenant-") and "bind" in entry:
+                out[cls] = entry["bind"]["p99_s"]
+        return out
+
+    base_rep, _ = run_serving(abuse=False, drf=True)
+    on_rep, gate = run_serving(abuse=True, drf=True)
+    # the control: the same storm with the tenancy machinery off —
+    # no DRF ordering, no active-gang quota (pre-tenancy behavior)
+    off_rep, _ = run_serving(abuse=True, drf=False, quota=False)
+    base = steady_p99(base_rep)
+
+    def worst_ratio(rep):
+        cur = steady_p99(rep)
+        # denominator clamped to one tick: an insta-bind baseline
+        # (p99 0.0) cannot manufacture an infinite ratio
+        ratios = [cur[t] / max(base.get(t, 0.0), 1.0)
+                  for t in cur if t in base]
+        return round(max(ratios), 3) if ratios else 0.0
+
+    ratio_on = worst_ratio(on_rep)
+    ratio_off = worst_ratio(off_rep)
+    isolation = {
+        "steady_tenants": len(base),
+        "worst_p99_ratio_drf_on": ratio_on,
+        "worst_p99_ratio_drf_off": ratio_off,
+        "target": 1.5,
+        "met": bool(ratio_on <= 1.5),
+        "invariants_ok": bool(on_rep.ok),
+        "control": "KTPU_DRF=0 + no quota",
+    }
+
+    # randomized DRF ordering parity, device kernel vs numpy oracle
+    def tenant_pod(name, tenant, cpu_m, prio):
+        return api.Pod(
+            metadata=api.ObjectMeta(
+                name=name, namespace="default",
+                labels={TENANT_LABEL: tenant}),
+            spec=api.PodSpec(priority=prio, containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": Quantity(f"{cpu_m}m"),
+                              "memory": Quantity("64Mi")}))]))
+
+    rng = np.random.default_rng(2718)
+    same = total = 0
+    for trial in range(20):
+        T = int(rng.integers(2, 10))
+        acct = DRFAccount()
+        acct.set_capacity([64_000.0, float(512 << 30), 64.0])
+        for j in range(T):
+            for k in range(int(rng.integers(0, 6))):
+                acct.charge(tenant_pod(
+                    f"std-{trial}-{j}-{k}", f"t{j}",
+                    int(rng.integers(100, 4000)), 0))
+        P = int(DRFAccount.DEVICE_FLOOR + rng.integers(0, 128))
+        pods = [tenant_pod(
+            f"b-{trial}-{i}", f"t{int(rng.integers(0, T))}", 100,
+            int(rng.choice((0, 0, 0, 1000)))) for i in range(P)]
+        dev = [p.metadata.name for p in acct.order_batch(pods)]
+        ref = [p.metadata.name
+               for p in acct.order_batch_reference(pods)]
+        total += 1
+        same += int(dev == ref)
+    parity = round(same / max(total, 1), 4)
+
+    print(json.dumps({
+        "metric": f"tenant isolation worst steady-tenant p99 ratio "
+                  f"({TENANTS} steady tenants vs 1 gang-storm abuser, "
+                  f"DRF + active-gang quota on)",
+        "value": ratio_on,
+        "unit": "x_of_no_abuse_baseline",
+        "detail": {
+            "isolation": isolation,
+            "parity": {"rate": parity, "batches": total,
+                       "oracle": "tenancy/drf.py "
+                                 "drf_order_reference"},
+            "gate": gate.get("abuse", {}),
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
@@ -1805,6 +1939,8 @@ if __name__ == "__main__":
         affinity_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "preempt":
         preempt_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "tenancy":
+        tenancy_main()
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

@@ -22,7 +22,18 @@ from ..api.quantity import Quantity
 
 
 class QuotaExceeded(Exception):
-    """Maps to HTTP 403 Forbidden, like the reference's quota denial."""
+    """Maps to HTTP 403 Forbidden, like the reference's quota denial.
+
+    `namespace` and `resource_key` name the exhausted cap (the quota KEY,
+    e.g. "requests.cpu", not the REST resource) so callers — the denial
+    counter, /debug/pending attribution — can label without parsing the
+    message."""
+
+    def __init__(self, message: str, namespace: str = "",
+                 resource_key: str = ""):
+        super().__init__(message)
+        self.namespace = namespace
+        self.resource_key = resource_key
 
 
 # ---------------------------------------------------------------- evaluators
@@ -116,8 +127,12 @@ class ResourceQuotaAdmission:
     until the controller resyncs.
     """
 
-    def __init__(self, client):
+    def __init__(self, client, metrics=None):
         self.client = client
+        #: tenancy.QuotaMetrics (optional): denials counted by
+        #: {namespace, resource} so "who is hitting which cap" is a
+        #: /metrics query, not a log grep
+        self.metrics = metrics
         # per-thread record of the last request's committed charges so the
         # server can refund them if storage rejects the create AFTER
         # admission (AlreadyExists, CRD validation…) — otherwise the
@@ -169,13 +184,17 @@ class ResourceQuotaAdmission:
                 continue
             try:
                 self._charge(quota, delta, interesting)
-            except QuotaExceeded:
+            except QuotaExceeded as e:
                 # un-charge quotas already committed this request so a
                 # denial leaves no phantom usage behind (the controller
                 # would eventually fix it, but until its resync the
                 # namespace would be falsely throttled)
                 for q, keys in charged:
                     self._refund(q, delta, keys)
+                if self.metrics is not None:
+                    self.metrics.admission_rejections.inc(
+                        namespace=e.namespace or ns,
+                        resource=e.resource_key or "unknown")
                 raise
             charged.append((quota, interesting))
         if charged:
@@ -201,7 +220,8 @@ class ResourceQuotaAdmission:
                         f"exceeded quota: {name}, requested: "
                         f"{k}={delta[k]}, used: "
                         f"{k}={used.get(k, Quantity(0))}, limited: "
-                        f"{k}={hard[k]}")
+                        f"{k}={hard[k]}",
+                        namespace=ns, resource_key=k)
                 used[k] = new
             live.status.hard = dict(live.spec.hard)
             live.status.used = used

@@ -138,7 +138,11 @@ class APIServer:
         sa = ServiceAccountAdmission(self.client)
         self.admission.mutators.append(sa.admit)
         self.admission.validators.append(sa.validate)
-        self._quota = ResourceQuotaAdmission(self.client)
+        from ..tenancy import QuotaMetrics
+        self.quota_metrics = QuotaMetrics()
+        self.metrics.add_registry("quota", self.quota_metrics.registry)
+        self._quota = ResourceQuotaAdmission(
+            self.client, metrics=self.quota_metrics)
         from .admission import NodeRestriction
         self.admission.validators.append(NodeRestriction(self).validate)
         # out-of-process webhooks: mutating AFTER the in-process mutators

@@ -322,6 +322,11 @@ class BatchScheduler:
         #: carrying PodGroup members route through the all-or-nothing
         #: kernel (kernels/gang.py) instead of schedule_batch
         self.gang = None
+        #: tenancy.DRFAccount, installed by the scheduler shell: the
+        #: preemption kernels fold its over-share ranks into the victim
+        #: band sort so over-share tenants' pods price cheaper (None, or
+        #: KTPU_DRF=0, keeps tenant-blind pricing)
+        self.drf = None
         import os as _os
         #: soft-score sub-batch size, resolved ONCE at construction (like
         #: KTPU_ALIGN_SPLIT) — re-reading the environment per batch was a
@@ -2040,6 +2045,18 @@ class BatchScheduler:
             nominated_to_clear=pre.nominated_pods_to_clear(
                 pod, node, self.nominated.pods_for_node(node)))
 
+    def _overshare_ranks(self):
+        """The DRF pricing input for the victim tables: quantized
+        over-share ranks per tenant, or None when no DRF account is
+        installed, the flag is off, or every tenant sits at/below fair
+        share (the legacy tenant-blind order in all three cases)."""
+        if self.drf is None:
+            return None
+        from ..tenancy.drf import drf_enabled
+        if not drf_enabled():
+            return None
+        return self.drf.overshare_ranks() or None
+
     def _preempt_kernel_plan(self, pod: Pod, candidates, infos, pdbs):
         """The batched path: tensorize every candidate's victims into
         band-sorted [N, V] pricing tables, run the masked prefix-sum fit
@@ -2048,7 +2065,8 @@ class BatchScheduler:
         last-resort band; gang victims are priced as whole PodGroups."""
         from .kernels import preempt as pk
         tabs = pk.build_victim_tables(pod, candidates, infos, pdbs,
-                                      unit_cache=self._preempt_unit_cache)
+                                      unit_cache=self._preempt_unit_cache,
+                                      overshare=self._overshare_ranks())
         if tabs is None:
             return None
         from . import preemption as pre
@@ -2104,7 +2122,8 @@ class BatchScheduler:
             candidates.append((name, ni, dom))
         pdbs = list(self.pdb_lister())
         tabs = pk.build_domain_tables(members, candidates, infos, pdbs,
-                                      min_member)
+                                      min_member,
+                                      overshare=self._overshare_ranks())
         if tabs is None:
             return None
         a = tabs.arrays

@@ -167,7 +167,34 @@ class CacheDebugger:
             report["gangDemand"] = [
                 {k: v for k, v in s.items() if k != "members"}
                 for s in gang.demand_shapes()]
+        # tenancy: per-namespace quota headroom (which cap is binding),
+        # the gang-quota gate's active/parked view, and each tenant's DRF
+        # dominant share — together the full "why is my tenant throttled"
+        # answer in one payload
+        self._tenancy_report(report)
         return report
+
+    def _tenancy_report(self, report: dict) -> None:
+        sched = self.scheduler
+        try:
+            from ..api.core import ResourceQuota
+            quotas = sched.informers.informer_for(
+                ResourceQuota).indexer.list()
+        except Exception:
+            quotas = []
+        if quotas:
+            from ..tenancy import quota_headroom
+            report["quotaHeadroom"] = quota_headroom(quotas)
+        gate = getattr(sched, "gang_quota", None)
+        if gate is not None:
+            gq = gate.report()
+            if gq:
+                report["gangQuota"] = gq
+        drf = getattr(sched, "drf", None)
+        if drf is not None:
+            rep = drf.report()
+            if rep.get("tenants"):
+                report["drf"] = rep
 
     def install(self, signum: int = signal.SIGUSR2) -> None:
         """SIGUSR2 -> dump + comparison to stderr (ref: debugger.go

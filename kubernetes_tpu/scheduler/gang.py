@@ -60,6 +60,10 @@ PARK_TIMEOUT = 60.0
 #: pop_gate verdicts
 ADMIT = "admit"
 PARK = "park"
+#: parked for an exhausted per-namespace active-gang quota — its own
+#: verdict so the queue can attribute it as QuotaExhausted, not as a
+#: gang that merely has not formed yet
+PARK_QUOTA = "park-quota"
 
 
 class _Gang:
@@ -109,15 +113,24 @@ class GangManager:
     def __init__(self, group_lookup: Callable[[str, str], Optional[PodGroup]],
                  clock: Clock = REAL_CLOCK, metrics=None,
                  node_label: Optional[Callable[[str, str],
-                                              Optional[str]]] = None):
+                                              Optional[str]]] = None,
+                 quota_gate=None):
         self._lookup = group_lookup
         self._clock = clock
         self.metrics = metrics
         #: node_label(node_name, label_key) -> value | None; the permit
         #: gate's cross-batch ICI-domain check (None disables it)
         self._node_label = node_label
+        #: tenancy.GangQuotaGate (optional): per-namespace active-gang
+        #: slots claimed at pop admission, returned when the gang's last
+        #: member leaves the books (_gc)
+        self.quota_gate = quota_gate
         self._lock = threading.RLock()
         self._gangs: Dict[str, _Gang] = {}
+        #: gang key -> the QuotaBlock that last parked it (attribution)
+        self._quota_blocks: Dict[str, object] = {}
+        #: a slot was returned since the last quota_released() sweep
+        self._quota_freed = False
         #: reservations invalidated outside the permit flow (their pod was
         #: deleted while waiting); drained by expire() for cache rollback
         self._orphaned: List[Tuple[Pod, Pod]] = []
@@ -161,6 +174,10 @@ class GangManager:
             g.dom_pin = None
         if g.empty():
             self._gangs.pop(g.key, None)
+            self._quota_blocks.pop(g.key, None)
+            if self.quota_gate is not None \
+                    and self.quota_gate.release(g.key):
+                self._quota_freed = True
 
     def _observe_pending(self) -> None:
         if self.metrics is not None:
@@ -267,7 +284,10 @@ class GangManager:
     def pop_gate(self, pod: Pod) -> str:
         """Pop-time admission (called under the queue lock, pod still in
         the queue's pending map). ADMIT marks the member in flight; PARK
-        tells the queue to hold the pod out of the active heap."""
+        tells the queue to hold the pod out of the active heap;
+        PARK_QUOTA is the same hold but because the namespace's
+        active-gang quota is exhausted (the block is retrievable via
+        quota_block_for until a slot frees up)."""
         gkey = pod_group_key(pod)
         if gkey is None:
             return ADMIT
@@ -277,6 +297,18 @@ class GangManager:
             if key not in g.pending:
                 g.pending[key] = pod
             if self._admissible(g):
+                # an admissible gang additionally needs an active-gang
+                # slot — unless it already holds reservations or bound
+                # members (a started gang must be allowed to finish;
+                # try_admit is idempotent while the slot is held)
+                if self.quota_gate is not None and g.reserved_count() == 0:
+                    block = self.quota_gate.try_admit(gkey)
+                    if block is not None:
+                        self._quota_blocks[gkey] = block
+                        g.parked.setdefault(key, self._clock.now())
+                        self._observe_pending()
+                        return PARK_QUOTA
+                self._quota_blocks.pop(gkey, None)
                 g.pending.pop(key, None)
                 g.parked.pop(key, None)
                 g.inflight[key] = self._clock.now()
@@ -284,6 +316,40 @@ class GangManager:
             g.parked.setdefault(key, self._clock.now())
             self._observe_pending()
             return PARK
+
+    def quota_block_for(self, pod: Pod):
+        """The QuotaBlock that parked this member's gang (None when the
+        gang is not quota-parked) — the queue's attribution source."""
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return None
+        with self._lock:
+            return self._quota_blocks.get(gkey)
+
+    def quota_changed(self) -> None:
+        """A ResourceQuota was raised/deleted: treat it like a freed
+        slot so the next quota_released() sweep re-evaluates parked
+        gangs against the new limit."""
+        with self._lock:
+            self._quota_freed = True
+
+    def quota_released(self) -> List[str]:
+        """Reactivation sweep after an active-gang slot was returned:
+        every parked member of an admissible gang goes back to the
+        active heap (optimistic — pop_gate re-checks the quota, so a
+        gang that still cannot get a slot simply re-parks). Returns
+        nothing when no slot was freed since the last sweep."""
+        with self._lock:
+            if not self._quota_freed:
+                return []
+            self._quota_freed = False
+            out: List[str] = []
+            for g in self._gangs.values():
+                if g.parked and self._admissible(g):
+                    out.extend(g.parked)
+                    g.parked.clear()
+            self._observe_pending()
+            return out
 
     def group_changed(self, gkey: str) -> List[str]:
         """A PodGroup was created/updated: parked members may now clear

@@ -87,6 +87,10 @@ class _Unit:
     start: str                    # latest start among top-priority victims
     startr: int = 0               # global rank of `start` (filled late)
     is_group: bool = False        # whole-PodGroup unit (never cached)
+    #: quantized DRF over-share rank of the unit's tenant (0 at/below
+    #: fair share, or when DRF is off) — over-share tenants' units sort
+    #: into a cheaper eviction band
+    oshare: int = 0
 
 
 @dataclass
@@ -140,9 +144,20 @@ def bound_group_index(infos: Dict[str, NodeInfo]) -> Dict[str, List[Pod]]:
     return out
 
 
+def _unit_oshare(pods: Sequence[Pod], overshare) -> int:
+    """The unit's DRF pricing term: the MAX over-share rank among its
+    victims' tenants (a group mixing tenants prices at its most
+    over-share member). 0 whenever DRF is off."""
+    if not overshare:
+        return 0
+    from ...tenancy.drf import tenant_of
+    return max((overshare.get(tenant_of(p), 0) for p in pods), default=0)
+
+
 def _node_units(prio: int, ni: NodeInfo, pdbs,
                 group_bound: Dict[str, List[Pod]],
-                res_names: Sequence[str]) -> Tuple[List[_Unit], bool]:
+                res_names: Sequence[str],
+                overshare=None) -> Tuple[List[_Unit], bool]:
     """The node's evictable units in band (eviction) order, plus
     whether the list is CACHEABLE: any gang member among the node's
     potential victims makes it not — both surviving group units (their
@@ -179,7 +194,8 @@ def _node_units(prio: int, ni: NodeInfo, pdbs,
             key=p.metadata.key(), evict=[p],
             freed=_res_row(pod_resource(p), res_names), fcnt=1,
             pdb=p.metadata.key() in viol, top=pr, psum=float(pr), gcnt=1,
-            start=p.status.start_time or ""))
+            start=p.status.start_time or "",
+            oshare=_unit_oshare([p], overshare)))
     for gk, here in sorted(groups.items()):
         members = group_bound.get(gk, here)
         prios = [helpers.pod_priority(m) for m in members]
@@ -193,21 +209,24 @@ def _node_units(prio: int, ni: NodeInfo, pdbs,
             top=top, psum=float(sum(prios)), gcnt=len(members),
             start=max((m.status.start_time or "") for m, pr in
                       zip(members, prios) if pr == top),
-            is_group=True))
+            is_group=True, oshare=_unit_oshare(members, overshare)))
     return units, not any(pod_group_key(p) is not None for p in potential)
 
 
 def _rank_and_sort(per_row: List[List[_Unit]]) -> None:
     """Assign global start-time ranks, then sort each row into the
-    eviction band order: clean before PDB, cheapest priority first,
-    youngest (latest start) first within a band, key as the final
-    deterministic tie."""
+    eviction band order: clean before PDB, most over-share tenant first
+    (the DRF pricing term — 0 for every unit when DRF is off, so the
+    legacy order is unchanged), cheapest priority first, youngest
+    (latest start) first within a band, key as the final deterministic
+    tie. This is HOST code consumed by both price_nodes and its numpy
+    reference, so kernel-vs-oracle parity holds by construction."""
     starts = sorted({u.start for row in per_row for u in row})
     rank = {s: i for i, s in enumerate(starts)}
     for row in per_row:
         for u in row:
             u.startr = rank[u.start]
-        row.sort(key=lambda u: (u.pdb, u.top, -u.startr, u.key))
+        row.sort(key=lambda u: (u.pdb, -u.oshare, u.top, -u.startr, u.key))
 
 
 def _bucket_pow2(n: int, minimum: int = 1) -> int:
@@ -218,7 +237,8 @@ def _bucket_pow2(n: int, minimum: int = 1) -> int:
 def build_victim_tables(pod: Pod,
                         candidates: Sequence[Tuple[str, NodeInfo]],
                         infos: Dict[str, NodeInfo], pdbs,
-                        unit_cache: Optional[dict] = None
+                        unit_cache: Optional[dict] = None,
+                        overshare: Optional[Dict[str, int]] = None
                         ) -> Optional[VictimTables]:
     """Single-preemptor tables: one row per candidate node.
 
@@ -242,12 +262,15 @@ def build_victim_tables(pod: Pod,
     # into the key so a DisruptionController update invalidates wholesale
     pdb_key = tuple(sorted(
         (p.metadata.key(), p.status.disruptions_allowed) for p in pdbs))
+    # cached unit lists bake the DRF pricing term in: fingerprint the
+    # over-share ranks so a share shift invalidates rather than reuses
+    os_key = tuple(sorted(overshare.items())) if overshare else ()
     for name, ni in candidates:
-        key = (name, ni.generation, prio, res_key, pdb_key)
+        key = (name, ni.generation, prio, res_key, pdb_key, os_key)
         units = unit_cache.get(key) if unit_cache is not None else None
         if units is None:
             units, cacheable = _node_units(prio, ni, pdbs, group_bound,
-                                           res_names)
+                                           res_names, overshare=overshare)
             # gang members key CLUSTER-WIDE state: a sibling binding (or
             # a remote member's priority putting its group off-limits)
             # changes this node's units without touching this node's
@@ -457,7 +480,9 @@ def _slot_curve(free0: np.ndarray, cfree0: float, units: List[_Unit],
 def build_domain_tables(members: Sequence[Pod],
                         candidates: Sequence[Tuple[str, NodeInfo, str]],
                         infos: Dict[str, NodeInfo], pdbs,
-                        min_member: int) -> Optional[DomainTables]:
+                        min_member: int,
+                        overshare: Optional[Dict[str, int]] = None
+                        ) -> Optional[DomainTables]:
     """Whole-gang tables: `candidates` are (node, info, domain value)
     triples of screen-passing nodes carrying the gang's topology label.
     The member request is the elementwise MAX over members (a slot that
@@ -489,7 +514,7 @@ def build_domain_tables(members: Sequence[Pod],
     for dom in domains:
         for name, ni in sorted(per_dom[dom]):
             units, _cacheable = _node_units(prio, ni, pdbs, group_bound,
-                                            res_names)
+                                            res_names, overshare=overshare)
             # the preemptor gang itself may already hold bound members
             # (a partially-recovered slice): never price them as victims
             if gkey is not None:
@@ -518,8 +543,8 @@ def build_domain_tables(members: Sequence[Pod],
                 merged.append((u, name, j))
         # cross-node merge in the shared band order; per-node unit order
         # is preserved (same sort key), so slot deltas stay additive
-        merged.sort(key=lambda e: (e[0].pdb, e[0].top, -e[0].startr,
-                                   e[0].key, e[1]))
+        merged.sort(key=lambda e: (e[0].pdb, -e[0].oshare, e[0].top,
+                                   -e[0].startr, e[0].key, e[1]))
         merged_rows.append(merged)
         base.append(slots0)
     D = _bucket_pow2(len(domains))

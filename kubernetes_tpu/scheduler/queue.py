@@ -50,7 +50,7 @@ from ..api import helpers
 from ..api.core import Pod
 from ..api.scheduling import pod_group_key
 from ..utils.clock import Clock, REAL_CLOCK
-from .gang import PARK
+from .gang import ADMIT, PARK_QUOTA
 
 DEFAULT_UNSCHEDULABLE_DURATION = 60.0  # unschedulableQTimeInterval (:49-51)
 INITIAL_BACKOFF = 1.0                  # pod_backoff.go initialDuration
@@ -366,22 +366,34 @@ class SchedulingQueue:
                     self.backoff_map.clear(key)
                     self.nominated.delete(info.pod)
                     continue
-                if self.gang is not None and \
-                        self.gang.pop_gate(info.pod) == PARK:
-                    # below-minMember gang member: hold it OUT of the heap
-                    # but keep it pending; the completing arrival (or a
-                    # PodGroup change) reactivates it. The pods behind it
-                    # keep popping — no head-of-line blocking.
+                verdict = ADMIT if self.gang is None \
+                    else self.gang.pop_gate(info.pod)
+                if verdict != ADMIT:
+                    # gang member held OUT of the heap but kept pending;
+                    # a completing arrival, PodGroup change, or freed
+                    # quota slot reactivates it. The pods behind it keep
+                    # popping — no head-of-line blocking. A quota park
+                    # gets its own attribution naming the blocking quota
+                    # so it never reads as a scheduler failure.
                     self._parked[key] = info
                     if self.tracer is not None:
                         self.tracer.pod_event("queue", "park", info.pod)
+                    if verdict == PARK_QUOTA:
+                        block = self.gang.quota_block_for(info.pod)
+                        reason = "QuotaExhausted"
+                        msg = block.message(pod_group_key(info.pod)) \
+                            if block is not None else \
+                            f"gang {pod_group_key(info.pod)} parked: " \
+                            f"active-gang quota exhausted"
+                    else:
+                        reason = "PodGroupNotReady"
+                        msg = (f"gang {pod_group_key(info.pod)} below "
+                               f"minMember; parked off the active heap")
                     if self.unsched_reasons is not None:
-                        self.unsched_reasons.inc(reason="PodGroupNotReady")
+                        self.unsched_reasons.inc(reason=reason)
                     if self.attribution is not None:
                         self.attribution.record(
-                            key, "PodGroupNotReady",
-                            f"gang {pod_group_key(info.pod)} below "
-                            f"minMember; parked off the active heap",
+                            key, reason, msg,
                             cycle=self._scheduling_cycle)
                     continue
                 # popped pods leave the pending set; a failed attempt re-adds
@@ -476,6 +488,13 @@ class SchedulingQueue:
                 else:
                     self._push_active(key, info)
         if self.gang is not None and self._parked:
+            # quota fast path: an active-gang slot freed since the last
+            # flush reactivates quota-parked gangs immediately (pop_gate
+            # re-checks the quota, so an unlucky gang just re-parks)
+            for key in self.gang.quota_released():
+                info = self._parked.pop(key, None)
+                if info is not None:
+                    self._push_active(key, info)
             # starved gang slow path: long-parked members cycle through the
             # standard backoff machinery (boosted, so repeats decay) and
             # re-park on pop if their gang is still short

@@ -227,10 +227,27 @@ class Scheduler:
             if ni is None or ni.node is None:
                 return None
             return ni.node.metadata.labels.get(label_key)
+        # multi-tenancy (tenancy/): per-tenant DRF usage carry (drain
+        # ordering + preemption pricing) and the per-namespace
+        # active-gang quota gate the gang manager consults at pop time
+        from ..api.core import ResourceQuota
+        from ..tenancy import (DRFAccount, GangQuotaGate, TenancyMetrics,
+                               drf_enabled)
+        try:
+            self.tenancy_metrics = TenancyMetrics(self.metrics.registry)
+        except ValueError:
+            self.tenancy_metrics = TenancyMetrics()
+        rq_informer = self.informers.informer_for(ResourceQuota)
+        self.gang_quota = GangQuotaGate(
+            lambda: rq_informer.indexer.list(),
+            metrics=self.tenancy_metrics)
+        self.drf = DRFAccount(mesh=mesh)
+        self._drf_on = drf_enabled()
+        self.algorithm.drf = self.drf
         self.gang = GangManager(
             lambda ns, name: pg_informer.indexer.get_by_key(f"{ns}/{name}"),
             clock=clock, metrics=self.gang_metrics,
-            node_label=_node_label)
+            node_label=_node_label, quota_gate=self.gang_quota)
         self.queue.gang = self.gang
         self.algorithm.gang = self.gang
         pg_informer.add_event_handlers(EventHandlers(
@@ -238,6 +255,36 @@ class Scheduler:
                 pg.metadata.key()),
             on_update=lambda old, new: self.queue.gang_group_changed(
                 new.metadata.key())))
+        # a raised (or deleted) quota may unpark quota-held gangs: mark
+        # the gate's freed flag so the queue's next flush re-evaluates.
+        # Spec changes only — the reconciler's status.used writes would
+        # otherwise re-trigger the sweep every tick.
+        rq_informer.add_event_handlers(EventHandlers(
+            on_update=lambda old, new: (
+                self.gang.quota_changed()
+                if dict(old.spec.hard) != dict(new.spec.hard) else None),
+            on_delete=lambda rq: self.gang.quota_changed()))
+        # PriorityClass bands: stored PriorityClasses define the named
+        # band catalog; the express-lane threshold DERIVES from it
+        # (lowest express band) instead of staying a hard-coded integer.
+        # No PriorityClass objects -> the legacy two-lane default, so the
+        # constructor argument keeps its exact old meaning.
+        from ..api.policy import PriorityClass
+        from ..tenancy import BandCatalog
+        pc_informer = self.informers.informer_for(PriorityClass)
+        self._lane_default = lane_priority
+        self.bands = BandCatalog.default(lane_priority)
+
+        def _rebuild_bands(*_args):
+            pcs = pc_informer.indexer.list()
+            self.bands = BandCatalog.from_priority_classes(pcs) \
+                if pcs else BandCatalog.default(self._lane_default)
+            self.lane_priority = self.bands.lane_threshold(
+                self._lane_default)
+        self._rebuild_bands = _rebuild_bands
+        pc_informer.add_event_handlers(EventHandlers(
+            on_add=_rebuild_bands, on_update=_rebuild_bands,
+            on_delete=_rebuild_bands))
         from ..state.record import EventRecorder
         from .debugger import CacheDebugger, UnschedulableAttribution
         #: correlating recorder (ref: client-go tools/record): dedup by
@@ -393,6 +440,7 @@ class Scheduler:
         if new.spec.node_name:
             if helpers.pod_is_terminal(new):
                 self.cache.remove_pod(new)
+                self.drf.release(new)
                 if self.gang is not None:
                     # a terminal worker no longer completes its gang
                     self.gang.pod_dropped(new)
@@ -428,6 +476,7 @@ class Scheduler:
         set may be torn mid-transaction. Roll all of it back (gangs
         whole-group, the PR 2 convention) and let the pod reschedule."""
         self.cache.remove_pod(old)  # drops the assumed flag too
+        self.drf.release(old)
         self.algorithm.mirror.invalidate_usage()
         self._pipe_phantom = True
         self.volume_binder.forget_pod_volumes(old)
@@ -451,6 +500,7 @@ class Scheduler:
     def _on_pod_delete(self, pod: Pod) -> None:
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
+            self.drf.release(pod)
             if self.gang is not None:
                 # prune the bound member: stale bound keys would let a
                 # re-created gang release partially against old counts
@@ -517,6 +567,17 @@ class Scheduler:
         self.batch_cap_log.append((depth, lane, pressure, cap))
         return cap
 
+    def _drf_order(self, pods: List[Pod]) -> List[Pod]:
+        """DRF fair-share reorder of a popped batch BEFORE soft-score
+        sub-chunking: priority still dominates (the express-lane
+        contract), but within a band the tenants furthest below fair
+        share tensorize first and win in-batch contention. Identity
+        under KTPU_DRF=0 (the measured control) or for trivial pops."""
+        if not self._drf_on or len(pods) < 2:
+            return pods
+        self.drf.ensure_capacity(self.algorithm.snapshot.node_infos)
+        return self.drf.order_batch(pods)
+
     def schedule_pending(self, max_pods: Optional[int] = None,
                          timeout: float = 0.0) -> List[ScheduleResult]:
         """One scheduling cycle: drain a batch and decide it. Returns the
@@ -530,6 +591,7 @@ class Scheduler:
                                     on_pop=_mark_in_flight)
         if not pods:
             return []
+        pods = self._drf_order(pods)
         if self.tracer.enabled:
             for pod in pods:
                 self.tracer.pod_event("scheduler", "drain_member", pod,
@@ -709,6 +771,7 @@ class Scheduler:
                 else:
                     pods = self.queue.pop_batch(self._drain_cap(), timeout=0,
                                                 on_pop=_mark)
+                    pods = self._drf_order(pods)
                 if pods:
                     # spread-carrying pods schedule in sub-chunks so their
                     # soft scores refresh as winners land (core.soft_batch_limit)
@@ -1065,6 +1128,9 @@ class Scheduler:
                     self.cache.finish_binding(out)
                 if self.gang is not None:
                     self.gang.pod_bound(out)
+                # winner commit: the DRF usage carry charges here
+                # (idempotent by key; released on terminal/delete)
+                self.drf.charge(out)
                 with self._count_lock:
                     self.scheduled_count += 1
                 self.metrics.schedule_attempts.inc(result="scheduled")
@@ -1123,6 +1189,7 @@ class Scheduler:
                     self.algorithm.mirror.invalidate_usage()
                     continue
             pairs.append((res, out))
+            self.drf.charge(out)
             with self._count_lock:
                 self.scheduled_count += 1
             self.metrics.schedule_attempts.inc(result="scheduled")
@@ -1231,6 +1298,7 @@ class Scheduler:
                 pass
             if self.gang is not None:
                 self.gang.bind_failed(res.pod)
+            self.drf.release(clone)
             self.algorithm.mirror.invalidate_usage()
             with self._count_lock:
                 self.scheduled_count -= 1
@@ -1348,6 +1416,9 @@ class Scheduler:
         dirty scatter repairs device usage) and the members requeue."""
         if self.gang is None:
             return
+        if self._drf_on:
+            # refresh the per-tenant dominant-share gauge once per cycle
+            self.tenancy_metrics.sample_shares(self.drf)
         rollbacks, requeue = self.gang.expire(self.clock.now())
         if not rollbacks and not requeue:
             return

@@ -47,6 +47,14 @@ _NODE_TRAILING = re.compile(
     r"^(unique_masks|unique_scores|spread_base|spread|soft_base|anti_dom"
     r"|soft_dom|dom_tab)$")
 
+#: tensors carried per TENANT, not per node: the DRF usage carry
+#: ([T, R], tenant-leading) and its [R] capacity row. Both are tiny and
+#: consumed whole by every shard's ordering kernel, so they REPLICATE
+#: by the default rule — named here so the rule is a decision, not an
+#: accident of the fallthrough (add a rule above if T ever grows to a
+#: shardable size).
+_TENANT_REPLICATED = ("tenant_usage", "tenant_capacity")
+
 
 def spec_for(name: str, ndim: int):
     """The PartitionSpec for tensor `name` (first matching rule wins;

@@ -29,8 +29,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..api.core import Node, NodeCondition, Pod
+from ..api.core import Node, NodeCondition, Pod, ResourceQuota
 from ..api.batch import CronJob, Job
+from ..api.policy import PriorityClass
 from ..api.apps import Deployment, ReplicaSet
 from ..api.meta import ObjectMeta
 from ..api.quantity import Quantity
@@ -48,7 +49,7 @@ from ..state.informer import SharedInformerFactory
 from ..state.store import NotFoundError, Store
 from ..utils.clock import FakeClock, now_iso
 from ..utils.metrics import RobustnessMetrics, ServingMetrics
-from .loadgen import CLASS_LABEL, LoadGen
+from .loadgen import CLASS_LABEL, TENANT_LABEL, LoadGen
 from .slo import SLOTracker
 
 
@@ -63,6 +64,10 @@ class ServingReport:
     #: (queue_depth, lane_depth, pressure, cap) per sized drain cycle
     batch_caps: List[Tuple] = field(default_factory=list)
     slo: dict = field(default_factory=dict)
+    #: per-tenant bind/startup percentiles (tenants > 0 or an abuser)
+    tenant_slo: dict = field(default_factory=dict)
+    #: per-priority-band bind p99 vs the band's SLO target
+    band_slo: dict = field(default_factory=dict)
     violations: List[str] = field(default_factory=list)
     #: arrived-but-never-bound, non-terminal pods after quiescence
     stuck: List[str] = field(default_factory=list)
@@ -89,7 +94,13 @@ class ServingHarness:
                  watch_drop_rate: float = 0.0,
                  autoscaler: bool = False,
                  autoscaler_cooldown: float = 60.0,
-                 autoscaler_max_nodes: int = 64):
+                 autoscaler_max_nodes: int = 64,
+                 tenants: int = 0,
+                 quotas: Optional[Dict[str, Dict[str, str]]] = None,
+                 abuse_rate: float = 0.0,
+                 abuse_namespace: str = "abuse",
+                 abuse_gang_sizes: Tuple[int, int] = (3, 5),
+                 gang_run_ticks: Optional[int] = None):
         self.seed = seed
         self.n_nodes = nodes
         self.tick_s = tick_s
@@ -135,11 +146,40 @@ class ServingHarness:
         self._build_controllers(self.factory)
         self.loadgen = LoadGen(self.admin, seed=seed, rate=rate, mix=mix,
                                clock=self.clock,
-                               lane_priority=lane_priority)
+                               lane_priority=lane_priority,
+                               tenants=tenants)
         self.serving_metrics.arrival_rate.set(rate)
         self.tracker = SLOTracker(clock=self.clock,
                                   metrics=self.serving_metrics,
                                   tracer=self.tracer)
+        # ---- multi-tenancy (tenancy/) ----
+        #: ResourceQuotas to create at start(): namespace -> hard caps
+        #: (quantity strings); an `scheduling.ktpu/active-gangs` key caps
+        #: that namespace's concurrent gangs at the queue gate
+        self.quotas = dict(quotas or {})
+        #: deterministic status.used reconciler, stepped per tick
+        from ..tenancy import TenantQuotaController
+        self.quota_controller = TenantQuotaController(self.admin) \
+            if self.quotas else None
+        #: gang-class pods retire after this many running ticks (None =
+        #: never, the legacy behavior) — with an active-gang quota the
+        #: gate's slots must recycle or the backlog can never converge
+        self.gang_run_ticks = gang_run_ticks
+        #: the abusive tenant: a second generator flooding gangs into its
+        #: own namespace (namespace-as-tenant for DRF attribution)
+        self.abuser = None
+        if abuse_rate > 0:
+            self.abuser = LoadGen(
+                self.admin, seed=seed + 7919, rate=abuse_rate,
+                mix=(("gang", 1.0),), clock=self.clock,
+                namespace=abuse_namespace,
+                gang_sizes=abuse_gang_sizes,
+                tenant_name=abuse_namespace)
+        #: per-tenant latency attribution (the isolation bench's surface)
+        self.tenant_tracker = None
+        if tenants > 0 or self.abuser is not None:
+            self.tenant_tracker = SLOTracker(clock=self.clock,
+                                             class_label=TENANT_LABEL)
         self._running_since: Dict[str, int] = {}
         self._tick_idx = 0
         self._started = False
@@ -208,6 +248,13 @@ class ServingHarness:
                 type="Ready", status="True", reason="KubeletReady",
                 last_heartbeat_time=now_iso(self.clock))]
             self.admin.nodes().create(node)
+        from ..api.core import ResourceQuotaSpec
+        for ns in sorted(self.quotas):
+            self.admin.resource_quotas(ns).create(ResourceQuota(
+                metadata=ObjectMeta(name=f"quota-{ns}", namespace=ns),
+                spec=ResourceQuotaSpec(hard={
+                    k: Quantity(v) for k, v
+                    in sorted(self.quotas[ns].items())})))
         for fac in self._factories():
             fac.start()
             fac.wait_for_cache_sync()
@@ -239,7 +286,8 @@ class ServingHarness:
 
     def run(self, n_events: int = 200, max_ticks: int = 600,
             quiesce_ticks: int = 40,
-            restart_scheduler_at: Optional[int] = None) -> ServingReport:
+            restart_scheduler_at: Optional[int] = None,
+            abuse_events: int = 0) -> ServingReport:
         """Drive the full schedule, then quiesce (cronjobs suspended,
         faults off) until every arrived pod is bound or terminal (or
         max_ticks). Returns the report with the determinism surfaces and
@@ -247,6 +295,8 @@ class ServingHarness:
         self.start()
         report = ServingReport(seed=self.seed)
         self.loadgen.begin(self.loadgen.make_schedule(n_events))
+        if self.abuser is not None and abuse_events > 0:
+            self.abuser.begin(self.abuser.make_schedule(abuse_events))
         quiesced = False
         quiesce_left = quiesce_ticks
         while self._tick_idx < max_ticks:
@@ -256,7 +306,7 @@ class ServingHarness:
                 self.restart_scheduler()
                 report.scheduler_restarts += 1
             self._tick()
-            if self.loadgen.done and not quiesced:
+            if self.loadgen.done and self._abuser_done() and not quiesced:
                 # quiesce: no new arrivals, future cron firings off,
                 # faults off — the backlog must now converge on its own
                 quiesced = True
@@ -275,6 +325,9 @@ class ServingHarness:
         report.batch_caps = self._batch_caps + \
             list(self.scheduler.batch_cap_log)
         report.slo = self.tracker.report()
+        if self.tenant_tracker is not None:
+            report.tenant_slo = self.tenant_tracker.report()
+        report.band_slo = self.tracker.band_report(self.scheduler.bands)
         report.stuck = self._stuck_pods()
         report.pods_bound = sum(
             1 for p in self.admin.pods().list(namespace=None)
@@ -282,6 +335,10 @@ class ServingHarness:
         checker = InvariantChecker(self.admin, scheduler=self.scheduler)
         report.violations = checker.check()
         return report
+
+    def _abuser_done(self) -> bool:
+        return self.abuser is None or self.abuser._start is None \
+            or self.abuser.done
 
     def _unconverged(self) -> bool:
         return bool(self._stuck_pods())
@@ -302,8 +359,19 @@ class ServingHarness:
         tracker observes — each stage settled so the next reads a
         deterministic view."""
         self.loadgen.step()
+        if self.abuser is not None and self.abuser._start is not None:
+            self.abuser.step()
         self._settle()
         self._controllers_pass()
+        if self.quota_controller is not None:
+            # after the workload controllers (their pods exist), before
+            # the drain: status.used reflects this tick's arrivals
+            try:
+                self.quota_controller.sync_all()
+            except Exception:
+                if not self._swallow_errors:
+                    raise
+            self._settle()
         try:
             self.scheduler.schedule_pending(timeout=0)
         except Exception:
@@ -320,7 +388,10 @@ class ServingHarness:
         self._virtual_kubelets()
         self._settle()
         # deterministic SLO observation: the settled store, sorted keys
-        self.tracker.scan(self.admin.pods().list(namespace=None))
+        pods = self.admin.pods().list(namespace=None)
+        self.tracker.scan(pods)
+        if self.tenant_tracker is not None:
+            self.tenant_tracker.scan(pods)
         self.clock.step(self.tick_s)
         self._tick_idx += 1
 
@@ -375,9 +446,13 @@ class ServingHarness:
                 except NotFoundError:
                     continue
                 self._running_since[key] = self._tick_idx
-            elif cls in ("job", "cronjob") and \
+            elif (cls in ("job", "cronjob")
+                  or (cls == "gang" and self.gang_run_ticks is not None)
+                  ) and \
                     self._tick_idx - self._running_since.get(
-                        key, self._tick_idx) >= self.job_run_ticks:
+                        key, self._tick_idx) >= (
+                        self.gang_run_ticks if cls == "gang"
+                        else self.job_run_ticks):
                 def done_status(cur):
                     if cur.status.phase == "Running":
                         cur.status.phase = "Succeeded"
@@ -392,9 +467,11 @@ class ServingHarness:
 
     #: resource classes the settling contract gates on — everything a
     #: serving control loop reads (only informers a factory actually
-    #: created are compared; see chaos.harness.informers_current)
+    #: created are compared; see chaos.harness.informers_current).
+    #: ResourceQuota rides along since the scheduler's gang-quota gate
+    #: and band catalog read their informers at pop time.
     _SETTLE_CLASSES = (Pod, Node, PodGroup, Deployment, ReplicaSet, Job,
-                       CronJob)
+                       CronJob, ResourceQuota, PriorityClass)
 
     def _settle(self, timeout: float = 10.0) -> None:
         """The chaos harness's settling contract over the serving

@@ -51,6 +51,11 @@ from ..utils.clock import Clock, REAL_CLOCK
 #: buckets its latency percentiles by this
 CLASS_LABEL = "serving.ktpu/class"
 
+#: the tenant label (shared with tenancy.drf.TENANT_LABEL) stamped when
+#: the generator runs with tenants > 0 — the isolation bench's
+#: attribution key
+TENANT_LABEL = "serving.ktpu/tenant"
+
 #: default class mix (weights; renormalized by random.choices)
 DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
     ("singleton", 0.40), ("deployment", 0.20), ("job", 0.15),
@@ -86,7 +91,9 @@ class LoadGen:
                  gang_sizes: Tuple[int, int] = (2, 4),
                  deploy_step: Tuple[int, int] = (1, 8),
                  job_sizes: Tuple[int, int] = (1, 4),
-                 max_cronjobs: int = 2):
+                 max_cronjobs: int = 2,
+                 tenants: int = 0,
+                 tenant_name: Optional[str] = None):
         self.client = client
         self.seed = seed
         self.rate = float(rate)
@@ -100,6 +107,12 @@ class LoadGen:
         self.deploy_step = deploy_step
         self.job_sizes = job_sizes
         self.max_cronjobs = max_cronjobs
+        #: > 0 stamps every workload with a seeded TENANT_LABEL; 0 (the
+        #: default) draws nothing, so legacy schedules stay byte-identical
+        self.tenants = int(tenants)
+        #: a FIXED tenant label on everything this generator emits (the
+        #: isolation bench's single-tenant abuser); overrides draws
+        self.tenant_name = tenant_name
         #: the applied-arrival log — (idx, cls, object name) in apply
         #: order; identical across same-seed runs (the determinism
         #: surface the serving smoke asserts on)
@@ -121,6 +134,13 @@ class LoadGen:
         (seed, rate, mix, n_events). String seeding is process-stable."""
         rng = random.Random(
             f"serving-loadgen:{self.seed}:{self.rate}:{n_events}")
+        # tenant draws come from their OWN stream — a pure function of
+        # (seed, n) — so turning tenants on never perturbs the arrival
+        # times/classes, and tenants=0 draws nothing at all (byte-identical
+        # legacy schedules)
+        trng = random.Random(
+            f"serving-loadgen-tenants:{self.seed}:{n_events}") \
+            if self.tenants > 0 else None
         names = [c for c, _ in self.mix]
         weights = [w for _, w in self.mix]
         t = 0.0
@@ -128,11 +148,12 @@ class LoadGen:
         for i in range(n_events):
             t += rng.expovariate(self.rate)
             cls = rng.choices(names, weights=weights)[0]
-            out.append(ArrivalEvent(
-                idx=i, t=t, cls=cls,
-                params={"size": rng.randint(*self.gang_sizes),
-                        "delta": rng.randint(*self.deploy_step),
-                        "par": rng.randint(*self.job_sizes)}))
+            params = {"size": rng.randint(*self.gang_sizes),
+                      "delta": rng.randint(*self.deploy_step),
+                      "par": rng.randint(*self.job_sizes)}
+            if trng is not None:
+                params["tenant"] = trng.randrange(self.tenants)
+            out.append(ArrivalEvent(idx=i, t=t, cls=cls, params=params))
         return out
 
     def begin(self, schedule: Optional[List[ArrivalEvent]] = None,
@@ -179,6 +200,13 @@ class LoadGen:
         self._counters[prefix] = n
         return f"srv-{prefix}-{n}"
 
+    def _tenant_labels(self, ev: ArrivalEvent) -> Dict[str, str]:
+        """The event's tenant label ({} when tenants are off)."""
+        if self.tenant_name is not None:
+            return {TENANT_LABEL: self.tenant_name}
+        k = ev.params.get("tenant")
+        return {} if k is None else {TENANT_LABEL: f"tenant-{k}"}
+
     def _pod_template(self, cls: str, extra_labels=None) -> PodTemplateSpec:
         labels = {CLASS_LABEL: cls, "app": f"srv-{cls}"}
         if extra_labels:
@@ -206,15 +234,16 @@ class LoadGen:
 
     def _do_singleton(self, ev: ArrivalEvent) -> str:
         name = self._name("solo")
-        self.client.pods(self.namespace).create(
-            self._make_pod(name, "singleton"))
+        self.client.pods(self.namespace).create(self._make_pod(
+            name, "singleton", extra_labels=self._tenant_labels(ev)))
         self._count("singleton")
         return name
 
     def _do_priority(self, ev: ArrivalEvent) -> str:
         name = self._name("pri")
         self.client.pods(self.namespace).create(self._make_pod(
-            name, "priority", priority=self.lane_priority))
+            name, "priority", priority=self.lane_priority,
+            extra_labels=self._tenant_labels(ev)))
         self._count("priority")
         return name
 
@@ -224,10 +253,10 @@ class LoadGen:
         self.client.pod_groups(self.namespace).create(PodGroup(
             metadata=ObjectMeta(name=gname, namespace=self.namespace),
             spec=PodGroupSpec(min_member=size)))
+        labels = {LABEL_POD_GROUP: gname, **self._tenant_labels(ev)}
         for i in range(size):
             self.client.pods(self.namespace).create(self._make_pod(
-                f"{gname}-w{i}", "gang",
-                extra_labels={LABEL_POD_GROUP: gname}))
+                f"{gname}-w{i}", "gang", extra_labels=labels))
         self._count("gang", size)
         return gname
 
@@ -243,7 +272,9 @@ class LoadGen:
                     replicas=delta,
                     selector=LabelSelector(
                         match_labels={"app": "srv-deployment"}),
-                    template=self._pod_template("deployment"))))
+                    template=self._pod_template(
+                        "deployment",
+                        extra_labels=self._tenant_labels(ev)))))
             return self._deploy_name
 
         def scale(cur):
@@ -259,7 +290,9 @@ class LoadGen:
         self.client.jobs(self.namespace).create(Job(
             metadata=ObjectMeta(name=name, namespace=self.namespace),
             spec=JobSpec(parallelism=par, completions=par,
-                         template=self._pod_template("job"))))
+                         template=self._pod_template(
+                             "job",
+                             extra_labels=self._tenant_labels(ev)))))
         return name
 
     def _do_cronjob(self, ev: ArrivalEvent) -> str:
@@ -269,8 +302,10 @@ class LoadGen:
         # job_template is the serde dict form (the CronJob controller
         # decodes it per firing); round-trip a real Job for field parity
         from ..api import serde
-        tmpl_job = Job(spec=JobSpec(parallelism=1, completions=1,
-                                    template=self._pod_template("cronjob")))
+        tmpl_job = Job(spec=JobSpec(
+            parallelism=1, completions=1,
+            template=self._pod_template(
+                "cronjob", extra_labels=self._tenant_labels(ev))))
         job_tmpl = {"spec": json.loads(
             serde.to_json_str(tmpl_job)).get("spec", {})}
         self.client.resource(CronJob, self.namespace).create(CronJob(

@@ -71,6 +71,9 @@ class SLOTracker:
         self._bound: Dict[str, float] = {}
         self._running: Dict[str, float] = {}
         self._cls: Dict[str, str] = {}
+        #: effective priority at first sight — band_report() buckets bind
+        #: latency through a tenancy.BandCatalog with this
+        self._prio: Dict[str, int] = {}
         #: (pod key, node) in first-observation order — with scan-driven
         #: observation this is the run's deterministic bind event log
         self.bind_log: List[Tuple[str, str]] = []
@@ -102,6 +105,8 @@ class SLOTracker:
                 self._created[key] = self._stamp_created(pod, now)
                 self._cls[key] = pod.metadata.labels.get(
                     self.class_label, "other")
+                from ..api.helpers import pod_priority
+                self._prio[key] = pod_priority(pod)
                 if self.metrics is not None:
                     self.metrics.pods_observed.inc(
                         cls=self._cls[key], phase="created")
@@ -196,6 +201,36 @@ class SLOTracker:
             if elapsed > 0 else 0.0,
             "classes": classes,
         }
+
+    def band_report(self, catalog) -> dict:
+        """Per-band bind latency vs. the band's SLO target: each bound
+        pod falls into the catalog band its recorded priority reaches
+        (tenancy.BandCatalog.band_of), and a band carrying a
+        slo_p99_bind_s target reports whether its observed p99 met it.
+        Bands with no bound pods are omitted."""
+        with self._lock:
+            per_band: Dict[str, List[float]] = {}
+            for key, t_end in self._bound.items():
+                band = catalog.band_of(self._prio.get(key, 0))
+                per_band.setdefault(band.name, []).append(
+                    max(0.0, t_end - self._created[key]))
+        out: dict = {}
+        for band in catalog.bands:
+            vals = sorted(per_band.get(band.name, []))
+            if not vals:
+                continue
+            p99 = percentile(vals, 0.99)
+            entry = {
+                "count": len(vals),
+                "priority_floor": band.value,
+                "p50_s": round(percentile(vals, 0.50), 6),
+                "p99_s": round(p99, 6),
+            }
+            if band.slo_p99_bind_s is not None:
+                entry["slo_p99_bind_s"] = band.slo_p99_bind_s
+                entry["slo_met"] = bool(p99 <= band.slo_p99_bind_s)
+            out[band.name] = entry
+        return out
 
     def unfinished(self) -> List[str]:
         """Pods observed created but never bound — the liveness surface

@@ -1,0 +1,35 @@
+"""Multi-tenancy: ResourceQuota reconciliation, gang quota at the queue
+gate, DRF fair share on the device scan, and PriorityClass bands.
+
+Layer map (ISSUE 16):
+
+  - quota.py     deterministic ResourceQuota reconciler + headroom report
+                 (admission charges forward in apiserver/admission.py;
+                 this controller is the source of truth that releases)
+  - gangquota.py per-namespace active-gang slots enforced at the gang
+                 manager's pop gate — whole PodGroups admitted or parked
+                 as units, with the blocking quota named
+  - drf.py       per-tenant usage carry + dominant-share kernel and its
+                 numpy parity oracle; drain ordering and preemption
+                 pricing terms (KTPU_DRF=0 is the measured control)
+  - bands.py     PriorityClass-derived named bands replacing the single
+                 lane threshold, with per-band SLO targets
+  - metrics.py   QuotaMetrics / TenancyMetrics families
+"""
+
+from .bands import Band, BandCatalog, BEST_EFFORT, EXPRESS_ANNOTATION, \
+    SLO_ANNOTATION
+from .drf import DRFAccount, RESOURCES, TENANT_LABEL, \
+    dominant_shares_reference, drf_enabled, drf_order_reference, tenant_of
+from .gangquota import ACTIVE_GANGS_KEY, GangQuotaGate, QuotaBlock
+from .metrics import QuotaMetrics, TenancyMetrics
+from .quota import TenantQuotaController, quota_headroom
+
+__all__ = [
+    "ACTIVE_GANGS_KEY", "BEST_EFFORT", "Band", "BandCatalog",
+    "DRFAccount", "EXPRESS_ANNOTATION", "GangQuotaGate", "QuotaBlock",
+    "QuotaMetrics", "RESOURCES", "SLO_ANNOTATION", "TENANT_LABEL",
+    "TenancyMetrics", "TenantQuotaController",
+    "dominant_shares_reference", "drf_enabled", "drf_order_reference",
+    "quota_headroom", "tenant_of",
+]

@@ -211,13 +211,15 @@ class TestMetricsRegistry:
         exposition."""
         from kubernetes_tpu.autoscaler import AutoscalerMetrics
         from kubernetes_tpu.scheduler.metrics import SchedulerMetrics
+        from kubernetes_tpu.tenancy import QuotaMetrics, TenancyMetrics
         classes = [obj for name, obj in
                    inspect.getmembers(metrics_mod, inspect.isclass)
                    if name.endswith("Metrics") and name != "_Metric"]
         assert len(classes) >= 5  # Gang/Informer/Robustness/Serving/APIServer
         mr = MetricsRegistry()
         declared = set()
-        for cls in classes + [SchedulerMetrics, AutoscalerMetrics]:
+        for cls in classes + [SchedulerMetrics, AutoscalerMetrics,
+                              QuotaMetrics, TenancyMetrics]:
             inst = cls()
             mr.add_registry(cls.__name__, inst.registry)
             with inst.registry._lock:

@@ -1,0 +1,417 @@
+"""Multi-tenancy (tenancy/): ResourceQuota admission + deterministic
+reconciliation, the per-namespace gang-quota gate, DRF fair share with
+kernel-vs-oracle parity, PriorityClass band SLO accounting, and the
+`-m slow` isolation soak (one abusive tenant cannot starve nine steady
+ones, and the whole run is a pure function of the seed).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import Quantity
+from kubernetes_tpu.api.policy import PriorityClass
+from kubernetes_tpu.api.scheduling import pod_group_key
+from kubernetes_tpu.api.wellknown import LABEL_POD_GROUP
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.scheduler.gang import ADMIT, PARK_QUOTA, GangManager
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.state import Client
+from kubernetes_tpu.tenancy import (ACTIVE_GANGS_KEY, BandCatalog,
+                                    DRFAccount, GangQuotaGate,
+                                    TENANT_LABEL, TenantQuotaController,
+                                    dominant_shares_reference,
+                                    quota_headroom, tenant_of)
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def make_pod(name, cpu="100m", mem="64Mi", ns="default", tenant=None,
+             group=None, priority=None):
+    labels = {}
+    if tenant is not None:
+        labels[TENANT_LABEL] = tenant
+    if group is not None:
+        labels[LABEL_POD_GROUP] = group
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu),
+                          "memory": Quantity(mem)}))]))
+    if priority is not None:
+        pod.spec.priority = priority
+    return pod
+
+
+def make_quota(name, hard, ns="default"):
+    return api.ResourceQuota(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ResourceQuotaSpec(
+            hard={k: Quantity(v) for k, v in hard.items()}))
+
+
+def make_group(name, min_member, ns="default"):
+    from kubernetes_tpu.api.scheduling import PodGroup, PodGroupSpec
+    return PodGroup(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=PodGroupSpec(min_member=min_member))
+
+
+# ------------------------------------------------ admission round-trip
+
+
+class TestQuotaAdmissionRoundTrip:
+    def test_reject_counts_by_namespace_and_resource(self):
+        srv = APIServer().start()
+        try:
+            client = HTTPClient(srv.address)
+            client.resource_quotas("default").create(
+                make_quota("q", {"pods": "2"}))
+            client.pods("default").create(make_pod("a"))
+            client.pods("default").create(make_pod("b"))
+            with pytest.raises(PermissionError, match="exceeded quota"):
+                client.pods("default").create(make_pod("c"))
+            # the denial reached the QuotaMetrics family with the
+            # exhausted key, and the refund left used at the cap
+            assert srv.quota_metrics.admission_rejections.value(
+                namespace="default", resource="pods") == 1.0
+            used = client.resource_quotas("default").get("q").status.used
+            assert used["pods"].value() == 2
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------- deterministic reconciler
+
+
+class TestTenantQuotaController:
+    def test_reconcile_under_churn(self):
+        client = Client()
+        client.resource_quotas("default").create(
+            make_quota("q", {"pods": "10", "requests.cpu": "4"}))
+        ctrl = TenantQuotaController(client)
+        # quota created out-of-band: first pass writes the zero totals
+        assert ctrl.sync_all() == 1
+        for i in range(3):
+            client.pods("default").create(make_pod(f"p{i}", cpu="500m"))
+        assert ctrl.sync_all() == 1
+        q = client.resource_quotas("default").get("q")
+        assert q.status.used["pods"].value() == 3
+        assert q.status.used["requests.cpu"].milli_value() == 1500
+        # converged pass: zero writes (the determinism surface)
+        assert ctrl.sync_all() == 0
+        client.pods("default").delete("p0")
+        assert ctrl.sync_all() == 1
+        q = client.resource_quotas("default").get("q")
+        assert q.status.used["pods"].value() == 2
+        head = quota_headroom(
+            client.resource_quotas().list(namespace=None))
+        assert head["default"]["pods"]["free"] == "8"
+
+    def test_active_gang_key_keeps_admissions_charge(self):
+        """A hard key naming no recountable resource keeps whatever
+        used value admission (or the gate's bookkeeping) recorded."""
+        client = Client()
+        rq = make_quota("q", {ACTIVE_GANGS_KEY: "2"})
+        rq.status.used = {ACTIVE_GANGS_KEY: Quantity("1")}
+        client.resource_quotas("default").create(rq)
+        ctrl = TenantQuotaController(client)
+        ctrl.sync_all()
+        q = client.resource_quotas("default").get("q")
+        assert q.status.used[ACTIVE_GANGS_KEY].value() == 1
+
+
+# ----------------------------------------------- gang quota at the gate
+
+
+class TestGangQuotaGate:
+    def test_slot_accounting(self):
+        quotas = [make_quota("q", {ACTIVE_GANGS_KEY: "1"})]
+        gate = GangQuotaGate(lambda: quotas)
+        assert gate.try_admit("default/g1") is None
+        block = gate.try_admit("default/g2")
+        assert block is not None
+        assert block.reason() == "QuotaExhausted"
+        assert block.namespace == "default"
+        assert block.resource == ACTIVE_GANGS_KEY
+        assert "1/1" in block.message("default/g2")
+        # idempotent while held; other namespaces unlimited
+        assert gate.try_admit("default/g1") is None
+        assert gate.try_admit("team-b/g9") is None
+        assert gate.release("default/g1") is True
+        assert gate.release("default/g1") is False
+        assert gate.try_admit("default/g2") is None
+        rep = gate.report()
+        assert rep["default"]["active"] == 1
+        assert rep["default"]["limit"] == 1
+
+    def test_queue_parks_and_releases_whole_gangs(self):
+        clock = FakeClock()
+        groups = {("default", "g1"): make_group("g1", 2),
+                  ("default", "g2"): make_group("g2", 2)}
+        quotas = [make_quota("q", {ACTIVE_GANGS_KEY: "1"})]
+        gate = GangQuotaGate(lambda: quotas)
+        q = SchedulingQueue(clock=clock)
+        gm = GangManager(lambda ns, n: groups.get((ns, n)), clock=clock,
+                         quota_gate=gate)
+        q.gang = gm
+        from kubernetes_tpu.scheduler.debugger import \
+            UnschedulableAttribution
+        q.attribution = UnschedulableAttribution(clock=clock)
+        for g in ("g1", "g2"):
+            for i in range(2):
+                q.add(make_pod(f"{g}-m{i}", group=g))
+        out = q.pop_batch(10, timeout=0)
+        # exactly one gang fits the single active slot; the other parks
+        # as a UNIT with the quota attribution, not a scheduler failure
+        popped_gangs = {pod_group_key(p) for p in out}
+        assert len(out) == 2 and len(popped_gangs) == 1
+        parked_gang = ({"default/g1", "default/g2"} - popped_gangs).pop()
+        _, gname = parked_gang.split("/")
+        rec = q.attribution.get(f"default/{gname}-m0")
+        assert rec is not None and rec["reason"] == "QuotaExhausted"
+        assert parked_gang in rec["message"]
+        # the admitted gang finishing returns the slot; the queue's next
+        # flush reactivates the parked members without waiting out the
+        # 60s parked-expiry backstop
+        for p in out:
+            gm.pod_bound(p)
+            p2 = api.Pod(metadata=p.metadata, spec=p.spec)
+            gm.pod_dropped(p2)
+        assert gate.holds(popped_gangs.pop()) is False
+        clock.step(1.0)  # flush is idempotent per clock instant
+        out2 = q.pop_batch(10, timeout=0)
+        assert {pod_group_key(p) for p in out2} == {parked_gang}
+        assert len(out2) == 2
+
+
+# --------------------------------------------------- DRF kernel parity
+
+
+class TestDRFParity:
+    def test_randomized_kernel_vs_oracle(self):
+        rng = np.random.default_rng(1234)
+        for trial in range(5):
+            T = int(rng.integers(2, 9))
+            acct = DRFAccount()
+            acct.set_capacity([64_000.0, 512 << 30, 64.0])
+            tenants = [f"t{j}" for j in range(T)]
+            # charge a random standing load per tenant
+            for j, t in enumerate(tenants):
+                for k in range(int(rng.integers(0, 6))):
+                    acct.charge(make_pod(
+                        f"std-{trial}-{j}-{k}", tenant=t,
+                        cpu=f"{int(rng.integers(1, 40))}00m",
+                        mem=f"{int(rng.integers(1, 65))}Mi"))
+            usage, cap, _ = acct._snapshot()
+            shares_dev = acct.dominant_shares()
+            shares_ref = dominant_shares_reference(usage, cap)
+            assert np.array_equal(shares_dev, shares_ref)
+            # a batch above DEVICE_FLOOR exercises the device ordering
+            P = int(DRFAccount.DEVICE_FLOOR + rng.integers(0, 64))
+            pods = [make_pod(
+                f"b-{trial}-{i}", tenant=tenants[int(rng.integers(0, T))],
+                priority=int(rng.choice((0, 0, 0, 1000))))
+                for i in range(P)]
+            dev = [p.metadata.name for p in acct.order_batch(pods)]
+            ref = [p.metadata.name
+                   for p in acct.order_batch_reference(pods)]
+            assert dev == ref
+
+    def test_order_prefers_undershare_within_band(self):
+        acct = DRFAccount()
+        acct.set_capacity([10_000.0, 1 << 30, 1.0])
+        # hog consumes half the cluster's cpu; sparrow nothing
+        acct.charge(make_pod("hog-load", tenant="hog", cpu="5000m"))
+        a = make_pod("z-sparrow", tenant="sparrow")
+        b = make_pod("a-hog", tenant="hog")
+        ordered = acct.order_batch_reference([b, a])
+        assert [p.metadata.name for p in ordered] == ["z-sparrow", "a-hog"]
+        # equal shares: pop order (FIFO) is untouched — the flag-on
+        # default cannot perturb single-tenant schedules
+        acct2 = DRFAccount()
+        acct2.set_capacity([10_000.0, 1 << 30, 1.0])
+        pods = [make_pod(f"p{i}") for i in range(5)]
+        assert [p.metadata.name
+                for p in acct2.order_batch_reference(pods)] == \
+            [p.metadata.name for p in pods]
+        # priority still dominates share
+        hi = make_pod("hi", tenant="hog", priority=1000)
+        assert [p.metadata.name
+                for p in acct.order_batch_reference([a, hi])][0] == "hi"
+
+    def test_charge_release_idempotent(self):
+        acct = DRFAccount()
+        acct.set_capacity([1000.0, 1 << 30, 1.0])
+        p = make_pod("p1", tenant="t1", cpu="250m")
+        acct.charge(p)
+        acct.charge(p)  # idempotent by key
+        assert acct.share_of("t1") == pytest.approx(0.25)
+        # sole tenant: fair share 1.0, 0.25 is under it
+        assert "t1" not in acct.overshare_ranks()
+        acct.charge(make_pod("p2", tenant="t2", cpu="100m"))
+        acct.charge(make_pod("p3", tenant="t3", cpu="50m"))
+        acct.charge(make_pod("p4", tenant="t4", cpu="50m"))
+        # T=4, fair share 0.25: t1 at exactly 0.25 is not over; one
+        # more pod pushes it strictly above while t2-t4 stay under
+        acct.charge(make_pod("p5", tenant="t1", cpu="100m"))
+        ranks = acct.overshare_ranks()
+        assert "t1" in ranks and ranks["t1"] > 0
+        assert "t2" not in ranks
+        acct.release_key("default/p5")
+        acct.release(p)
+        acct.release(p)
+        assert acct.share_of("t1") == 0.0
+
+    def test_preempt_pricing_prefers_overshare_victims(self):
+        """The host band sort consumed by kernel AND oracle folds the
+        over-share rank in: an over-share tenant's pod prices ahead of
+        an equal-priority pod of an in-share tenant."""
+        from kubernetes_tpu.scheduler.kernels.preempt import _rank_and_sort
+
+        class U:
+            def __init__(self, key, oshare):
+                self.pdb = False
+                self.top = 0
+                self.start = ""
+                self.startr = 0
+                self.key = key
+                self.oshare = oshare
+        row = [U("a", 0), U("b", 250000)]
+        _rank_and_sort([row])
+        assert [u.key for u in row] == ["b", "a"]
+
+
+# ------------------------------------------------- band SLO accounting
+
+
+class TestBandSLO:
+    def _catalog(self):
+        pcs = [
+            PriorityClass(
+                metadata=api.ObjectMeta(
+                    name="gold",
+                    annotations={
+                        "serving.ktpu/slo-p99-bind-seconds": "1.0",
+                        "serving.ktpu/express": "true"}),
+                value=1000),
+            PriorityClass(
+                metadata=api.ObjectMeta(
+                    name="silver",
+                    annotations={
+                        "serving.ktpu/slo-p99-bind-seconds": "30.0"}),
+                value=100),
+        ]
+        return BandCatalog.from_priority_classes(pcs)
+
+    def test_catalog_lookup_and_lane(self):
+        cat = self._catalog()
+        assert cat.names() == ["gold", "silver", "best-effort"]
+        assert cat.band_of(1500).name == "gold"
+        assert cat.band_of(100).name == "silver"
+        assert cat.band_of(5).name == "best-effort"
+        assert cat.lane_threshold() == 1000
+        assert cat.targets() == {"gold": 1.0, "silver": 30.0}
+
+    def test_band_report_judges_each_band_against_its_target(self):
+        from kubernetes_tpu.serving.slo import SLOTracker
+        clock = FakeClock()
+        tracker = SLOTracker(clock=clock)
+        fast = make_pod("fast", priority=1000)
+        slow = make_pod("slow", priority=100)
+        tracker.observe(fast)
+        tracker.observe(slow)
+        clock.step(0.5)
+        fast.spec.node_name = "n1"
+        tracker.observe(fast)
+        clock.step(59.5)
+        slow.spec.node_name = "n2"
+        tracker.observe(slow)
+        rep = tracker.band_report(self._catalog())
+        assert rep["gold"]["slo_met"] is True
+        assert rep["gold"]["p99_s"] == pytest.approx(0.5)
+        assert rep["silver"]["slo_met"] is False
+        assert rep["silver"]["p99_s"] == pytest.approx(60.0)
+
+    def test_scheduler_lane_derives_from_priority_classes(self):
+        client = Client()
+        client.resource(PriorityClass).create(PriorityClass(
+            metadata=api.ObjectMeta(
+                name="express-band",
+                annotations={"serving.ktpu/express": "true"}),
+            value=500))
+        from kubernetes_tpu.scheduler import Scheduler
+        sched = Scheduler(client, batch_size=8)
+        try:
+            sched.informers.start()
+            sched.informers.wait_for_cache_sync()
+            assert sched.lane_priority == 500
+            assert sched.bands.band_of(700).name == "express-band"
+        finally:
+            sched.informers.stop()
+
+
+# ----------------------------------------------------- tenant plumbing
+
+
+class TestTenantPlumbing:
+    def test_tenant_of_label_then_namespace(self):
+        assert tenant_of(make_pod("a", tenant="t9", ns="other")) == "t9"
+        assert tenant_of(make_pod("b", ns="team-a")) == "team-a"
+
+    def test_loadgen_tenant_stamping_is_flag_conditional(self):
+        from kubernetes_tpu.serving.loadgen import LoadGen
+        base = LoadGen(None, seed=5).make_schedule(50)
+        off = LoadGen(None, seed=5, tenants=0).make_schedule(50)
+        on = LoadGen(None, seed=5, tenants=4).make_schedule(50)
+        assert [(e.t, e.cls) for e in base] == [(e.t, e.cls) for e in off]
+        # tenants on: same arrival script, plus a tenant draw per event
+        assert [(e.t, e.cls) for e in on] == [(e.t, e.cls) for e in base]
+        assert all("tenant" not in e.params for e in off)
+        drawn = {e.params["tenant"] for e in on}
+        assert drawn <= set(range(4)) and len(drawn) > 1
+        # pure function of (seed, n)
+        on2 = LoadGen(None, seed=5, tenants=4).make_schedule(50)
+        assert [e.params["tenant"] for e in on] == \
+            [e.params["tenant"] for e in on2]
+
+
+# ------------------------------------------------------- isolation soak
+
+
+def _soak(seed):
+    from kubernetes_tpu.serving.harness import ServingHarness
+    h = ServingHarness(
+        seed=seed, nodes=8, rate=12.0, tenants=9,
+        mix=(("singleton", 0.5), ("priority", 0.3), ("job", 0.2)),
+        quotas={"abuse": {ACTIVE_GANGS_KEY: "2"}},
+        abuse_rate=8.0, gang_run_ticks=2)
+    try:
+        rep = h.run(n_events=120, max_ticks=400, quiesce_ticks=10,
+                    abuse_events=40)
+        gate = h.scheduler.gang_quota.report()
+        return rep, gate
+    finally:
+        h.close()
+
+
+@pytest.mark.slow
+class TestIsolationSoak:
+    def test_abusive_tenant_contained_and_deterministic(self):
+        rep1, gate1 = _soak(42)
+        rep2, _ = _soak(42)
+        # invariants green, nothing permanently stuck
+        assert rep1.violations == []
+        assert rep1.stuck == []
+        # the gate never over-admitted the abuser
+        assert all(ns_rep["active"] <= 2
+                   for ns, ns_rep in gate1.items() if ns == "abuse")
+        # every steady tenant got latency attribution alongside the abuser
+        classes = rep1.tenant_slo["classes"]
+        assert "abuse" in classes
+        steady = [c for c in classes if c.startswith("tenant-")]
+        assert len(steady) >= 5
+        # determinism: same seed => identical arrival AND bind event logs
+        assert rep1.arrival_log == rep2.arrival_log
+        assert rep1.bind_log == rep2.bind_log
