@@ -1,7 +1,7 @@
 # Convenience entries (the reference's hack/ equivalents).
 
 .PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
-	bench-preempt bench-tenancy
+	bench-preempt bench-tenancy bench-resilience
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -34,6 +34,14 @@ bench-affinity:
 # (BENCH_r09's source)
 bench-preempt:
 	JAX_PLATFORMS=cpu python bench.py preempt
+
+# resilience bench: the HTTP + HA + replication chaos soak under a
+# seeded fault schedule (wire resets/latency/drops, torn-WAL restarts,
+# leader kills, lease suppression, one promote drill) vs the fault-free
+# control of the same schedule — failover percentiles, per-class p99
+# bind degradation, invariant sweeps (BENCH_r11's source; recurring)
+bench-resilience:
+	JAX_PLATFORMS=cpu python bench.py resilience
 
 # tenant-isolation bench: one abusive tenant's gang storm vs nine
 # steady tenants with DRF + active-gang quota on, the no-tenancy

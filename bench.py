@@ -1930,6 +1930,145 @@ def tenancy_main():
     }))
 
 
+RES_NODES = int(os.environ.get("BENCH_RES_NODES", "8"))
+RES_EVENTS = int(os.environ.get("BENCH_RES_EVENTS", "120"))
+RES_SEED = int(os.environ.get("BENCH_RES_SEED", "17"))
+RES_QUIESCE = int(os.environ.get("BENCH_RES_QUIESCE", "30"))
+#: the wire fault mix the resilience suite runs (the same rates as
+#: tests/test_chaos.py TestWireHAChaos._FAULTS)
+RES_FAULTS = dict(error_rate=0.05, reset_rate=0.05, latency_rate=0.08,
+                  latency_max=0.003, watch_drop_rate=0.15)
+
+
+def _resilience_run(tag, faulted):
+    """One seeded serving soak at the wire config: HTTP transport, HA
+    standby pairs, SLO tracking, with_restarts/with_tears/ha flags ON in
+    BOTH legs so the schedule is identical. The faulted leg injects the
+    wire fault mix, actually executes the restart/tear/leader-kill/lease
+    events, follows with a StoreReplica through the chaos proxy, and
+    runs ONE promote drill at the midpoint; the control leg
+    (enable_restarts=False, zero rates, no replica) runs the same
+    workload churn and node kills fault-free — the p99 denominator."""
+    import shutil
+    import tempfile
+    from kubernetes_tpu.chaos import ChaosHarness
+    tmp = tempfile.mkdtemp(prefix=f"bench-res-{tag}-")
+    kw = dict(RES_FAULTS) if faulted else dict(error_rate=0.0)
+    h = ChaosHarness(seed=RES_SEED, nodes=RES_NODES, http=True, ha=True,
+                     slo=True, with_restarts=True, with_tears=True,
+                     replica=faulted, enable_restarts=faulted,
+                     wal_path=os.path.join(tmp, "res.wal"), **kw)
+    try:
+        return h.run(n_events=RES_EVENTS, quiesce_steps=RES_QUIESCE,
+                     promote_at_step=RES_EVENTS // 2 if faulted else None)
+    finally:
+        h.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def resilience_main():
+    """`bench.py resilience` — the recurring resilience bench (ISSUE 17):
+    a serving soak at the wire config under a seeded fault schedule
+    (resets, latency, watch drops, torn-WAL restarts, leader kills,
+    lease suppression, one replica-promote drill). Sections:
+
+      - failover: virtual-second percentiles over every timed leader
+        failover (lease loss -> the standby's first bind/acquire)
+      - slo_degradation: per-class p99 bind latency, faulted vs the
+        fault-free control of the SAME schedule — the headline is the
+        worst class's ratio
+      - invariants: both legs' sweep results (gang atomicity, zero
+        double-binds, WAL replay, replication horizon) — green is the
+        acceptance floor, the percentiles are the trend to watch
+      - replication: follower lag high-water, reconnects, and the
+        stream-tagged wire faults the replication stream itself absorbed
+      - deterministic: two same-seed faulted runs compared on event log
+        and semantic end state
+    """
+    import math
+
+    def pct(vals, p):
+        if not vals:
+            return None
+        i = min(len(vals) - 1, max(0, int(math.ceil(p * len(vals))) - 1))
+        return round(vals[i], 3)
+
+    r1 = _resilience_run("a", faulted=True)
+    r2 = _resilience_run("b", faulted=True)
+    r0 = _resilience_run("ctl", faulted=False)
+    deterministic = bool(r1.events == r2.events
+                         and r1.store_state == r2.store_state)
+    fo = sorted(s for _name, s in r1.failovers)
+    by_comp = {}
+    for name, s in r1.failovers:
+        by_comp.setdefault(name, []).append(round(s, 3))
+    failover = {"count": len(fo), "p50_s": pct(fo, 0.50),
+                "p95_s": pct(fo, 0.95), "p99_s": pct(fo, 0.99),
+                "max_s": pct(fo, 1.0), "unit": "virtual_seconds",
+                "by_component": by_comp}
+    classes = {}
+    worst_ratio = 0.0
+    for cls, entry in (r1.slo or {}).get("classes", {}).items():
+        p99 = entry.get("bind", {}).get("p99_s")
+        ctl = ((r0.slo or {}).get("classes", {})
+               .get(cls, {}).get("bind", {}).get("p99_s"))
+        # denominator clamped to 1 virtual second: an insta-bind control
+        # cannot manufacture an infinite ratio (the tenancy bench's rule)
+        ratio = (round(p99 / max(ctl or 0.0, 1.0), 3)
+                 if p99 is not None else None)
+        classes[cls] = {"faulted_p99_s": p99, "control_p99_s": ctl,
+                        "degradation": ratio,
+                        "count": entry.get("bind", {}).get("count")}
+        if ratio is not None:
+            worst_ratio = max(worst_ratio, ratio)
+    stream_faults = {k: v for k, v in sorted(r1.fault_counts.items())
+                     if k.endswith("_replication")}
+
+    print(json.dumps({
+        "metric": "resilience worst per-class p99 bind degradation "
+                  f"({RES_EVENTS} chaos events x {RES_NODES} nodes, "
+                  "HTTP + HA + replication + promote drill, vs "
+                  "fault-free control of the same schedule)",
+        "value": worst_ratio,
+        "unit": "x_of_fault_free_control",
+        "detail": {
+            "seed": RES_SEED, "events": RES_EVENTS, "nodes": RES_NODES,
+            "faults": RES_FAULTS,
+            "failover": failover,
+            "slo_degradation": classes,
+            "invariants": {
+                "faulted_ok": bool(r1.ok),
+                "faulted_violations": len(r1.violations),
+                "violations_sample": r1.violations[:5],
+                "control_ok": bool(r0.ok),
+                "zero_double_binds": bool(
+                    not any("double-bind" in v for v in r1.violations)),
+            },
+            "deterministic": deterministic,
+            "chaos": {
+                "pods_bound": r1.pods_bound,
+                "gangs_created": r1.gangs_created,
+                "nodes_killed": r1.nodes_killed,
+                "wal_tears": r1.wal_tears,
+                "records_torn": r1.records_torn,
+                "leader_kills": r1.leader_kills,
+                "lease_suppressions": r1.lease_suppressions,
+                "promoted": bool(r1.promoted),
+            },
+            "replication": {
+                "lag_records_final": r1.replication_lag_records,
+                "lag_records_max": r1.replication_max_lag_records,
+                "reconnects": r1.replication_reconnects,
+                "stream_faults": stream_faults,
+            },
+            "fault_counts": dict(sorted(r1.fault_counts.items())),
+            "control": "enable_restarts=False + zero fault rates + no "
+                       "replica; ha/with_restarts/with_tears flags stay "
+                       "on so the schedule is byte-identical",
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
@@ -1941,6 +2080,8 @@ if __name__ == "__main__":
         preempt_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "tenancy":
         tenancy_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "resilience":
+        resilience_main()
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

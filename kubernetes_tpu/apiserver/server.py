@@ -329,6 +329,18 @@ class APIServer:
         scheme = "https" if self._tls else "http"
         return f"{scheme}://{host}:{port}"
 
+    def attach_replica(self, replica,
+                       max_lag_records: int = 1024) -> None:
+        """Wire a StoreReplica into this server's observability surface:
+        its lag/promote attribution joins /debug/pending and a
+        replication-lag readiness check gates /readyz (a standby too far
+        behind would lose acknowledged writes if promoted, so it must
+        stop answering ready)."""
+        from ..utils.healthz import replication_contributor
+        self.pending_providers.append(replica.pending_report)
+        self.health.add_all(replication_contributor(
+            replica, max_lag_records=max_lag_records))
+
     def start(self) -> "APIServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="apiserver")

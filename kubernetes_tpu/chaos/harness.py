@@ -233,6 +233,18 @@ class ChaosReport:
     #: containers virtual kubelets GCed for pods the store lost
     orphans_gced: int = 0
     promoted: bool = False
+    #: replication follower accounting (replica=True): the final and
+    #: worst-observed primary-vs-replica rv gap, and how many times the
+    #: replication stream had to reconnect through the chaos proxy
+    replication_lag_records: int = 0
+    replication_max_lag_records: int = 0
+    replication_reconnects: int = 0
+    #: injected-fault totals by kind (wire_reset, wire_reset_replication,
+    #: watch_drop, ...) — the proof faults actually fired
+    fault_counts: dict = field(default_factory=dict)
+    #: per-class SLO report (slo=True): the SLOTracker's bind/startup
+    #: percentiles for the "gang"/"solo" classes
+    slo: dict = field(default_factory=dict)
     #: the semantic end state — sorted (resource, namespace, name,
     #: phase, bound) tuples; node choice and resourceVersions excluded.
     #: Comparable between a faulted and a fault-free run of one schedule.
@@ -265,7 +277,8 @@ class ChaosHarness:
                  autoscaler: bool = False,
                  autoscaler_cooldown: float = 60.0,
                  autoscaler_max_nodes: int = 64,
-                 preempt_storm: bool = False):
+                 preempt_storm: bool = False,
+                 slo: bool = False):
         self.seed = seed
         #: jax.sharding.Mesh for the scheduler's drain (None = single
         #: device). The determinism contract must survive sharding: the
@@ -326,10 +339,14 @@ class ChaosHarness:
         self._server = None
         if http:
             # wire mode: a real hub over the store; the control plane's
-            # client speaks actual HTTP through the injector's wire hook
+            # client speaks actual HTTP through the injector's wire hook.
+            # The hub's /metrics aggregates the robustness families
+            # (replication lag, slow renews, injected faults) beside its
+            # own request counters — the scrape surface under test.
             from ..apiserver.server import APIServer
             from ..apiserver.httpclient import HTTPClient
-            self._server = APIServer(store=store).start()
+            self._server = APIServer(
+                store=store, metrics=self._make_server_metrics()).start()
             self.client = ChaosHTTPClient(
                 self.injector,
                 HTTPClient(self._server.address,
@@ -348,18 +365,42 @@ class ChaosHarness:
         self._promote_violations: List[str] = []
         self._promoted = False
         if replica:
-            if http:
-                raise ValueError("replica drill runs in-process; the wire "
-                                 "replica story is test_replication's")
             if wal_path is None:
                 raise ValueError("replica drill needs wal_path (the "
                                  "standby journals what it applies)")
             from ..state.replication import ReadOnlyStore, StoreReplica
+            if http:
+                # the wire replica: the follower LISTs and watches the
+                # primary hub over actual HTTP, through its OWN faulted
+                # client — the replication stream itself takes resets,
+                # latency, and watch drops, tagged per-stream so the run
+                # can prove the follower (not just the control plane)
+                # rode through them
+                from ..apiserver.httpclient import HTTPClient
+                follower_client = HTTPClient(
+                    self._server.address,
+                    wire_hook=self.injector.make_wire_hook(
+                        stream="replication"))
+            else:
+                follower_client = Client(store)
             self._replica = StoreReplica(
-                Client(store),
+                follower_client,
                 store=ReadOnlyStore(wal_path=wal_path + ".replica",
                                     metrics=self.metrics),
-                seed=seed)
+                seed=seed, metrics=self.metrics)
+            if http:
+                # lag/promote attribution in /debug/pending; a
+                # replication-lag check gates the hub's /readyz
+                self._server.attach_replica(self._replica)
+        #: per-class SLO observation under chaos (slo=True): created
+        #: pods carry the serving class label ("gang"/"solo") and a
+        #: scan-driven SLOTracker on the shared FakeClock stamps their
+        #: lifecycle each tick — deterministic, so the resilience bench
+        #: can compare per-class bind p99 faulted-vs-control
+        self.slo = None
+        if slo:
+            from ..serving.slo import SLOTracker
+            self.slo = SLOTracker(clock=self.clock)
         self._gang_counter = 0
         self._pod_counter = 0
         self._started = False
@@ -429,6 +470,17 @@ class ChaosHarness:
                 # the virtual kubelets own heartbeats here — and the
                 # injector's node kills must stay authoritative
                 maintain_heartbeats=False)
+
+    def _make_server_metrics(self):
+        """A hub MetricsRegistry with the harness's robustness families
+        attached: GET /metrics on the (primary or promoted-standby)
+        apiserver exposes replication_lag_records,
+        leaderelection_slow_renews_total, and the injected-fault counters
+        beside the hub's own request families."""
+        from ..observability import MetricsRegistry
+        m = MetricsRegistry()
+        m.add_registry("robustness", self.metrics.registry)
+        return m
 
     def _build_scheduler(self, factory: SharedInformerFactory,
                          client=None) -> Scheduler:
@@ -745,6 +797,22 @@ class ChaosHarness:
 
     # ----------------------------------------------------- promote drill
 
+    def _replica_barrier(self, timeout: float = 15.0) -> None:
+        """Wall-clock catch-up barrier against a STATIC primary (post-
+        quiesce, or pre-promote with the drill's schedule paused): wait
+        until the follower's contents match the primary's. On timeout the
+        replication sweep that follows reports the divergence — the
+        barrier only bounds how long we give the follower to drain its
+        stream, it never hides a loss."""
+        if self._replica is None:
+            return
+        want = self.admin.store.contents()
+        deadline = self.wall_clock.now() + timeout
+        while self.wall_clock.now() < deadline:
+            if self._replica.store.contents() == want:
+                return
+            self.wall_clock.sleep(0.01)
+
     def promote_replica(self, timeout: float = 30.0) -> List[str]:
         """The replica-promote drill (replica=True): kill the primary
         store FOR GOOD, gate on the follower being fully synced, promote
@@ -788,8 +856,26 @@ class ChaosHarness:
                     f"replication horizon lost (standby has "
                     f"{got.get(key)})")
         # the primary dies for good; every component fails over
-        primary.close()
-        new_client = ChaosClient(self.injector, store=promoted)
+        old_server = None
+        if self.http:
+            # wire mode: a STANDBY hub comes up over the promoted store
+            # and every component's HTTP client is rebuilt against its
+            # address (wire faults and all); the old hub — and the
+            # primary store under it — die only after the repoint, so
+            # in-flight streams sever into a reconnect, not a hang
+            from ..apiserver.server import APIServer
+            from ..apiserver.httpclient import HTTPClient
+            old_server = self._server
+            self._server = APIServer(
+                store=promoted,
+                metrics=self._make_server_metrics()).start()
+            self._server.attach_replica(self._replica)
+            new_client = ChaosHTTPClient(
+                self.injector,
+                HTTPClient(self._server.address,
+                           wire_hook=self.injector.make_wire_hook()))
+        else:
+            new_client = ChaosClient(self.injector, store=promoted)
         self.admin = Client(promoted)
         self.client = new_client
         if self.ha:
@@ -814,6 +900,9 @@ class ChaosHarness:
         if self.autoscaler is not None:
             self.autoscaler.client = new_client
             self._ca_factory.repoint(new_client)
+        if old_server is not None:
+            old_server.stop()
+        primary.close()
         # the standby journals what it applied: the WAL-replay invariant
         # now checks the promoted store against ITS OWN journal
         self.wal_path = self.wal_path + ".replica"
@@ -911,6 +1000,23 @@ class ChaosHarness:
                 (ev[2], ev[3]) for ev in self.injector.events
                 if ev[1] == "leader_failover"]
         report.violations += self._promote_violations
+        if self._replica is not None and not self._promoted:
+            # the quiesced primary is static: the follower must converge
+            # to EXACTLY its contents (a wall-clock catch-up barrier,
+            # then the replication sweep — every acknowledged record at
+            # the same rv, no forks)
+            self._replica_barrier()
+            from .invariants import check_replication
+            report.violations += check_replication(self.admin.store,
+                                                   self._replica.store)
+        if self._replica is not None:
+            report.replication_lag_records = self._replica.last_lag_records
+            report.replication_max_lag_records = \
+                self._replica.max_lag_records
+            report.replication_reconnects = self._replica.reconnects
+        if self.slo is not None:
+            report.slo = self.slo.report()
+        report.fault_counts = dict(self.injector.fault_counts)
         report.promoted = self._promoted
         report.orphans_gced = self._orphans_gced
         report.events = list(self.injector.events)
@@ -1004,7 +1110,11 @@ class ChaosHarness:
                 if self.kill_leader(ev["election"]) is not None:
                     report.leader_kills += 1
         elif action == "suppress_lease":
-            if self.ha and not self.injector.lease_suppressed:
+            # gated like the restart actions: a fault-free control run
+            # (enable_restarts=False) keeps the identical schedule but
+            # never actually suppresses the election lock
+            if self.ha and self.enable_restarts \
+                    and not self.injector.lease_suppressed:
                 self.injector.suppress_lease(True)
                 report.lease_suppressions += 1
         elif action == "resume_lease":
@@ -1043,6 +1153,12 @@ class ChaosHarness:
         if group is not None:
             from ..api.wellknown import LABEL_POD_GROUP
             labels[LABEL_POD_GROUP] = group
+        if self.slo is not None:
+            # the serving class the SLO tracker buckets by: gang members
+            # vs singletons — two latency populations worth separating
+            # (gangs wait at the permit gate; solos don't)
+            from ..serving.loadgen import CLASS_LABEL
+            labels[CLASS_LABEL] = "gang" if group is not None else "solo"
         pod = Pod(
             metadata=ObjectMeta(name=name, namespace="default",
                                 labels=labels),
@@ -1100,6 +1216,14 @@ class ChaosHarness:
                 except Exception:
                     pass  # chaos mid-resubmit: the next tick re-syncs
                 self._settle()
+        if self._replica is not None and not self._promoted:
+            # one lag sample per tick: primary rv vs the follower's
+            # high-water mark (sets the replication_lag_records gauge)
+            self._replica.observe_lag(self.admin.store.resource_version)
+        if self.slo is not None:
+            # settled pod listing, sorted-key order, shared FakeClock —
+            # the per-class bind/startup stamps are deterministic
+            self.slo.scan(self.admin.pods().list(namespace=None))
         self.clock.step(self.clock_step)
 
     def _virtual_kubelets(self) -> None:

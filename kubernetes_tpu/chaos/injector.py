@@ -118,6 +118,11 @@ class FaultInjector:
         #: the run's event log: (step, kind, *detail) tuples, identical
         #: across runs with the same (seed, schedule)
         self.events: List[Tuple] = []
+        #: kind -> total faults fired (the metrics counter's plain-dict
+        #: twin, so reports can quote counts without a registry scrape;
+        #: stream-tagged kinds like wire_reset_replication prove the
+        #: replication stream itself took faults)
+        self.fault_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------ driver
 
@@ -228,6 +233,8 @@ class FaultInjector:
                 f"(attempt {attempt})")
 
     def _count(self, kind: str) -> None:
+        with self._lock:
+            self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
         if self.metrics is not None:
             self.metrics.faults_injected.inc(kind=kind)
 
@@ -300,14 +307,32 @@ class FaultInjector:
             self.wire_watch_plans.setdefault(resource, []).append(plan)
         return plan
 
-    def make_wire_hook(self):
+    def make_wire_hook(self, stream: Optional[str] = None):
         """The `HTTPClient(wire_hook=...)` adapter: one callable serving
-        both hook kinds (request faults; watch-stream drop budgets)."""
+        both hook kinds (request faults; watch-stream drop budgets).
+        `stream` tags this client's faults with an extra per-stream count
+        (wire_reset_<stream> / wire_drop_<stream>) so a dedicated client
+        — the replication follower's — can PROVE its own stream took
+        faults, separate from the control plane's totals. The tag never
+        touches the draw signatures, so flag-off runs stay byte-identical."""
         def hook(kind: str, op: str, resource: str, path: str):
             if kind == "watch":
-                self.wire_request("WATCH", resource, path)
-                return self.watch_plan(resource)
-            self.wire_request(op, resource, path)
+                try:
+                    self.wire_request("WATCH", resource, path)
+                except ChaosResetError:
+                    if stream is not None:
+                        self._count(f"wire_reset_{stream}")
+                    raise
+                plan = self.watch_plan(resource)
+                if stream is not None and plan is not None:
+                    self._count(f"wire_drop_{stream}")
+                return plan
+            try:
+                self.wire_request(op, resource, path)
+            except ChaosResetError:
+                if stream is not None:
+                    self._count(f"wire_reset_{stream}")
+                raise
             return None
         return hook
 

@@ -67,6 +67,33 @@ def wal_digest(path: str) -> dict:
     return state
 
 
+def check_replication(primary, replica_store) -> List[str]:
+    """The replication-horizon sweep: against a QUIESCED primary, the
+    follower must hold every acknowledged record at the SAME rv — a key
+    the primary has that the replica lacks is an acknowledged write below
+    the replication horizon lost; a key only the replica has is a forked
+    history; an rv mismatch is a stale or reordered apply. Call only
+    after a catch-up barrier (ChaosHarness._replica_barrier) — mid-stream
+    the follower legitimately trails."""
+    want = primary.contents()
+    got = replica_store.contents()
+    out: List[str] = []
+    for key in sorted(set(want) | set(got)):
+        if key not in got:
+            out.append(
+                f"replication: acknowledged write {key}@{want[key]} "
+                f"missing at the replica")
+        elif key not in want:
+            out.append(
+                f"replication: replica forked — holds {key}@{got[key]} "
+                f"which the primary never acknowledged")
+        elif want[key] != got[key]:
+            out.append(
+                f"replication: {key} at rv {got[key]} on the replica "
+                f"vs {want[key]} on the primary")
+    return out
+
+
 class InvariantChecker:
     def __init__(self, client, scheduler=None,
                  wal_path: Optional[str] = None,

@@ -73,6 +73,9 @@ class ServingReport:
     stuck: List[str] = field(default_factory=list)
     pods_bound: int = 0
     scheduler_restarts: int = 0
+    #: mid-churn store restarts (restart_store) and journal records torn
+    store_restarts: int = 0
+    records_torn: int = 0
 
     @property
     def ok(self) -> bool:
@@ -100,7 +103,8 @@ class ServingHarness:
                  abuse_rate: float = 0.0,
                  abuse_namespace: str = "abuse",
                  abuse_gang_sizes: Tuple[int, int] = (3, 5),
-                 gang_run_ticks: Optional[int] = None):
+                 gang_run_ticks: Optional[int] = None,
+                 wal_path: Optional[str] = None):
         self.seed = seed
         self.n_nodes = nodes
         self.tick_s = tick_s
@@ -120,7 +124,11 @@ class ServingHarness:
             seed=seed, error_rate=error_rate, metrics=self.metrics,
             reset_rate=reset_rate, latency_rate=latency_rate,
             latency_max=latency_max, watch_drop_rate=watch_drop_rate)
-        store = Store()
+        #: journaled when wal_path is given — restart_store() can then
+        #: WAL-replay (or tear) the store mid-churn, the serving-scale
+        #: durability fault the resilience soak composes with wire chaos
+        self.wal_path = wal_path
+        store = Store(wal_path=wal_path, metrics=self.metrics)
         #: fault-free admin view: workload creation (the loadgen) and
         #: virtual-kubelet writes stay stable so the run's INPUT is a
         #: pure function of the seed; only the control plane's handling
@@ -282,11 +290,29 @@ class ServingHarness:
         self._sched_factory.wait_for_cache_sync()
         self._settle()
 
+    def restart_store(self, torn: int = 0) -> int:
+        """WAL-replay the store in place mid-churn (the etcd-restart
+        analog under sustained load): live watch streams sever, informers
+        resume or relist, and with `torn=N` the last N journal records
+        are LOST first — the rv clock regresses and bound pods whose
+        binds were in the torn tail come back Pending. No-op without a
+        wal_path. Returns the records actually torn."""
+        if self.wal_path is None:
+            return 0
+        actual = self.admin.store.restart(torn=torn)
+        if torn > 0:
+            self.injector.tear_wal(actual)
+        self.injector.record("restart_store")
+        self._settle()
+        return actual
+
     # -------------------------------------------------------------- run
 
     def run(self, n_events: int = 200, max_ticks: int = 600,
             quiesce_ticks: int = 40,
             restart_scheduler_at: Optional[int] = None,
+            restart_store_at: Optional[int] = None,
+            store_torn: int = 0,
             abuse_events: int = 0) -> ServingReport:
         """Drive the full schedule, then quiesce (cronjobs suspended,
         faults off) until every arrived pod is bound or terminal (or
@@ -305,6 +331,11 @@ class ServingHarness:
                     and self._tick_idx == restart_scheduler_at:
                 self.restart_scheduler()
                 report.scheduler_restarts += 1
+            if restart_store_at is not None \
+                    and self._tick_idx == restart_store_at \
+                    and self.wal_path is not None:
+                report.records_torn += self.restart_store(torn=store_torn)
+                report.store_restarts += 1
             self._tick()
             if self.loadgen.done and self._abuser_done() and not quiesced:
                 # quiesce: no new arrivals, future cron firings off,
@@ -332,7 +363,8 @@ class ServingHarness:
         report.pods_bound = sum(
             1 for p in self.admin.pods().list(namespace=None)
             if p.spec.node_name)
-        checker = InvariantChecker(self.admin, scheduler=self.scheduler)
+        checker = InvariantChecker(self.admin, scheduler=self.scheduler,
+                                   wal_path=self.wal_path)
         report.violations = checker.check()
         return report
 

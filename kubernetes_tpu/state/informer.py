@@ -199,6 +199,7 @@ class SharedInformer:
             self._bookmark_capable = None  # re-probe the new transport
             if self._watch is not None:
                 self._watch.stop()
+        self.metrics.repoints.inc(resource=self._resource)
 
     def _delays(self) -> Iterator[float]:
         """The reconnect schedule: the shared retry-forever policy (a

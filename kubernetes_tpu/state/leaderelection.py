@@ -33,7 +33,8 @@ class LeaderElector:
                  retry_period: float = DEFAULT_RETRY_PERIOD,
                  on_started_leading: Optional[Callable[[], None]] = None,
                  on_stopped_leading: Optional[Callable[[], None]] = None,
-                 clock: Clock = REAL_CLOCK, metrics=None):
+                 clock: Clock = REAL_CLOCK, metrics=None,
+                 slow_renew_fraction: float = 0.5):
         self.client = client
         self.name = name
         self.identity = identity
@@ -50,8 +51,14 @@ class LeaderElector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.is_leader = False
+        #: a SUCCESSFUL renew landing later than this fraction of
+        #: renew_deadline after the previous one is "slow" — counted and
+        #: logged once per streak, because one more round-trip that slow
+        #: and the holder self-fences
+        self.slow_renew_fraction = slow_renew_fraction
         self._acquire_error_logged = False
         self._release_error_logged = False
+        self._slow_renew_logged = False
         # step()-mode state (the chaos harness's synchronous election):
         # next instant an acquire/renew attempt is due, and the last
         # successful renew — both on the injected clock
@@ -161,6 +168,27 @@ class LeaderElector:
                     self.name, self.identity, e)
         self.is_leader = False
 
+    def _note_renew(self, prev_renew: float, now: float) -> None:
+        """Slow-renew accounting for a SUCCESSFUL renew while already
+        leading: a gap past slow_renew_fraction of the renew deadline
+        means wire latency or failed attempts ate most of the fencing
+        budget — the near-fence condition worth seeing BEFORE a failover.
+        Counted every time, logged once per streak (a fast renew resets
+        the streak); never fences — fencing stays purely deadline-driven."""
+        if now - prev_renew <= self.slow_renew_fraction * self.renew_deadline:
+            self._slow_renew_logged = False
+            return
+        if self.metrics is not None:
+            self.metrics.slow_renews.inc(name=self.name)
+        if not self._slow_renew_logged:
+            self._slow_renew_logged = True
+            import logging
+            logging.getLogger("leaderelection").warning(
+                "%s/%s: lease renew landed %.2fs after the previous one "
+                "(renew deadline %.2fs) — approaching self-fence",
+                self.name, self.identity, now - prev_renew,
+                self.renew_deadline)
+
     def _became_leader(self) -> None:
         self.is_leader = True
         if self.metrics is not None:
@@ -184,6 +212,7 @@ class LeaderElector:
                 if self._stop.is_set():
                     break
                 if self._try_acquire_or_renew():
+                    self._note_renew(last_renew, self.clock.now())
                     last_renew = self.clock.now()
                 elif self.clock.now() - last_renew > self.renew_deadline:
                     break  # fencing: stop leading when renewal fails
@@ -207,6 +236,8 @@ class LeaderElector:
             return
         self._next_attempt = now + self.retry_period
         if self._try_acquire_or_renew():
+            if self.is_leader:
+                self._note_renew(self._last_renew, now)
             self._last_renew = now
             if not self.is_leader:
                 self._became_leader()

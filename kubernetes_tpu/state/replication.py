@@ -85,9 +85,12 @@ class StoreReplica:
 
     def __init__(self, primary_client, store: Optional[Store] = None,
                  resources: Optional[List[str]] = None,
-                 clock: Clock = REAL_CLOCK, seed: int = 0):
+                 clock: Clock = REAL_CLOCK, seed: int = 0, metrics=None):
         self.client = primary_client
         self.store = store if store is not None else ReadOnlyStore()
+        #: RobustnessMetrics (optional): replication_lag_records /
+        #: replication_reconnects_total ride the owner's registry
+        self.metrics = metrics
         self._resources = list(resources) if resources is not None \
             else list(SCHEME.resources())
         #: injected clock: retry sleeps WAIT on it (see _sleep — a
@@ -101,6 +104,16 @@ class StoreReplica:
         self._threads: List[threading.Thread] = []
         #: resource -> highest primary rv applied (lag observability)
         self.applied_rv: Dict[str, int] = {}
+        self._lag_lock = threading.Lock()
+        #: observe_lag() bookkeeping: the latest and worst-ever primary-rv
+        #: minus replica-rv gap, in records — the health contributor and
+        #: /debug/pending read these
+        self.last_lag_records = 0
+        self.max_lag_records = 0
+        #: follower streams re-established after an error (also counted
+        #: per-resource into metrics.replication_reconnects)
+        self.reconnects = 0
+        self.promoted = False
 
     def start(self) -> "StoreReplica":
         for resource in self._resources:
@@ -178,12 +191,46 @@ class StoreReplica:
                 # jitter), then relist — never a blind fixed sleep
                 if self._stop.is_set():
                     return
+                with self._lag_lock:
+                    self.reconnects += 1
+                if self.metrics is not None:
+                    self.metrics.replication_reconnects.inc(
+                        resource=resource)
                 if delays is None:
                     delays = self._retry_delays(resource)
                 self._sleep(next(delays))
 
     def caught_up(self, resource: str, rv: int) -> bool:
         return self.applied_rv.get(resource, 0) >= rv
+
+    def observe_lag(self, primary_rv: int) -> int:
+        """Sample how far the replica store trails the primary, in rv
+        units (records): primary resource_version minus the replica's
+        high-water rv, clamped at zero (the replica's uid clock can run
+        ahead after a torn-WAL primary restart regressed the primary).
+        Sets the replication_lag_records gauge; callers sample it on
+        their own cadence (the chaos harness: once per tick)."""
+        lag = max(0, int(primary_rv) - int(self.store.resource_version))
+        with self._lag_lock:
+            self.last_lag_records = lag
+            if lag > self.max_lag_records:
+                self.max_lag_records = lag
+        if self.metrics is not None:
+            self.metrics.replication_lag.set(lag)
+        return lag
+
+    def pending_report(self) -> dict:
+        """/debug/pending contributor: replication lag and promote
+        attribution beside the scheduler's per-pod reports, so "why is
+        the standby stale" is answerable from the same endpoint as "why
+        is this pod Pending"."""
+        with self._lag_lock:
+            return {"component": "replication",
+                    "promoted": self.promoted,
+                    "lag_records": self.last_lag_records,
+                    "max_lag_records": self.max_lag_records,
+                    "reconnects": self.reconnects,
+                    "applied_rv": dict(self.applied_rv)}
 
     def wait_synced(self, timeout: float = 30.0) -> bool:
         """True once EVERY followed resource completed its initial list —
@@ -208,6 +255,9 @@ class StoreReplica:
         (etcd refuses to promote a learner that is not caught up)."""
         self.stop()
         self.store.read_only = False
+        self.promoted = True
+        if self.metrics is not None:
+            self.metrics.replication_lag.set(0)
         return self.store
 
     def stop(self) -> None:

@@ -155,6 +155,22 @@ def leaderelection_contributor(elector, name: str = "leader-election"
     return {name: alive}
 
 
+def replication_contributor(replica, max_lag_records: int = 1024,
+                            name: str = "replication-lag"
+                            ) -> Dict[str, Callable[[], bool]]:
+    """Replica readiness as a check: unready while the follower trails
+    the primary by more than `max_lag_records` rv units (the last
+    observe_lag() sample) — a standby that far behind would lose
+    acknowledged writes if promoted, so load balancers must stop
+    treating it as a viable failover target. A PROMOTED replica is
+    always ready (it IS the primary now; lag is moot)."""
+    def caught_up() -> bool:
+        if getattr(replica, "promoted", False):
+            return True
+        return replica.last_lag_records <= max_lag_records
+    return {name: caught_up}
+
+
 class HealthzServer:
     def __init__(self, registry: Optional[Registry] = None,
                  host: str = "127.0.0.1", port: int = 0,

@@ -298,6 +298,13 @@ class InformerMetrics:
         self.watch_bookmarks = r.counter(
             "informer_watch_bookmarks_total",
             "Watch BOOKMARK frames that advanced last_sync_rv, by resource")
+        #: repoint() calls — the informer's upstream swapped to a new
+        #: client (replica promotion) and the next watch round resumed at
+        #: last_sync_rv through it; pairs with relists to prove the
+        #: promote drill's no-relist contract
+        self.repoints = r.counter(
+            "informer_repoints_total",
+            "Informer upstreams swapped by repoint(), by resource")
 
 
 class RobustnessMetrics:
@@ -372,6 +379,26 @@ class RobustnessMetrics:
             "bind, by election name",
             buckets=(1.0, 2.0, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0,
                      90.0, 120.0, 180.0))
+        #: successful lease renews that landed past slow_renew_fraction of
+        #: the renew deadline — near-fence conditions visible BEFORE a
+        #: failover (one more slow round-trip and the holder self-fences)
+        self.slow_renews = r.counter(
+            "leaderelection_slow_renews_total",
+            "Successful lease renews that approached the renew deadline, "
+            "by election name")
+        #: how far the follower trails the primary, in rv units (records):
+        #: primary resource_version minus the replica store's high-water rv
+        self.replication_lag = r.gauge(
+            "replication_lag_records",
+            "Records the replica store trails the primary by "
+            "(primary rv - replica rv)")
+        #: replication stream re-established after an error (wire reset,
+        #: dropped watch, primary restart) — each costs one LIST+watch
+        #: round against the primary
+        self.replication_reconnects = r.counter(
+            "replication_reconnects_total",
+            "Replication reflector streams re-established after an "
+            "error, by resource")
         #: containers a virtual kubelet garbage-collected because the
         #: store no longer knows their pod (torn-WAL recovery: the pod's
         #: create was lost with the journal tail)

@@ -688,6 +688,90 @@ class TestWireChaosRuns:
                    for ev in r.events)
 
 
+class TestWireHAChaos:
+    """ACCEPTANCE (ISSUE 17): the full serving topology over the REAL
+    HTTP transport — HA standby pairs electing through the apiserver,
+    a StoreReplica following across the chaos proxy — while the wire
+    itself takes resets, latency, and watch drops."""
+
+    _FAULTS = dict(error_rate=0.05, reset_rate=0.05, latency_rate=0.08,
+                   latency_max=0.003, watch_drop_rate=0.15)
+
+    def _run(self, tmp_path, tag, seed=5, n_events=14, promote_at=None,
+             **kw):
+        h = ChaosHarness(seed=seed, nodes=6, http=True, ha=True,
+                         replica=True, slo=True, with_restarts=True,
+                         wal_path=str(tmp_path / f"{tag}.wal"), **kw)
+        try:
+            return h.run(n_events=n_events, quiesce_steps=10,
+                         promote_at_step=promote_at)
+        finally:
+            h.close()
+
+    def test_http_ha_smoke_identical_logs_zero_double_binds(
+            self, tmp_path):
+        """Tier-1 cut of the HTTP-HA soak: leader kills and lease
+        suppression compose with wire faults over live HTTP; two
+        same-seed runs produce byte-identical event logs, the
+        double-bind sweep stays empty (check_ha_binds feeds r.ok), and
+        the replication STREAM itself provably took wire faults."""
+        r1 = self._run(tmp_path, "a", **self._FAULTS)
+        r2 = self._run(tmp_path, "b", **self._FAULTS)
+        assert r1.ok and r2.ok, (r1.violations, r2.violations)
+        assert r1.events == r2.events
+        assert r1.store_state == r2.store_state
+        assert r1.pods_bound > 0
+        assert r1.failovers, "seed 5 must time at least one failover"
+        # the stream-tagged wire hook: resets/drops attributed to the
+        # replication stream, not just the component clients
+        stream_faults = sum(v for k, v in r1.fault_counts.items()
+                            if k in ("wire_reset_replication",
+                                     "wire_drop_replication"))
+        assert stream_faults > 0, r1.fault_counts
+        # the SLO tracker classified the workload under chaos
+        assert "gang" in r1.slo.get("classes", {}), r1.slo
+
+    def test_http_promote_drill_smoke_deterministic(self, tmp_path):
+        """The promote drill MID-FAULT over HTTP: the standby hub over
+        the promoted replica takes over, every component repoints, and
+        the run stays deterministic — two same-seed drills produce
+        identical event logs and end states."""
+        rs = [self._run(tmp_path, f"p{i}", seed=7, promote_at=8,
+                        **self._FAULTS) for i in range(2)]
+        r1, r2 = rs
+        assert r1.ok and r2.ok, (r1.violations, r2.violations)
+        assert r1.promoted and r2.promoted
+        assert r1.events == r2.events
+        assert r1.store_state == r2.store_state
+        assert any(ev[1] == "promote" for ev in r1.events)
+        assert r1.pods_bound > 0
+
+    @pytest.mark.slow
+    def test_http_ha_replication_soak(self, tmp_path):
+        """The full resilience soak (-m slow): 400 events of workload
+        churn, node kills, wire resets/latency/drops, torn-WAL store
+        restarts, leader kills, lease suppression, and ONE replica
+        promote drill at the midpoint — invariants green, zero
+        double-binds, replication-stream faults observed."""
+        h = ChaosHarness(seed=42, nodes=12, http=True, ha=True,
+                         replica=True, slo=True, with_restarts=True,
+                         with_tears=True,
+                         wal_path=str(tmp_path / "soak.wal"),
+                         **self._FAULTS)
+        try:
+            r = h.run(n_events=400, quiesce_steps=40, promote_at_step=200)
+            assert r.ok, r.violations[:20]
+            assert r.promoted
+            assert r.gangs_created > 15
+            assert r.leader_kills + r.lease_suppressions > 0
+            assert r.failovers, "no failover was ever timed"
+            stream_faults = sum(v for k, v in r.fault_counts.items()
+                                if k.endswith("_replication"))
+            assert stream_faults > 0, r.fault_counts
+        finally:
+            h.close()
+
+
 class TestPodGroupSnapshots:
     """Satellite: resubmission spec snapshots — members lost before the
     rebuild are recreated from the templates recorded at admission."""

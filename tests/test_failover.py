@@ -231,6 +231,49 @@ class TestLeaderElectionStep:
         assert log == ["a+", "a-", "b+"]
         assert metrics.leader_transitions.value(name="cm") == 2
 
+    def test_slow_renew_counted_and_never_fences(self):
+        """Satellite: a successful renew landing past half the renew
+        deadline (wire latency or failed attempts ate the fencing
+        budget) increments leaderelection_slow_renews_total — and ONLY
+        counts; fencing stays purely deadline-driven, so the holder
+        keeps the lease with zero spurious depositions."""
+        from kubernetes_tpu.state import Client
+        from kubernetes_tpu.state.leaderelection import LeaderElector
+        clock = FakeClock()
+        metrics = RobustnessMetrics()
+        el = LeaderElector(Client(), "cm", "a", lease_duration=25.0,
+                           renew_deadline=10.0, retry_period=5.0,
+                           clock=clock, metrics=metrics)
+        el.step()
+        assert el.is_leader
+        for _ in range(3):  # healthy cadence: gap 5s <= 0.5 * 10s
+            clock.step(5.0)
+            el.step()
+        assert metrics.slow_renews.value(name="cm") == 0
+        # one failed attempt eats a retry period; the NEXT successful
+        # renew lands a full deadline after the previous one — slow
+        real = el._leases
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("wire latency")
+            return real()
+        el._leases = flaky
+        clock.step(5.0)
+        el.step()  # the failed renew: within deadline, still leader
+        assert el.is_leader
+        clock.step(5.0)
+        el.step()  # success, 10s after the previous renew: slow
+        assert el.is_leader, "slow renew must never fence"
+        assert metrics.slow_renews.value(name="cm") == 1
+        assert metrics.leader_transitions.value(name="cm") == 1
+        # a healthy renew ends the streak without another count
+        clock.step(5.0)
+        el.step()
+        assert metrics.slow_renews.value(name="cm") == 1
+
     def test_release_failure_logged_and_counted(self):
         from kubernetes_tpu.state import Client
         from kubernetes_tpu.state.leaderelection import LeaderElector
@@ -369,6 +412,58 @@ class TestReplicaPromote:
             assert _checker(h).check() == []
             assert any(ev[1] == "kill_primary" for ev in h.injector.events)
             assert any(ev[1] == "promote" for ev in h.injector.events)
+        finally:
+            h.close()
+
+    def test_promoted_store_torn_restart_rolls_back_whole_gang(
+            self, tmp_path):
+        """Satellite: the bound->Pending regression path where the
+        REGRESSED side is the promoted REPLICA. After the drill the
+        standby's own journal is the durable truth — tear ITS tail back
+        past a gang's group-commit bind and the whole gang must roll
+        back together (never 1-of-N bound at any settled point), then
+        reconverge to the pre-tear semantic state."""
+        from kubernetes_tpu.api.scheduling import pod_group_name
+        h = ChaosHarness(seed=5, nodes=4, error_rate=0.0, replica=True,
+                         wal_path=str(tmp_path / "rp.wal"))
+        try:
+            h.start()
+            h._create_pod("pre", 100)
+            for _ in range(3):
+                h._tick()
+            assert h.promote_replica() == []
+            assert h.wal_path.endswith(".replica")
+            # the gang binds AFTER the promote, so its group-commit BIND
+            # record lands in the REPLICA's journal (everything earlier
+            # arrived over the replication stream as plain applies)
+            h._create_gang(2, 250)
+            for _ in range(4):
+                h._tick()
+            target = h.store_state()
+            assert all(bound for res, _, _, _, bound in target
+                       if res == "pods"), "precondition: everything bound"
+            h.admin.store.flush_wal()
+            records, _ = load_wal(h.wal_path)
+            keep = None
+            for i, rec in enumerate(records):
+                if rec["op"] in ("BIND", "BINDS"):
+                    keep = i
+                    break
+            assert keep is not None, \
+                "the promoted store journaled no bind records"
+            torn = len(records) - keep
+            h.restart_store(torn=torn)
+            # the regression is WHOLE-gang: every member Pending, never
+            # a partial bind surviving the tear
+            gang = [p for p in h.admin.pods().list(namespace=None)
+                    if pod_group_name(p)]
+            assert gang and all(not p.spec.node_name for p in gang)
+            for _ in range(6):
+                h._tick()
+                assert _checker(h).check_gang_atomicity() == [], \
+                    "gang partially bound mid-recovery"
+            assert h.store_state() == target, "store-state parity lost"
+            assert _checker(h).check() == []
         finally:
             h.close()
 
