@@ -1,7 +1,7 @@
 # Convenience entries (the reference's hack/ equivalents).
 
 .PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
-	bench-preempt bench-tenancy bench-resilience
+	bench-preempt bench-tenancy bench-resilience bench-wire
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -49,3 +49,12 @@ bench-resilience:
 # parity (BENCH_r10's source)
 bench-tenancy:
 	JAX_PLATFORMS=cpu python bench.py tenancy
+
+# wire bench: the BENCH_r12 round — one-shot drain JSON vs binary with
+# bind-decision parity, sustained streaming soak (creation overlapping
+# the drain) baseline vs binary + replica read fan-out, the latency-knee
+# curve with wire faults on, and the 1M-pending-pod streamed drain.
+# Publishes BENCH_r12.json.
+bench-wire:
+	JAX_PLATFORMS=cpu python bench.py wire > BENCH_r12.json
+	@tail -c 400 BENCH_r12.json; echo

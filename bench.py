@@ -284,11 +284,13 @@ class _SpawnedAPIServer:
         s.close()
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"  # the hub must never grab the TPU
-        self._proc = subprocess.Popen(
-            [sys.executable, "-m", "kubernetes_tpu.cmd.kube_apiserver",
-             "--port", str(port), "--data-dir", self._tmp],
-            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self._errlog = os.path.join(self._tmp, "stderr.log")
+        with open(self._errlog, "wb") as errf:
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "kubernetes_tpu.cmd.kube_apiserver",
+                 "--port", str(port), "--data-dir", self._tmp],
+                cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+                stdout=subprocess.DEVNULL, stderr=errf)
         self.base = f"http://127.0.0.1:{port}"
         deadline = time.time() + 60
         while True:
@@ -297,8 +299,15 @@ class _SpawnedAPIServer:
                 return self
             except Exception:
                 if time.time() > deadline or self._proc.poll() is not None:
+                    try:
+                        with open(self._errlog, "rb") as f:
+                            tail = f.read()[-2000:].decode(errors="replace")
+                    except OSError:
+                        tail = "<no stderr captured>"
                     self.__exit__(None, None, None)
-                    raise RuntimeError("apiserver process never came up")
+                    raise RuntimeError(
+                        f"apiserver process never came up; stderr tail:\n"
+                        f"{tail}")
                 time.sleep(0.1)
 
     def __exit__(self, *exc):
@@ -322,7 +331,8 @@ def _proc_cpu_s(pid) -> float:
     return (int(parts[13]) + int(parts[14])) / os.sysconf("SC_CLK_TCK")
 
 
-def run_wire_config(n_nodes, n_pods, batch=None):
+def run_wire_config(n_nodes, n_pods, batch=None, wire=None,
+                    collect_assignments=False):
     """The headline config THROUGH THE HUB (ref: scheduler_perf runs
     against a real apiserver, test/integration/scheduler_perf/util.go:
     42-90): a REAL kube-apiserver process (subprocess, WAL durability and
@@ -331,16 +341,22 @@ def run_wire_config(n_nodes, n_pods, batch=None):
     watch into its informers, binds leave as slim BindLists through the
     bulk bindings endpoint (one store transaction per batch, one POST per
     batch, issued from the async binder thread so the hub overlaps the
-    next batch's compute). Returns (pods/s, scheduled, setup_s, elapsed,
-    bottlenecks) — bottlenecks carries both processes' measured CPU during
-    the drain, naming where the remaining wall time goes."""
+    next batch's compute). `wire` pins the client's payload encoding
+    ("json" | "binary"; None = KTPU_WIRE default) — the negotiation is
+    per-stream, so this is the whole-deployment flip. Returns (pods/s,
+    scheduled, setup_s, elapsed, bottlenecks) — bottlenecks carries both
+    processes' measured CPU during the drain plus the client-side wire
+    byte/decode families, naming where the remaining wall time goes.
+    `collect_assignments` adds the final pod->node map (parity legs
+    compare it across encodings) under bottlenecks["_assignments"]."""
     from kubernetes_tpu.apiserver import HTTPClient
+    from kubernetes_tpu.apiserver import httpclient as hc_mod
     from kubernetes_tpu.scheduler import Scheduler
 
     sched = None
     with _SpawnedAPIServer() as hub:
       try:
-        client = HTTPClient(hub.base)
+        client = HTTPClient(hub.base, wire=wire)
         b = batch or WIRE_BATCH
         sched = Scheduler(client, batch_size=b)
         t_setup = time.time()
@@ -380,6 +396,10 @@ def run_wire_config(n_nodes, n_pods, batch=None):
                 [make_pod(2_000_000 + i) for i in range(sz)])
             sched.algorithm.mirror.invalidate_usage()
         _warm_dirty_scatter(sched)
+        # steady-state wire attribution: byte/decode counters restart at
+        # the drain boundary so setup traffic (bulk load, informer fill)
+        # never skews the per-encoding split
+        hc_mod.reset_wire_metrics()
         hub_cpu0 = _proc_cpu_s(hub._proc.pid)
         my_cpu0 = _proc_cpu_s(os.getpid())
         t0 = time.time()
@@ -403,7 +423,13 @@ def run_wire_config(n_nodes, n_pods, batch=None):
                               " records + slim bind watch frames",
             "sched_cost_split": "slim frame apply (clone+fields) +"
                                 " tensorize + assume/commit loop",
+            "wire": _wire_client_stats(),
+            "encoding": client.wire,
         }
+        if collect_assignments:
+            bottlenecks["_assignments"] = {
+                p.metadata.name: p.spec.node_name
+                for p in client.pods("default").list() if p.spec.node_name}
         return rate, scheduled, setup_s, elapsed, bottlenecks
       finally:
         if sched is not None:
@@ -411,6 +437,521 @@ def run_wire_config(n_nodes, n_pods, batch=None):
                 sched.informers.stop()
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------
+# streaming wire round (BENCH_r12): binary frames + replica read fan-out
+# + the 1M-pending drain. Creation STREAMS into the drain from its own
+# process (r07's 500k lesson: setup, not scan, is the bound) and reads
+# can fan out to a follower kube-replica process while writes/binds stay
+# on the primary.
+# ---------------------------------------------------------------------
+
+#: sustained/knee/1M topology: wide nodes (64 cpu, 1200-pod density) so
+#: ≥1000 nodes hold a 1M-pod fleet; per-leg shapes env-tunable
+WIRE_S_NODES = int(os.environ.get("BENCH_WIRE_S_NODES", "1000"))
+WIRE_S_PODS = int(os.environ.get("BENCH_WIRE_S_PODS", "60000"))
+#: kubelet-ish full-object watch consumers (own process) loading the
+#: read fan-out path during the sustained legs
+WIRE_WATCHERS = int(os.environ.get("BENCH_WIRE_WATCHERS", "4"))
+WIRE_KNEE_RATES = [int(r) for r in os.environ.get(
+    "BENCH_WIRE_KNEE_RATES", "1000,2000,4000,6000").split(",") if r]
+WIRE_KNEE_DURATION_S = float(os.environ.get("BENCH_WIRE_KNEE_S", "12"))
+WIRE_M_NODES = int(os.environ.get("BENCH_WIRE_M_NODES", "1000"))
+WIRE_M_PODS = int(os.environ.get("BENCH_WIRE_M_PODS", "1000000"))
+WIRE_M_DEADLINE_S = float(os.environ.get("BENCH_WIRE_M_DEADLINE_S",
+                                         "3600"))
+
+
+def make_wide_node(i):
+    """High-density node (64 cpu / 256Gi / 1200 pods): 1000 of these hold
+    the 1M-pod fleet, the TPU-pod-slice density shape rather than the
+    reference's 110-pod kubelet default."""
+    alloc = {"cpu": Quantity("64"), "memory": Quantity("256Gi"),
+             "pods": Quantity(1200)}
+    return api.Node(
+        metadata=api.ObjectMeta(
+            name=f"node-{i}",
+            labels={api.wellknown.LABEL_HOSTNAME: f"node-{i}",
+                    api.wellknown.LABEL_ZONE: f"zone-{i % 16}"}),
+        status=api.NodeStatus(capacity=dict(alloc), allocatable=dict(alloc),
+                              conditions=[api.NodeCondition(type="Ready",
+                                                            status="True")]))
+
+
+def make_small_pod(i):
+    """Minimal schedulable pod (10m/16Mi): 1M of them fit the wide-node
+    fleet's cpu (10k of 64k) and pod (1M of 1.2M) budgets."""
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"pod-{i}", namespace="default",
+                                labels={"app": "bench"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="pause",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("10m"),
+                          "memory": Quantity("16Mi")}))]))
+
+
+def _wire_client_stats():
+    """Client-side wire families (httpclient's standalone counters) as a
+    JSON-ready dict: bytes sent/received and decode latency per
+    encoding — the r04 bottleneck attribution, re-measured per encoding."""
+    from kubernetes_tpu.apiserver import httpclient as hc
+    out = {}
+    for enc in ("json", "binary"):
+        sent = hc.WIRE_BYTES_SENT.value(encoding=enc)
+        recv = hc.WIRE_BYTES_RECEIVED.value(encoding=enc)
+        n = hc.WIRE_DECODE_SECONDS.count(encoding=enc)
+        if not (sent or recv or n):
+            continue
+        entry = {"bytes_sent": int(sent), "bytes_received": int(recv),
+                 "decode_calls": n}
+        if n:
+            entry["decode_total_s"] = round(
+                hc.WIRE_DECODE_SECONDS.sum(encoding=enc), 4)
+            p99 = hc.WIRE_DECODE_SECONDS.quantile(0.99, encoding=enc)
+            entry["decode_p99_us"] = (round(p99 * 1e6, 1)
+                                      if p99 != float("inf") else None)
+        out[enc] = entry
+    return out
+
+
+def _scrape_wire_metrics(base):
+    """Scrape the hub's /metrics for the server-side wire families
+    (bytes per encoding, encode time, watch frame-cache hits). Histogram
+    bucket rows are dropped — sums/counts carry the attribution."""
+    import urllib.request
+    try:
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+    except Exception as e:
+        return {"error": str(e)}
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "_bucket{" in line:
+            continue
+        if line.startswith(("apiserver_wire_",
+                            "apiserver_watch_frame_cache_hits")):
+            key, _, val = line.rpartition(" ")
+            try:
+                out[key] = round(float(val), 4)
+            except ValueError:
+                continue
+    return out
+
+
+class _SpawnedReplica:
+    """A kube-replica follower process: syncs off the primary, then
+    serves LIST/watch (reads only) on its own port. /healthz answers
+    only after the initial sync barrier, so the handshake doubles as
+    wait_synced."""
+
+    def __init__(self, primary_base, wire="json"):
+        self._primary = primary_base
+        self._wire = wire
+        self._proc = None
+        self.base = None
+
+    def start(self):
+        import socket
+        import subprocess
+        import urllib.request
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KTPU_WIRE"] = self._wire  # replication stream's encoding
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.cmd.kube_replica",
+             "--primary", self._primary, "--port", str(port)],
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 120
+        while True:
+            try:
+                urllib.request.urlopen(f"{self.base}/healthz", timeout=1)
+                return self
+            except Exception:
+                if time.time() > deadline or self._proc.poll() is not None:
+                    self.stop()
+                    raise RuntimeError("kube-replica never came up")
+                time.sleep(0.1)
+
+    @property
+    def pid(self):
+        return self._proc.pid
+
+    def stop(self):
+        import subprocess
+        if self._proc is None:
+            return
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait()
+        self._proc = None
+
+
+def _spawn_bench_sub(*args, wire=None):
+    """Run `bench.py <subcommand> ...` as a child process (creator /
+    watcher fleets live off the scheduler's GIL)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if wire is not None:
+        env["KTPU_WIRE"] = wire
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), *args], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wire_creator_main(argv):
+    """`bench.py _wire_creator <base> <kind> <n> <rate> <chunk>` — stream
+    pod creation into a running drain through the bulk-create endpoint.
+    rate 0 creates flat-out; rate > 0 paces an open-loop arrival process
+    (the knee curve's offered load)."""
+    base, kind = argv[0], argv[1]
+    n, rate, chunk = int(argv[2]), float(argv[3]), int(argv[4])
+    from kubernetes_tpu.apiserver import HTTPClient
+    maker = make_small_pod if kind == "small" else make_pod
+    pods_rc = HTTPClient(base).pods("default")
+    t0 = time.monotonic()
+    sent = 0
+    while sent < n:
+        take = min(chunk, n - sent)
+        if rate > 0:
+            target = t0 + sent / rate
+            now = time.monotonic()
+            if now < target:
+                time.sleep(target - now)
+        rs = pods_rc.create_bulk([maker(sent + j) for j in range(take)])
+        bad = next((r for r in rs if isinstance(r, Exception)), None)
+        if bad is not None:
+            raise bad
+        sent += take
+    print(sent, flush=True)
+
+
+def _wire_watchers_main(argv):
+    """`bench.py _wire_watchers <base> <count>` — a kubelet-ish watcher
+    fleet: each consumer LISTs once, then holds a full-object pod watch
+    open and discards events, loading the server's per-watcher fan-out
+    (frame cache + coalesced chunks) without storing anything. Runs
+    until the parent terminates it."""
+    base, count = argv[0], int(argv[1])
+    import queue as queue_mod
+    import threading
+    from kubernetes_tpu.apiserver import HTTPClient
+
+    def run_one():
+        rc = HTTPClient(base).pods("default")
+        while True:
+            try:
+                _, rv = rc.list_rv()
+                stream = rc.watch(resource_version=rv)
+                while True:
+                    try:
+                        ev = stream.events.get(timeout=5.0)
+                    except queue_mod.Empty:
+                        if stream.error is not None:
+                            break
+                        continue
+                    if ev is None:
+                        break
+                    rv = ev.resource_version or rv
+            except Exception:
+                time.sleep(0.5)  # server restarting; re-list when back
+    for _ in range(count):
+        threading.Thread(target=run_one, daemon=True).start()
+    while True:
+        time.sleep(60)
+
+
+def run_wire_stream(n_nodes, n_pods, wire="json", replica_reads=False,
+                    batch=None, rate=0.0, watchers=0, faults=True,
+                    deadline_s=900.0, seed=18):
+    """One streaming wire leg: a real hub process, pod creation streamed
+    in from a creator process (paced when rate > 0), the scheduler
+    draining CONCURRENTLY with arrival — plus, per flags, a kube-replica
+    follower serving the informers' LIST/watch (writes/binds stay on the
+    primary), a watcher fleet process loading the read fan-out, and
+    deterministic wire faults (latency/resets/watch drops) on the
+    scheduler's transport. Returns the leg's throughput, per-process CPU
+    split, create→bind latency percentiles (object timestamps, hub
+    clock), and both sides' wire byte/codec families."""
+    import gc
+    from kubernetes_tpu.api.core import Pod as _Pod
+    from kubernetes_tpu.apiserver import HTTPClient
+    from kubernetes_tpu.apiserver import httpclient as hc_mod
+    from kubernetes_tpu.chaos.injector import FaultInjector
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.serving.slo import SLOTracker
+    from kubernetes_tpu.state.informer import SharedInformerFactory
+
+    b = batch or WIRE_BATCH
+    sched = None
+    replica = None
+    children = []
+    with _SpawnedAPIServer() as hub:
+      try:
+        injector = None
+        hook = None
+        if faults:
+            injector = FaultInjector(seed=seed, error_rate=0.002,
+                                     reset_rate=0.001, latency_rate=0.01,
+                                     latency_max=0.005,
+                                     watch_drop_rate=0.02)
+            hook = injector.make_wire_hook()
+        # fleet first, over a clean setup client: every read surface
+        # (primary or follower) must know the nodes before informers sync
+        setup_rc = HTTPClient(hub.base).nodes()
+        CHUNK = 2000
+        for lo in range(0, n_nodes, CHUNK):
+            rs = setup_rc.create_bulk(
+                [make_wide_node(i)
+                 for i in range(lo, min(lo + CHUNK, n_nodes))])
+            bad = next((r for r in rs if isinstance(r, Exception)), None)
+            if bad is not None:
+                raise bad
+        read_client = None
+        if replica_reads:
+            replica = _SpawnedReplica(hub.base, wire=wire).start()
+            read_client = HTTPClient(replica.base, wire=wire,
+                                     wire_hook=hook)
+        client = HTTPClient(hub.base, wire=wire, wire_hook=hook)
+        factory = SharedInformerFactory(client, read_client=read_client)
+        sched = Scheduler(client, informer_factory=factory, batch_size=b)
+        slo = SLOTracker(use_object_timestamps=True)
+        sched.informers.informer_for(_Pod).add_event_handlers(
+            slo.handlers())
+        t_setup = time.time()
+        sched.informers.start()
+        sched.informers.wait_for_cache_sync()
+        deadline = time.time() + 120
+        while len(sched.cache.node_names()) < n_nodes:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"node informer fill stalled at "
+                    f"{len(sched.cache.node_names())}/{n_nodes}")
+            time.sleep(0.05)
+        # warm every pow2 batch bucket a STREAMING drain can pop —
+        # arrival-paced pops are variable-size, unlike the preloaded
+        # drain's full-batch + remainder pair
+        sched.algorithm.refresh()
+        sz = b
+        while sz >= 128:
+            sched.algorithm.schedule(
+                [make_small_pod(5_000_000 + i) for i in range(sz)])
+            sched.algorithm.mirror.invalidate_usage()
+            sz //= 2
+        _warm_dirty_scatter(sched)
+        watch_base = replica.base if replica is not None else hub.base
+        if watchers:
+            children.append(_spawn_bench_sub(
+                "_wire_watchers", watch_base, str(watchers), wire=wire))
+        gc.collect()
+        hc_mod.reset_wire_metrics()
+        pids = {"hub": hub._proc.pid, "sched": os.getpid()}
+        if replica is not None:
+            pids["replica"] = replica.pid
+        cpu0 = {k: _proc_cpu_s(pid) for k, pid in pids.items()}
+        creator = _spawn_bench_sub(
+            "_wire_creator", hub.base, "small", str(n_pods), str(rate),
+            "2000", wire=wire)
+        children.append(creator)
+        pids["creator"] = creator.pid
+        cpu0["creator"] = 0.0
+        cpu_last = dict(cpu0)
+        setup_s = time.time() - t_setup
+        t0 = time.time()
+        bound = 0
+        last_sample = t0
+        with _gc_paused():
+            while bound < n_pods and time.time() - t0 < deadline_s:
+                got = sched.drain_pipelined()
+                bound += got
+                if bound >= n_pods:
+                    break
+                if creator.poll() is not None and creator.returncode:
+                    raise RuntimeError(
+                        f"creator exited rc={creator.returncode}")
+                now = time.time()
+                if now - last_sample > 2.0:
+                    # children may exit before the drain settles; keep
+                    # the last live CPU sample for attribution
+                    last_sample = now
+                    for k, pid in pids.items():
+                        try:
+                            cpu_last[k] = _proc_cpu_s(pid)
+                        except OSError:
+                            pass
+                if not got:
+                    time.sleep(0.02)
+        elapsed = time.time() - t0
+        for k, pid in pids.items():
+            try:
+                cpu_last[k] = _proc_cpu_s(pid)
+            except OSError:
+                pass
+        # settle: the drain exits at bind commit; let the watch stream
+        # deliver the tail of bound MODIFIED events so the latency
+        # sample covers the whole run, not all-but-the-last-batch
+        settle_deadline = time.time() + 15
+        while time.time() < settle_deadline:
+            with slo._lock:
+                observed = len(slo._bound)
+            if observed >= bound:
+                break
+            time.sleep(0.1)
+        rpt = slo.report()
+        other = rpt["classes"].get("other", {}).get("bind", {})
+        leg = {
+            "nodes": n_nodes, "pods": n_pods, "bound": bound,
+            "complete": bound >= n_pods,
+            "wire": wire, "replica_reads": replica_reads,
+            "watchers": watchers, "faults_on": bool(faults),
+            "offered_rate_per_s": rate or None,
+            "pods_per_sec": round(bound / elapsed, 1) if elapsed else 0.0,
+            "elapsed_s": round(elapsed, 2),
+            "setup_s": round(setup_s, 2),
+            "batch": b,
+            "bind_latency": {
+                "p50_s": other.get("p50_s"), "p99_s": other.get("p99_s"),
+                "max_s": other.get("max_s"), "count": other.get("count"),
+            },
+            "cpu_s": {k: round(cpu_last[k] - cpu0[k], 2) for k in pids},
+            "cpu_us_per_pod": {
+                k: round((cpu_last[k] - cpu0[k]) / max(1, bound) * 1e6, 1)
+                for k in pids},
+            "client_wire": _wire_client_stats(),
+            "hub_wire": _scrape_wire_metrics(hub.base),
+        }
+        if replica is not None:
+            leg["replica_wire"] = _scrape_wire_metrics(replica.base)
+        if injector is not None:
+            leg["fault_counts"] = dict(sorted(
+                injector.fault_counts.items()))
+        return leg
+      finally:
+        import subprocess
+        for ch in children:
+            ch.terminate()
+        if sched is not None:
+            try:
+                sched.informers.stop()
+            except Exception:
+                pass
+        if replica is not None:
+            replica.stop()
+        for ch in children:
+            try:
+                ch.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                ch.kill()
+                ch.wait()
+
+
+def wire_main():
+    """`bench.py wire` — the BENCH_r12 round. Four sections:
+
+    1. one-shot 20k drain, JSON vs binary, with bind-decision parity
+       (identical pod->node maps across encodings)
+    2. sustained streaming soak (creation overlapping the drain) —
+       JSON/direct baseline vs the full wire config (binary frames +
+       replica read fan-out + watcher fleet), same harness
+    3. latency-knee-vs-arrival-rate curve at WIRE_S_NODES wide nodes,
+       wire faults on, binary + replica reads
+    4. the 1M-pending-pod drain, streamed creation, faults on
+
+    Single JSON document on stdout (the BENCH_rNN.json shape)."""
+    import gc
+    single_core = (os.cpu_count() or 1) == 1
+    # -- 1: encoding comparison + decision parity on the r05 shape
+    oneshot = {}
+    assignments = {}
+    for enc in ("json", "binary"):
+        r, n_sched, setup_s, elapsed, bn = run_wire_config(
+            WIRE_NODES, WIRE_PODS, wire=enc, collect_assignments=True)
+        assignments[enc] = bn.pop("_assignments")
+        oneshot[enc] = {
+            "pods_per_sec": round(r, 1), "scheduled": n_sched,
+            "setup_s": round(setup_s, 2), "elapsed_s": round(elapsed, 2),
+            "bottlenecks": bn,
+        }
+        gc.collect()
+    keys = set(assignments["json"]) | set(assignments["binary"])
+    same = sum(1 for k in keys
+               if assignments["json"].get(k) == assignments["binary"].get(k))
+    parity = round(same / len(keys), 4) if keys else None
+    oneshot["decision_parity"] = parity
+    oneshot["ratio_binary_vs_json"] = round(
+        oneshot["binary"]["pods_per_sec"]
+        / max(1e-9, oneshot["json"]["pods_per_sec"]), 2)
+    del assignments
+    gc.collect()
+    # -- 2: sustained soak, baseline vs wire config (same harness)
+    sustained = {
+        "json_direct": run_wire_stream(
+            WIRE_S_NODES, WIRE_S_PODS, wire="json", replica_reads=False,
+            watchers=WIRE_WATCHERS, faults=False),
+    }
+    gc.collect()
+    sustained["binary_replica"] = run_wire_stream(
+        WIRE_S_NODES, WIRE_S_PODS, wire="binary", replica_reads=True,
+        watchers=WIRE_WATCHERS, faults=False)
+    gc.collect()
+    sustained["ratio_wire_config_vs_json"] = round(
+        sustained["binary_replica"]["pods_per_sec"]
+        / max(1e-9, sustained["json_direct"]["pods_per_sec"]), 2)
+    # -- 3: latency knee vs offered arrival rate, faults on
+    knee = []
+    for kr in WIRE_KNEE_RATES:
+        leg = run_wire_stream(
+            WIRE_S_NODES, int(kr * WIRE_KNEE_DURATION_S), wire="binary",
+            replica_reads=True, rate=float(kr), watchers=0, faults=True,
+            deadline_s=WIRE_KNEE_DURATION_S * 10 + 120, seed=18 + kr)
+        knee.append({
+            "offered_per_s": kr,
+            "achieved_per_s": leg["pods_per_sec"],
+            "bind_p50_s": leg["bind_latency"]["p50_s"],
+            "bind_p99_s": leg["bind_latency"]["p99_s"],
+            "bound": leg["bound"], "complete": leg["complete"],
+            "fault_counts": leg.get("fault_counts"),
+        })
+        gc.collect()
+    # -- 4: the 1M round (streamed creation, faults on). Replica reads
+    # default OFF here: on a single-core host the follower doubles every
+    # store apply without adding CPU capacity — flip with
+    # BENCH_WIRE_M_REPLICA=1 on multi-core hosts.
+    m_replica = os.environ.get("BENCH_WIRE_M_REPLICA", "0") == "1"
+    million = run_wire_stream(
+        WIRE_M_NODES, WIRE_M_PODS, wire="binary",
+        replica_reads=m_replica, watchers=0, faults=True,
+        deadline_s=WIRE_M_DEADLINE_S)
+    print(json.dumps({
+        "metric": "wire round: binary frames + replica read fan-out + "
+                  f"1M-pod streamed drain ({WIRE_M_PODS} pods x "
+                  f"{WIRE_M_NODES} nodes)",
+        "value": million["pods_per_sec"],
+        "unit": "pods/s",
+        "detail": {
+            "single_core_host": single_core,
+            "host_note": "one schedulable CPU: every process timeshares "
+                         "a single core, so cross-process offload "
+                         "(replica reads, creator overlap) cannot add "
+                         "capacity here — per-encoding CPU and byte "
+                         "splits carry the multi-core attribution",
+            "oneshot_drain": oneshot,
+            "sustained": sustained,
+            "latency_knee": knee,
+            "million": million,
+        },
+    }))
 
 
 DENSITY_NODES = int(os.environ.get("BENCH_DENSITY_NODES", "100"))
@@ -734,6 +1275,11 @@ def run_serving_config(n_nodes, rate, duration_s):
             tracker.handlers())
         sched.start()
         serving_metrics.arrival_rate.set(rate)
+        # steady-state wire attribution: zero the byte/decode families at
+        # the warmup boundary (the affinity section's phase-stats
+        # convention) so setup traffic never skews the serving rates
+        from kubernetes_tpu.apiserver import httpclient as hc_mod
+        hc_mod.reset_wire_metrics()
 
         gen = LoadGen(client, seed=int(rate), rate=rate)
         n_events = max(1, int(rate * duration_s))
@@ -2082,6 +2628,12 @@ if __name__ == "__main__":
         tenancy_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "resilience":
         resilience_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "wire":
+        wire_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "_wire_creator":
+        _wire_creator_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "_wire_watchers":
+        _wire_watchers_main(sys.argv[2:])
     elif "--trace" in sys.argv[1:]:
         trace_main()
     else:

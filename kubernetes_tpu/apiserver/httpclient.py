@@ -11,23 +11,26 @@ changes. That substitutability is the tested process boundary.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from queue import Queue
+from time import perf_counter
 from typing import Any, Callable, List, Optional, Type
 from urllib import error as urlerror
 from urllib import request as urlrequest
 from urllib.parse import urlsplit
 
+from ..api import binenc
 from ..api import core as corev1
 from ..api import labels as labelsmod
 from ..api import serde
 from ..api.meta import LabelSelector
 from ..runtime.scheme import SCHEME, Scheme
-from ..state.store import (BOOKMARK, AlreadyExistsError, ConflictError,
-                           ExpiredError, NotFoundError, SlimBindRef,
-                           WatchEvent)
-from ..utils.metrics import Counter
+from ..state.store import (BOOKMARK, MODIFIED, AlreadyExistsError,
+                           ConflictError, ExpiredError, NotFoundError,
+                           SlimBindRef, WatchEvent)
+from ..utils.metrics import WIRE_CODEC_BUCKETS, Counter, Histogram
 
 #: terminal watch-stream errors by (resource, reason) — the TRANSPORT
 #: layer's family, counted in the pump for every consumer including raw
@@ -39,6 +42,30 @@ from ..utils.metrics import Counter
 WATCH_STREAM_ERRORS = Counter(
     "httpwatch_stream_errors_total",
     "HTTP watch streams terminated by an error, by resource and reason")
+
+#: client half of the wire-volume split (the hub's apiserver_wire_*
+#: families are the server half): request/watch bytes and payload decode
+#: time by negotiated encoding, so the r04 "watch decode is
+#: scheduler-side" attribution can be re-measured per encoding.
+#: Standalone like WATCH_STREAM_ERRORS — process-wide across every
+#: HTTPClient, which is what a per-process bench wants to sample.
+WIRE_BYTES_SENT = Counter(
+    "httpclient_wire_bytes_sent_total",
+    "Request body bytes written, by encoding")
+WIRE_BYTES_RECEIVED = Counter(
+    "httpclient_wire_bytes_received_total",
+    "Response + watch-frame bytes read, by encoding")
+WIRE_DECODE_SECONDS = Histogram(
+    "httpclient_wire_decode_seconds",
+    "Payload decode latency, by encoding", WIRE_CODEC_BUCKETS)
+
+
+def reset_wire_metrics() -> None:
+    """Zero the client-side wire families (bench phase boundaries:
+    steady-state rates must not be skewed by warmup/setup traffic)."""
+    WIRE_BYTES_SENT.clear()
+    WIRE_BYTES_RECEIVED.clear()
+    WIRE_DECODE_SECONDS.clear()
 
 
 class WatchStaleError(ConnectionError):
@@ -107,13 +134,17 @@ class _HTTPWatch:
     """
 
     def __init__(self, resp, cls: Type, resource: str = "",
-                 drop_after: Optional[int] = None):
+                 drop_after: Optional[int] = None, binary: bool = False):
         self._resp = resp
         self._cls = cls
         self._resource = resource
         self._stopped = False
         #: injected wire fault: sever the stream after this many events
         self._drop_after = drop_after
+        #: the server ECHOED the binary opt-in (Content-Type sniff): the
+        #: pump reads length-prefixed binenc frames instead of JSON lines
+        self._binary = binary
+        self._delivered = 0
         self.killed = False
         self.error: Optional[BaseException] = None
         self.last_rv: Optional[int] = None
@@ -123,60 +154,11 @@ class _HTTPWatch:
         self._thread.start()
 
     def _pump(self) -> None:
-        delivered = 0
         try:
-            # the server heartbeats an empty line every second, so this
-            # blocking read always turns over and a stop() is noticed
-            # promptly; the response is closed HERE (closing from another
-            # thread deadlocks http.client's buffered reader)
-            for line in self._resp:
-                self.last_activity = time.monotonic()
-                if self._stopped:
-                    break
-                line = line.strip()
-                if not line:
-                    continue
-                frame = json.loads(line)
-                if frame.get("type") == "BOOKMARK":
-                    # negotiated heartbeat carrying the server's current
-                    # rv: advances the consumer's resume point through
-                    # quiet periods. NOT an object event — it bypasses
-                    # the injected drop budget (wire-chaos watch plans
-                    # are keyed to real event counts, and a wall-clock-
-                    # timed heartbeat must not perturb them).
-                    rv = int(frame.get("rv") or 0)
-                    if rv:
-                        self.last_rv = rv
-                        self.events.put(WatchEvent(BOOKMARK, None, rv))
-                    continue
-                if self._drop_after is not None \
-                        and delivered >= self._drop_after:
-                    raise ConnectionResetError(
-                        "injected watch drop "
-                        f"(after {delivered} events)")
-                slim = frame.get("slim")
-                if slim == "bind" or slim == "binds":
-                    # negotiated compact bind frame(s): the informer
-                    # materializes each pod from its cached prior
-                    # revision. "binds" is the server's coalesced form —
-                    # one frame (one dumps/loads) for a whole bind batch,
-                    # split back into per-pod events here
-                    items = [frame["o"]] if slim == "bind" \
-                        else frame["o"]["items"]
-                    for o in items:
-                        rv = int(o["rv"])
-                        self.last_rv = rv
-                        self.events.put(WatchEvent(
-                            frame["type"],
-                            SlimBindRef(o.get("namespace", ""), o["name"],
-                                        o["node"], o.get("ts"), rv), rv))
-                        delivered += 1
-                    continue
-                obj = serde.decode(self._cls, frame["object"])
-                rv = int(obj.metadata.resource_version or 0)
-                self.last_rv = rv
-                self.events.put(WatchEvent(frame["type"], obj, rv))
-                delivered += 1
+            if self._binary:
+                self._pump_binary()
+            else:
+                self._pump_json()
         except Exception as e:
             # a stop() tears the socket down under the read — that is a
             # clean close, not a stream failure; everything else is
@@ -193,6 +175,143 @@ class _HTTPWatch:
             except Exception:
                 pass
             self.events.put(None)
+
+    def _pump_json(self) -> None:
+        # the server heartbeats an empty line every second, so this
+        # blocking read always turns over and a stop() is noticed
+        # promptly; the response is closed by _pump's finally (closing
+        # from another thread deadlocks http.client's buffered reader)
+        for line in self._resp:
+            self.last_activity = time.monotonic()
+            if self._stopped:
+                break
+            WIRE_BYTES_RECEIVED.inc(len(line), encoding="json")
+            line = line.strip()
+            if not line:
+                continue
+            t0 = perf_counter()
+            frame = json.loads(line)
+            WIRE_DECODE_SECONDS.observe(perf_counter() - t0,
+                                        encoding="json")
+            if frame.get("type") == "BOOKMARK":
+                # negotiated heartbeat carrying the server's current
+                # rv: advances the consumer's resume point through
+                # quiet periods. NOT an object event — it bypasses
+                # the injected drop budget (wire-chaos watch plans
+                # are keyed to real event counts, and a wall-clock-
+                # timed heartbeat must not perturb them).
+                rv = int(frame.get("rv") or 0)
+                if rv:
+                    self.last_rv = rv
+                    self.events.put(WatchEvent(BOOKMARK, None, rv))
+                continue
+            if self._drop_after is not None \
+                    and self._delivered >= self._drop_after:
+                raise ConnectionResetError(
+                    "injected watch drop "
+                    f"(after {self._delivered} events)")
+            slim = frame.get("slim")
+            if slim == "bind" or slim == "binds":
+                # negotiated compact bind frame(s): the informer
+                # materializes each pod from its cached prior
+                # revision. "binds" is the server's coalesced form —
+                # one frame (one dumps/loads) for a whole bind batch,
+                # split back into per-pod events here
+                items = [frame["o"]] if slim == "bind" \
+                    else frame["o"]["items"]
+                for o in items:
+                    rv = int(o["rv"])
+                    self.last_rv = rv
+                    self.events.put(WatchEvent(
+                        frame["type"],
+                        SlimBindRef(o.get("namespace", ""), o["name"],
+                                    o["node"], o.get("ts"), rv), rv))
+                    self._delivered += 1
+                continue
+            obj = serde.decode(self._cls, frame["object"])
+            rv = int(obj.metadata.resource_version or 0)
+            self.last_rv = rv
+            self.events.put(WatchEvent(frame["type"], obj, rv))
+            self._delivered += 1
+
+    def _read_exact(self, n: int) -> bytes:
+        """Read exactly n bytes off the (transparently de-chunked)
+        response, or b"" on a clean EOF at a frame boundary. A short
+        read mid-frame is a torn stream and raises."""
+        buf = self._resp.read(n)
+        if not buf or len(buf) == n:
+            return buf
+        chunks = [buf]
+        got = len(buf)
+        while got < n:
+            chunk = self._resp.read(n - got)
+            if not chunk:
+                raise ConnectionError(
+                    f"binary watch: stream ended {n - got} bytes into "
+                    f"a frame")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def _pump_binary(self) -> None:
+        """Binary frame pump: 6-byte header, exact-length body. Same
+        consumer contract as the JSON pump — BOOKMARK bypasses the
+        injected drop budget, FT_BINDS splits into per-pod SlimBindRef
+        events, FT_EVENT decodes the full object."""
+        while True:
+            hdr = self._read_exact(binenc.HEADER_SIZE)
+            if not hdr:
+                break  # server ended the stream cleanly
+            self.last_activity = time.monotonic()
+            if self._stopped:
+                break
+            ftype, blen = binenc.parse_header(hdr)
+            body = self._read_exact(blen) if blen else b""
+            WIRE_BYTES_RECEIVED.inc(binenc.HEADER_SIZE + blen,
+                                    encoding="binary")
+            if ftype == binenc.FT_HEARTBEAT:
+                continue
+            if ftype == binenc.FT_BOOKMARK:
+                rv = int.from_bytes(body, "big")
+                if rv:
+                    self.last_rv = rv
+                    self.events.put(WatchEvent(BOOKMARK, None, rv))
+                continue
+            if self._drop_after is not None \
+                    and self._delivered >= self._drop_after:
+                raise ConnectionResetError(
+                    "injected watch drop "
+                    f"(after {self._delivered} events)")
+            if ftype == binenc.FT_BINDS:
+                t0 = perf_counter()
+                items = binenc.unpack(body)
+                WIRE_DECODE_SECONDS.observe(perf_counter() - t0,
+                                            encoding="binary")
+                for o in items:
+                    rv = int(o["rv"])
+                    self.last_rv = rv
+                    self.events.put(WatchEvent(
+                        MODIFIED,
+                        SlimBindRef(o.get("namespace", ""), o["name"],
+                                    o["node"], o.get("ts"), rv), rv))
+                    self._delivered += 1
+                continue
+            if ftype != binenc.FT_EVENT:
+                raise binenc.BinencError(
+                    f"binary watch: unknown frame type {ftype}")
+            t0 = perf_counter()
+            ev_type = binenc.EVENT_NAMES[body[0]]
+            data, off = binenc.unpack_from(body, 1)
+            if off != len(body):
+                raise binenc.BinencError(
+                    "binary watch: trailing bytes in event frame")
+            obj = serde.decode(self._cls, data)
+            WIRE_DECODE_SECONDS.observe(perf_counter() - t0,
+                                        encoding="binary")
+            rv = int(obj.metadata.resource_version or 0)
+            self.last_rv = rv
+            self.events.put(WatchEvent(ev_type, obj, rv))
+            self._delivered += 1
 
     def stop(self) -> None:
         self._stopped = True
@@ -232,12 +351,26 @@ class HTTPResourceClient:
     def __init__(self, base_url: str, scheme: Scheme, cls: Type,
                  namespace: Optional[str] = None,
                  token: Optional[str] = None, ssl_context=None,
-                 wire_hook: Optional[Callable] = None):
+                 wire_hook: Optional[Callable] = None,
+                 wire: str = "json",
+                 wire_state: Optional[dict] = None):
         self._ssl = ssl_context
         #: transport interceptor (see WIRE_REQUEST/WIRE_WATCH above):
         #: chaos runs inject latency, connection resets, and watch drops
         #: into the REAL http path here, not into a client wrapper
         self._wire_hook = wire_hook
+        #: negotiated payload encoding preference ("json" | "binary"):
+        #: binary ASKS via query opt-in and falls back silently when the
+        #: peer answers JSON — old hubs keep working
+        self._wire_binary = wire == "binary"
+        #: capability state SHARED across this HTTPClient's per-resource
+        #: clients (they are constructed per accessor call): flips to
+        #: confirmed on the first binary-typed response, after which
+        #: request BODIES (BindList) may be packed too — a binary body
+        #: to an unconfirmed peer could land on an old hub that only
+        #: reads JSON
+        self._wire_state = wire_state if wire_state is not None \
+            else {"confirmed": False}
         self._base = base_url.rstrip("/")
         self._scheme = scheme
         self._cls = cls
@@ -275,13 +408,23 @@ class HTTPResourceClient:
     def _request(self, method: str, url: str, body: Any = None,
                  content_type: Optional[str] = None):
         if content_type is not None:
-            data = json.dumps(body).encode() if body is not None else None
+            if content_type.startswith(binenc.CONTENT_TYPE):
+                data = binenc.pack(body) if body is not None else None
+            else:
+                data = json.dumps(body).encode() \
+                    if body is not None else None
         else:
             data = serde.to_json_str(body).encode() \
                 if body is not None else None
         headers = self._headers()
         if content_type is not None:
             headers["Content-Type"] = content_type
+        if data is not None:
+            WIRE_BYTES_SENT.inc(
+                len(data),
+                encoding="binary" if content_type is not None
+                and content_type.startswith(binenc.CONTENT_TYPE)
+                else "json")
         req = urlrequest.Request(url, data=data, method=method,
                                  headers=headers)
         if self._wire_hook is not None:
@@ -292,7 +435,24 @@ class HTTPResourceClient:
                             urlsplit(url).path)
         try:
             with urlrequest.urlopen(req, context=self._ssl) as resp:
-                return json.loads(resp.read())
+                raw = resp.read()
+                if resp.headers.get("Content-Type", "").startswith(
+                        binenc.CONTENT_TYPE):
+                    # the peer echoed the binary opt-in: decode packed,
+                    # and unlock packed request bodies on this client
+                    self._wire_state["confirmed"] = True
+                    WIRE_BYTES_RECEIVED.inc(len(raw), encoding="binary")
+                    t0 = perf_counter()
+                    out = binenc.unpack(raw)
+                    WIRE_DECODE_SECONDS.observe(perf_counter() - t0,
+                                                encoding="binary")
+                    return out
+                WIRE_BYTES_RECEIVED.inc(len(raw), encoding="json")
+                t0 = perf_counter()
+                out = json.loads(raw)
+                WIRE_DECODE_SECONDS.observe(perf_counter() - t0,
+                                            encoding="json")
+                return out
         except urlerror.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"))
 
@@ -358,7 +518,11 @@ class HTTPResourceClient:
 
     def list_rv(self, namespace: Optional[str] = None):
         ns = namespace if namespace is not None else (self._ns or None)
-        url = self._url(namespace=ns or "")
+        # binary opt-in rides the query like slimBind; the response
+        # shape is IDENTICAL either way (_request decodes by the
+        # response Content-Type), so an old hub silently answers JSON
+        url = self._url(namespace=ns or "",
+                        query="binary=true" if self._wire_binary else "")
         data = self._request("GET", url)
         items = [self._decode(d) for d in data.get("items", [])]
         rv = int(data.get("metadata", {}).get("resourceVersion", 0))
@@ -464,6 +628,8 @@ class HTTPResourceClient:
             # must be ready for object-less frames, so informers — which
             # track last_sync_rv — are the ones that ask
             query += "&allowWatchBookmarks=true"
+        if self._wire_binary:
+            query += "&binary=true"
         url = self._url(namespace=ns or "", query=query)
         drop_after = None
         if self._wire_hook is not None:
@@ -477,8 +643,16 @@ class HTTPResourceClient:
             resp = urlrequest.urlopen(req, context=self._ssl)
         except urlerror.HTTPError as e:
             _raise_for(e.code, e.read().decode(errors="replace"))
+        # the server's Content-Type echo decides the pump: an old hub
+        # ignores &binary=true and answers json;stream=watch, and the
+        # line pump keeps working — negotiation is response-driven,
+        # never assumed
+        binary = resp.headers.get("Content-Type", "").startswith(
+            binenc.CONTENT_TYPE)
+        if binary:
+            self._wire_state["confirmed"] = True
         return _HTTPWatch(resp, self._cls, resource=self._resource,
-                          drop_after=drop_after)
+                          drop_after=drop_after, binary=binary)
 
 
 class HTTPPodClient(HTTPResourceClient):
@@ -510,8 +684,19 @@ class HTTPPodClient(HTTPResourceClient):
         body = {"apiVersion": "v1", "kind": "BindList",
                 "items": [[name, node] for name, node in pairs]}
         url = f"{self._base}/api/v1/namespaces/{namespace}/bindings"
-        resp = self._request("POST", url, body,
-                             content_type="application/json")
+        if self._wire_binary:
+            # ask for a binary Status echo; pack the request body only
+            # once a prior binary response CONFIRMED the peer speaks it
+            # (the first batch goes JSON — an old hub must never be
+            # handed bytes it cannot parse). The echo itself confirms,
+            # so a write-only client upgrades on its second batch.
+            url += "?binary=true"
+            ctype = binenc.CONTENT_TYPE \
+                if self._wire_state.get("confirmed") \
+                else "application/json"
+        else:
+            ctype = "application/json"
+        resp = self._request("POST", url, body, content_type=ctype)
         out = [self._decode_bind_slot(item)
                for item in resp.get("items", [])]
         # a truncated/malformed response must not leave missing slots —
@@ -573,11 +758,20 @@ class HTTPClient:
                  key_file: Optional[str] = None,
                  ca_file: Optional[str] = None,
                  insecure_skip_tls_verify: bool = False,
-                 wire_hook: Optional[Callable] = None):
+                 wire_hook: Optional[Callable] = None,
+                 wire: Optional[str] = None):
         self.base_url = base_url
         self.scheme = scheme
         self.token = token
         self.wire_hook = wire_hook
+        #: payload encoding preference ("json" | "binary"); defaults
+        #: from KTPU_WIRE so a whole deployment flips with one env var.
+        #: Read ONCE at construction — no per-request env draws.
+        self.wire = wire if wire is not None \
+            else os.environ.get("KTPU_WIRE", "json")
+        #: binary-capability state shared by every per-resource client
+        #: this instance hands out (see HTTPResourceClient.__init__)
+        self._wire_state = {"confirmed": False}
         self.ssl_context = None
         if base_url.startswith("https") or cert_file or ca_file:
             # kubeconfig TLS shape: server CA pinning + optional client
@@ -606,11 +800,15 @@ class HTTPClient:
             return HTTPPodClient(self.base_url, self.scheme, cls, namespace,
                                  token=self.token,
                                  ssl_context=self.ssl_context,
-                                 wire_hook=self.wire_hook)
+                                 wire_hook=self.wire_hook,
+                                 wire=self.wire,
+                                 wire_state=self._wire_state)
         return HTTPResourceClient(self.base_url, self.scheme, cls, namespace,
                                   token=self.token,
                                   ssl_context=self.ssl_context,
-                                  wire_hook=self.wire_hook)
+                                  wire_hook=self.wire_hook,
+                                  wire=self.wire,
+                                  wire_state=self._wire_state)
 
     def __getattr__(self, name):
         """Convenience accessors (pods(), nodes(), ...) mirror Client's by

@@ -25,13 +25,15 @@ watch framing (application/json;stream=watch).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import perf_counter
 from typing import Any, Callable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from ..api import serde
+from ..api import binenc, serde
 from ..api.core import Binding
 from .admission import QuotaExceeded
 from ..api.validation import ValidationError
@@ -96,6 +98,11 @@ class APIServer:
         self.store = self.client.store
         self.scheme = scheme
         self.admission = AdmissionChain()
+        #: binary-frame kill-switch (KTPU_BINARY_WIRE=0): a hub that
+        #: never echoes the binary opt-in — every client silently keeps
+        #: JSON, exactly the old-peer downgrade contract. Read ONCE at
+        #: construction, like the client's KTPU_WIRE draw.
+        self.binary_wire = os.environ.get("KTPU_BINARY_WIRE", "1") != "0"
         # ---- observability surface (ISSUE 11): the hub is the cluster's
         # scrape point. `metrics` is an observability.MetricsRegistry
         # aggregating every attached component's families (collision-
@@ -736,7 +743,20 @@ class APIServer:
 
     def _read_body(self, h) -> Any:
         length = int(h.headers.get("Content-Length", 0))
-        return json.loads(h.rfile.read(length)) if length else None
+        if not length:
+            return None
+        raw = h.rfile.read(length)
+        # negotiated binary bodies carry the SAME wire dicts as JSON
+        # (binenc packs what serde emits), so every downstream branch —
+        # BindList, bulk create, Binding decode — is encoding-blind
+        if h.headers.get("Content-Type", "").startswith(
+                binenc.CONTENT_TYPE):
+            self.request_metrics.wire_bytes_received.inc(
+                length, encoding="binary")
+            return binenc.unpack(raw)
+        self.request_metrics.wire_bytes_received.inc(
+            length, encoding="json")
+        return json.loads(raw)
 
     #: resources serving the /scale subresource (ref: the ScaleREST
     #: registrations in pkg/registry/{apps,core}/.../storage.go)
@@ -818,15 +838,29 @@ class APIServer:
             else:
                 items, rv = self.store.list(
                     req.resource, req.namespace or None)
+                if self.binary_wire and \
+                        req.query.get("binary") in ("true", "1"):
+                    # negotiated binary collection: per-item packed
+                    # bytes come from the rv-keyed object cache, shared
+                    # with every binary watch frame of the same revision
+                    t0 = perf_counter()
+                    body = binenc.encode_list_body(items, rv)
+                    self.request_metrics.wire_encode_seconds.observe(
+                        perf_counter() - t0, encoding="binary")
+                    self._respond_raw(h, 200, body, binenc.CONTENT_TYPE)
+                    return
                 # assemble from per-object cached JSON: the store's frozen
                 # objects encode once per revision (serde.to_json_cached),
                 # so a 20k-item list is a join, not 20k re-encodes
+                t0 = perf_counter()
                 body = (
                     b'{"apiVersion": "v1", "kind": "List", "metadata": '
                     b'{"resourceVersion": "%d"}, "items": [' % rv
                     + ", ".join(serde.to_json_cached(o)
                                 for o in items).encode()
                     + b"]}")
+                self.request_metrics.wire_encode_seconds.observe(
+                    perf_counter() - t0, encoding="json")
                 self._respond_raw(h, 200, body, "application/json")
         elif method == "POST":
             data = self._read_body(h)
@@ -902,6 +936,15 @@ class APIServer:
                     {"kind": "Status", "status": "Failure",
                      "reason": type(o).__name__, "message": str(o)}
                     for o in outs]}
+                if self.binary_wire and \
+                        req.query.get("binary") in ("true", "1"):
+                    # the binary echo doubles as capability discovery: a
+                    # client that asked and got a binary Content-Type
+                    # back knows it may pack its NEXT BindList body
+                    # (old hubs ignore the query and answer JSON)
+                    self._respond_raw(h, 200, binenc.pack(body),
+                                      binenc.CONTENT_TYPE)
+                    return
                 self._respond_raw(h, 200, json.dumps(body).encode(),
                                   "application/json")
                 return
@@ -1354,12 +1397,22 @@ class APIServer:
         # out of the bounded history window (the 410-relist after a quiet
         # period). Non-negotiating clients keep the bare-line heartbeat.
         bookmarks_ok = req.query.get("allowWatchBookmarks") in ("true", "1")
+        # negotiated binary framing: length-prefixed packed frames
+        # (binenc) instead of JSON lines. The server ECHOES the opt-in
+        # via Content-Type, so a client talking to an old hub sees
+        # application/json back and keeps its line pump — the same
+        # silent-fallback contract slim binds use.
+        binary_ok = self.binary_wire and \
+            req.query.get("binary") in ("true", "1")
+        encoding = "binary" if binary_ok else "json"
         watch = self.store.watch(req.resource, req.namespace or None,
                                  int(rv) if rv else None)
         h._audit_code = 200
         self.request_metrics.watch_streams.inc(resource=req.resource)
         h.send_response(200)
-        h.send_header("Content-Type", "application/json;stream=watch")
+        h.send_header("Content-Type",
+                      binenc.CONTENT_TYPE_WATCH if binary_ok
+                      else "application/json;stream=watch")
         h.send_header("Transfer-Encoding", "chunked")
         h.end_headers()
 
@@ -1387,8 +1440,14 @@ class APIServer:
                     # over so a stopped client can notice and close from
                     # its OWN thread — closing an http response
                     # cross-thread deadlocks. Bookmark-negotiated streams
-                    # ride the pre-wait rv snapshot on it.
-                    if bookmarks_ok:
+                    # ride the pre-wait rv snapshot on it. Binary streams
+                    # need a real (empty-body) frame — an empty chunk is
+                    # the chunked-encoding terminator, not a keep-alive.
+                    if binary_ok:
+                        write_chunk(binenc.bookmark_frame(bm_rv)
+                                    if bookmarks_ok
+                                    else binenc.HEARTBEAT_FRAME)
+                    elif bookmarks_ok:
                         write_chunk(
                             json.dumps({"type": BOOKMARK, "rv": bm_rv})
                             .encode() + b"\n")
@@ -1422,11 +1481,18 @@ class APIServer:
                 # frame back into per-pod events)
                 parts = []
                 slim_run: list = []
+                cache_hits = 0
+                t0 = perf_counter()
 
                 def flush_slim():
                     if not slim_run:
                         return
-                    if len(slim_run) == 1:
+                    if binary_ok:
+                        # FT_BINDS: the coalesced run as one packed
+                        # array (slim × binary compose — binary framing
+                        # of the slim payload, not a third protocol)
+                        parts.append(binenc.binds_frame(slim_run))
+                    elif len(slim_run) == 1:
                         parts.append(
                             f'{{"type": "MODIFIED", "slim": "bind", '
                             f'"o": {json.dumps(slim_run[0])}}}\n'.encode())
@@ -1444,14 +1510,37 @@ class APIServer:
                         slim_run.append(d)
                     else:
                         flush_slim()
-                        parts.append(
-                            (f'{{"type": "{e.type}", "object": '
-                             f"{serde.to_json_cached(e.object)}}}\n")
-                            .encode())
+                        # full-object frames ride the per-(event,
+                        # encoding) byte cache: the store publishes ONE
+                        # WatchEvent object to every watcher queue, so
+                        # the first stream to serialize a revision pays
+                        # the encode and the rest ship its bytes
+                        if binary_ok:
+                            buf, hit = binenc.cached_watch_frame(
+                                e, "binary",
+                                lambda: binenc.event_frame(
+                                    e.type, binenc.encode_obj(e.object)))
+                        else:
+                            buf, hit = binenc.cached_watch_frame(
+                                e, "json",
+                                lambda: (
+                                    f'{{"type": "{e.type}", "object": '
+                                    f"{serde.to_json_cached(e.object)}}}\n"
+                                ).encode())
+                        cache_hits += hit
+                        parts.append(buf)
                 flush_slim()
-                self.request_metrics.watch_events.inc(
+                payload = b"".join(parts)
+                wm = self.request_metrics
+                wm.wire_encode_seconds.observe(
+                    perf_counter() - t0, encoding=encoding)
+                if cache_hits:
+                    wm.watch_frame_cache_hits.inc(
+                        cache_hits, encoding=encoding)
+                wm.wire_bytes_sent.inc(len(payload), encoding=encoding)
+                wm.watch_events.inc(
                     len(batch), resource=req.resource)
-                write_chunk(b"".join(parts))
+                write_chunk(payload)
                 if closing:
                     break
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -1505,6 +1594,10 @@ class APIServer:
 
     def _respond_raw(self, h, code: int, body: bytes, ctype: str,
                      headers: Optional[dict] = None) -> None:
+        self.request_metrics.wire_bytes_sent.inc(
+            len(body),
+            encoding="binary" if ctype.startswith(binenc.CONTENT_TYPE)
+            else "json")
         h._audit_code = code
         h.send_response(code)
         h.send_header("Content-Type", ctype)

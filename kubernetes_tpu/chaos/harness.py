@@ -273,6 +273,7 @@ class ChaosHarness:
                  with_tears: bool = False,
                  ha: bool = False,
                  replica: bool = False,
+                 replica_reads: bool = False,
                  mesh=None,
                  autoscaler: bool = False,
                  autoscaler_cooldown: float = 60.0,
@@ -364,6 +365,21 @@ class ChaosHarness:
         self._replica = None
         self._promote_violations: List[str] = []
         self._promoted = False
+        #: replica read fan-out (replica_reads=True): a STANDBY hub over
+        #: the follower store serves every informer's LIST/watch while
+        #: writes keep hitting the primary; a ReadRouter rotates reads
+        #: back to the primary when replication lag crosses threshold
+        self._read_server = None
+        self._read_client = None
+        self._read_router = None
+        if replica_reads:
+            if not (http and replica):
+                raise ValueError("replica_reads needs http=True and "
+                                 "replica=True (reads are served by a "
+                                 "standby hub over the follower store)")
+            if ha:
+                raise ValueError("replica_reads with ha is not wired "
+                                 "(HA replicas own their factories)")
         if replica:
             if wal_path is None:
                 raise ValueError("replica drill needs wal_path (the "
@@ -392,6 +408,21 @@ class ChaosHarness:
                 # lag/promote attribution in /debug/pending; a
                 # replication-lag check gates the hub's /readyz
                 self._server.attach_replica(self._replica)
+            if replica_reads:
+                # the read path: a standby hub OVER the follower's
+                # read-only store (writes 503 until promote), reached
+                # through the same faulted transport as the primary —
+                # replica reads take wire faults too
+                from ..apiserver.server import APIServer
+                from ..apiserver.httpclient import HTTPClient
+                self._read_server = APIServer(
+                    store=self._replica.store,
+                    metrics=self._make_server_metrics()).start()
+                self._read_server.attach_replica(self._replica)
+                self._read_client = ChaosHTTPClient(
+                    self.injector,
+                    HTTPClient(self._read_server.address,
+                               wire_hook=self.injector.make_wire_hook()))
         #: per-class SLO observation under chaos (slo=True): created
         #: pods carry the serving class label ("gang"/"solo") and a
         #: scan-driven SLOTracker on the shared FakeClock stamps their
@@ -441,8 +472,10 @@ class ChaosHarness:
         else:
             #: controllers' factory; the scheduler runs its OWN factory
             #: so a scheduler crash can take its informers down with it
-            self.factory = SharedInformerFactory(self.client)
-            self._sched_factory = SharedInformerFactory(self.client)
+            self.factory = SharedInformerFactory(
+                self.client, read_client=self._read_client)
+            self._sched_factory = SharedInformerFactory(
+                self.client, read_client=self._read_client)
             self.scheduler = self._build_scheduler(self._sched_factory)
             self._build_controllers(self.factory)
         #: gang-aware capacity management under chaos: the autoscaler
@@ -459,7 +492,8 @@ class ChaosHarness:
             # its own factory: controller-manager restarts replace
             # self.factory, but the autoscaler (like a separate
             # cluster-autoscaler deployment) survives them
-            self._ca_factory = SharedInformerFactory(self.client)
+            self._ca_factory = SharedInformerFactory(
+                self.client, read_client=self._read_client)
             self.autoscaler = ClusterAutoscaler(
                 self.client, self._ca_factory,
                 demand_source=scheduler_demand_source(
@@ -470,6 +504,14 @@ class ChaosHarness:
                 # the virtual kubelets own heartbeats here — and the
                 # injector's node kills must stay authoritative
                 maintain_heartbeats=False)
+        if self._read_client is not None:
+            # driver-ticked rotation gate (no router thread — rotation
+            # instants must be schedule-deterministic); _factories is
+            # passed as a CALLABLE so restart-replaced factories rotate
+            from ..state.replication import ReadRouter
+            self._read_router = ReadRouter(
+                self._replica, self._read_client, self._factories,
+                metrics=self.metrics)
 
     def _make_server_metrics(self):
         """A hub MetricsRegistry with the harness's robustness families
@@ -508,6 +550,14 @@ class ChaosHarness:
     def _build_controllers(self, factory: SharedInformerFactory) -> None:
         self.nodelifecycle, self.podgroups, self.podgc = \
             self._make_controllers(factory)
+
+    def _current_read_client(self):
+        """The read client a crash-replaced factory should come up on:
+        the follower while it is in read rotation, the primary while the
+        router has it gated out (or replica reads are off)."""
+        if self._read_router is not None and self._read_router.on_replica:
+            return self._read_client
+        return None
 
     def _factories(self) -> List[SharedInformerFactory]:
         extra = [self._ca_factory] if self._ca_factory is not None else []
@@ -659,10 +709,16 @@ class ChaosHarness:
             return
         for i in range(self.n_nodes):
             self._register_node(i)
+        if self._replica is not None and self._read_client is not None:
+            # replica reads: the follower must finish its initial sync
+            # BEFORE informers list through the standby hub, or their
+            # first LIST would see an empty follower store
+            self._replica.start()
+            self._replica.wait_synced()
         for fac in self._factories():
             fac.start()
             fac.wait_for_cache_sync()
-        if self._replica is not None:
+        if self._replica is not None and self._read_client is None:
             self._replica.start()
             self._replica.wait_synced()
         self._settle()
@@ -681,12 +737,14 @@ class ChaosHarness:
         self.admin.nodes().create(node)
 
     def close(self) -> None:
+        for fac in self._factories():
+            fac.stop()
+        if self._read_server is not None:
+            self._read_server.stop()
         if self._replica is not None:
             self._replica.stop()
             if not self._promoted:
                 self._replica.store.close()
-        for fac in self._factories():
-            fac.stop()
         if self._server is not None:
             self._server.stop()
         self.admin.store.close()
@@ -708,7 +766,8 @@ class ChaosHarness:
         self.injector.record("restart_scheduler")
         self._sched_factory.stop()
         self.scheduler.crash()
-        self._sched_factory = SharedInformerFactory(self.client)
+        self._sched_factory = SharedInformerFactory(
+            self.client, read_client=self._current_read_client())
         self.scheduler = self._build_scheduler(self._sched_factory)
         self._sched_factory.start()
         self._sched_factory.wait_for_cache_sync()
@@ -725,7 +784,8 @@ class ChaosHarness:
             return self.kill_leader("kube-controller-manager") is not None
         self.injector.record("restart_controllers")
         self.factory.stop()
-        self.factory = SharedInformerFactory(self.client)
+        self.factory = SharedInformerFactory(
+            self.client, read_client=self._current_read_client())
         self._build_controllers(self.factory)
         self.factory.start()
         self.factory.wait_for_cache_sync()
@@ -900,6 +960,15 @@ class ChaosHarness:
         if self.autoscaler is not None:
             self.autoscaler.client = new_client
             self._ca_factory.repoint(new_client)
+        if self._read_server is not None:
+            # the promoted store is now the PRIMARY (served by the new
+            # hub above); the standby read hub over it retires, and the
+            # router with it — factory.repoint already collapsed every
+            # informer's read path onto the promoted client
+            self._read_server.stop()
+            self._read_server = None
+            self._read_client = None
+            self._read_router = None
         if old_server is not None:
             old_server.stop()
         primary.close()
@@ -1218,8 +1287,13 @@ class ChaosHarness:
                 self._settle()
         if self._replica is not None and not self._promoted:
             # one lag sample per tick: primary rv vs the follower's
-            # high-water mark (sets the replication_lag_records gauge)
-            self._replica.observe_lag(self.admin.store.resource_version)
+            # high-water mark (sets the replication_lag_records gauge).
+            # With replica reads on, the router samples — and rotates a
+            # follower past the lag threshold out of read rotation.
+            if self._read_router is not None:
+                self._read_router.tick(self.admin.store.resource_version)
+            else:
+                self._replica.observe_lag(self.admin.store.resource_version)
         if self.slo is not None:
             # settled pod listing, sorted-key order, shared FakeClock —
             # the per-class bind/startup stamps are deterministic

@@ -1,0 +1,68 @@
+"""kube-replica entry point: a follower read server.
+
+Ref: etcd learners serving follower reads / the apiserver's
+"watch from cache". A StoreReplica follows --primary over the same
+list+watch protocol the informers use (preserving the primary's
+resourceVersions), and a read-only APIServer over the follower store
+serves LIST and watch to informer fleets — the replica read fan-out's
+own process, so the primary sheds its read path onto a second CPU.
+Writes against this server answer 503 until the replica is promoted;
+/readyz carries the replication-lag contributor, so a load balancer
+(or the bench harness) can gate a lagging follower out of rotation.
+
+The replication stream's encoding follows KTPU_WIRE exactly like any
+other client, so a binary-wire fleet replicates over binary frames too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-replica")
+    p.add_argument("--primary", required=True,
+                   help="primary apiserver base URL to follow")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8081)
+    p.add_argument("--data-dir", default=None,
+                   help="journal applied records (replayed on restart)")
+    args = p.parse_args(argv)
+
+    from ..apiserver.httpclient import HTTPClient
+    from ..apiserver.server import APIServer
+    from ..state.replication import ReadOnlyStore, StoreReplica
+
+    wal_path = None
+    if args.data_dir:
+        import os
+        os.makedirs(args.data_dir, exist_ok=True)
+        wal_path = os.path.join(args.data_dir, "replica.wal")
+    replica = StoreReplica(HTTPClient(args.primary),
+                           store=ReadOnlyStore(wal_path=wal_path))
+    replica.start()
+    replica.wait_synced()
+    srv = APIServer(store=replica.store, host=args.bind_address,
+                    port=args.port)
+    srv.attach_replica(replica)
+    srv.start()
+    print(f"following {args.primary}, serving reads on {srv.address}",
+          flush=True)
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    stop.wait()
+    replica.stop()
+    srv.stop()
+    replica.store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -474,8 +474,15 @@ class SharedInformerFactory:
     type, shared across all consumers."""
 
     def __init__(self, client: Client,
-                 metrics: Optional[InformerMetrics] = None):
+                 metrics: Optional[InformerMetrics] = None,
+                 read_client: Optional[Client] = None):
         self._client = client
+        #: replica read fan-out (ref: the apiserver's "watch from cache"
+        #: served by followers): when set, informers LIST and watch
+        #: through THIS client — a follower replica's read-only hub —
+        #: while `client` stays the write path. None means reads ride
+        #: the primary like before.
+        self._read_client = read_client
         #: one metric family set shared by this factory's informers
         #: (series split by resource label)
         self.metrics = metrics if metrics is not None else InformerMetrics()
@@ -492,7 +499,9 @@ class SharedInformerFactory:
                 from ..api.core import Pod
                 if cls is Pod:
                     index_funcs["nodeName"] = pod_node_name_index
-                inf = SharedInformer(self._client.resource(cls), index_funcs,
+                rc_client = self._read_client \
+                    if self._read_client is not None else self._client
+                inf = SharedInformer(rc_client.resource(cls), index_funcs,
                                      metrics=self.metrics)
                 self._informers[cls] = inf
             started = self._started
@@ -517,12 +526,30 @@ class SharedInformerFactory:
     def repoint(self, client: Client) -> None:
         """Fail every informer over to a new client (promoted standby):
         each reconnects at its last_sync_rv — see SharedInformer.repoint.
-        Informers created AFTER this call also ride the new client."""
+        Informers created AFTER this call also ride the new client.
+        Clears any replica read routing: after a promote the old
+        follower may BE the new primary (or be gone), so reads collapse
+        onto the promoted client until a router re-splits them."""
         with self._lock:
             self._client = client
+            self._read_client = None
             informers = dict(self._informers)
         for cls, inf in informers.items():
             inf.repoint(client.resource(cls))
+
+    def repoint_reads(self, client: Optional[Client]) -> None:
+        """Move only the READ path (LIST + watch) to `client` — the
+        replica-read rotation: a lagging follower is swapped out for
+        the primary (pass the primary client here), and back in when it
+        catches up. Same rv-continuous reconnect as repoint(), but the
+        write client is untouched. None collapses reads back onto the
+        write client."""
+        with self._lock:
+            self._read_client = client
+            target = client if client is not None else self._client
+            informers = dict(self._informers)
+        for cls, inf in informers.items():
+            inf.repoint(target.resource(cls))
 
     def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
         with self._lock:

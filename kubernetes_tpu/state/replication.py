@@ -265,3 +265,76 @@ class StoreReplica:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+
+
+class ReadRouter:
+    """Replica read fan-out (ref: follower reads / "watch from cache"
+    served by learners): informer factories LIST and watch against a
+    follower's read-only hub while writes keep hitting the primary —
+    the serving architecture PR 17's failover drill was building
+    toward. The router is the rotation gate: the same
+    replication_lag_records signal that feeds the standby's /readyz
+    contributor swaps a lagging follower out of read rotation (reads
+    collapse onto the primary via the factories' rv-continuous
+    repoint_reads — one reconnect, no relist) and back in once it has
+    caught up, with hysteresis so a follower hovering at the threshold
+    doesn't thrash the informer streams.
+
+    tick() is SYNCHRONOUS and driver-called (the chaos harness calls it
+    once per tick where it used to sample observe_lag directly): no
+    router thread means no schedule-independent rotation instants, so
+    the identical-event-log determinism contract survives replica reads
+    being on."""
+
+    def __init__(self, replica: StoreReplica, replica_client,
+                 factories, max_lag_records: int = 256, metrics=None):
+        self._replica = replica
+        self._replica_client = replica_client
+        #: a zero-arg callable returning the CURRENT factory list (the
+        #: chaos harness crash-replaces factories mid-run) or a static
+        #: iterable of factories
+        if callable(factories):
+            self._factories = factories
+        else:
+            frozen = list(factories)
+            self._factories = lambda: frozen
+        #: rotation threshold, in rv records — aligned with the
+        #: readiness contributor's default so "out of read rotation"
+        #: and "not ready" trip together
+        self.max_lag_records = max_lag_records
+        self.metrics = metrics
+        #: True while informer reads ride the follower
+        self.on_replica = True
+        #: rotation count (out + back in), for the bench/debug surface
+        self.rotations = 0
+
+    def tick(self, primary_rv: int) -> int:
+        """Sample lag (delegates to observe_lag, so the gauge and
+        /debug/pending stay current) and rotate the read path if the
+        follower crossed the threshold. Returns the sampled lag."""
+        lag = self._replica.observe_lag(primary_rv)
+        if self.on_replica and lag > self.max_lag_records:
+            # gate the lagging follower out: reads collapse onto the
+            # factories' write client (the primary)
+            self.on_replica = False
+            self.rotations += 1
+            if self.metrics is not None:
+                self.metrics.replication_read_rotations.inc(
+                    direction="to_primary")
+            for f in self._factories():
+                f.repoint_reads(None)
+        elif not self.on_replica and lag <= self.max_lag_records // 2:
+            # caught up (with hysteresis): fan reads back out
+            self.on_replica = True
+            self.rotations += 1
+            if self.metrics is not None:
+                self.metrics.replication_read_rotations.inc(
+                    direction="to_replica")
+            for f in self._factories():
+                f.repoint_reads(self._replica_client)
+        return lag
+
+    def report(self) -> dict:
+        return {"on_replica": self.on_replica,
+                "rotations": self.rotations,
+                "max_lag_records": self.max_lag_records}

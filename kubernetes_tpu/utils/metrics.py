@@ -15,6 +15,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 DEFAULT_BUCKETS = (0.001, 0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
                    0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
 
+#: codec-latency buckets: payload encode/decode runs in the micro- to
+#: low-millisecond range, far below DEFAULT_BUCKETS' 1ms floor
+WIRE_CODEC_BUCKETS = (0.00001, 0.00005, 0.0001, 0.0005, 0.001,
+                      0.005, 0.02, 0.1, 0.5)
+
 
 def _label_key(labels: Dict[str, str]) -> Tuple:
     return tuple(sorted(labels.items()))
@@ -399,6 +404,13 @@ class RobustnessMetrics:
             "replication_reconnects_total",
             "Replication reflector streams re-established after an "
             "error, by resource")
+        #: read-path rotations by the replica ReadRouter: a follower
+        #: gated out of read rotation for lagging (to_primary) or fanned
+        #: back in after catching up (to_replica)
+        self.replication_read_rotations = r.counter(
+            "replication_read_rotations_total",
+            "Informer read-path rotations between replica and primary, "
+            "by direction")
         #: containers a virtual kubelet garbage-collected because the
         #: store no longer knows their pod (torn-WAL recovery: the pod's
         #: create was lost with the journal tail)
@@ -484,6 +496,29 @@ class APIServerMetrics:
         self.watch_events = r.counter(
             "apiserver_watch_events_sent_total",
             "Watch events written to streams, by resource")
+        #: wire volume split by encoding so the r04 bottleneck
+        #: attribution (json encode vs transport) can be re-measured
+        #: per negotiated encoding (ref: apiserver response-size
+        #: families, split by content type)
+        self.wire_bytes_sent = r.counter(
+            "apiserver_wire_bytes_sent_total",
+            "Response + watch-frame bytes written, by encoding")
+        self.wire_bytes_received = r.counter(
+            "apiserver_wire_bytes_received_total",
+            "Request body bytes read, by encoding")
+        #: serialization cost per encoding: payload/frame encode time on
+        #: the hub (decode time lives client-side in httpclient's
+        #: standalone families)
+        self.wire_encode_seconds = r.histogram(
+            "apiserver_wire_encode_seconds",
+            "Payload encode latency, by encoding",
+            buckets=WIRE_CODEC_BUCKETS)
+        #: watch frames served from the per-(event, encoding) byte cache
+        #: instead of re-serializing per registered watcher
+        self.watch_frame_cache_hits = r.counter(
+            "apiserver_watch_frame_cache_hits_total",
+            "Watch frames reused from the shared per-event byte cache, "
+            "by encoding")
 
 
 class Registry:

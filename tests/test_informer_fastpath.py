@@ -1,0 +1,148 @@
+"""Informer slim-frame fast path: decode without deepcopy-per-event, with
+mutation isolation.
+
+Ref: client-go's watch cache hands handlers pointers into the cache and
+documents "you must not mutate"; our contract is stronger — the slim
+fast path materializes the bound pod via a SHALLOW bind clone (sharing
+containers/labels/conditions payloads with the frozen prior revision),
+and a handler that mutates its delivered object must never corrupt the
+indexer's cached revision or a later clone. These tests pin both halves:
+the structure sharing (no deepcopy) and the isolation boundary.
+"""
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.state.informer import EventHandlers, SharedInformer
+from kubernetes_tpu.state.store import MODIFIED, SlimBindRef, WatchEvent
+
+
+def make_pod(name, rv="5"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                resource_version=rv,
+                                labels={"app": "web"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity("100m"),
+                          "memory": Quantity("64Mi")}))]))
+
+
+class _NoHTTP:
+    """ResourceClient stand-in that refuses the GET fallback: these tests
+    must exercise the cached-revision fast path, not the network."""
+    _resource = "pods"
+
+    def get(self, name, namespace=None):
+        raise AssertionError("slim fast path fell back to a GET")
+
+
+def _informer_with(pod):
+    inf = SharedInformer(_NoHTTP())
+    inf.indexer.add(pod)
+    return inf
+
+
+def _slim_event(pod, node="n1", rv=9):
+    return WatchEvent(
+        type=MODIFIED,
+        object=SlimBindRef(namespace=pod.metadata.namespace,
+                           name=pod.metadata.name, node=node,
+                           ts="2026-01-01T00:00:00.000000Z", rv=rv),
+        resource_version=rv)
+
+
+class TestSlimFastPath:
+    def test_materializes_bind_from_cached_revision(self):
+        pod = make_pod("p1")
+        inf = _informer_with(pod)
+        seen = []
+        inf.add_event_handlers(EventHandlers(
+            on_update=lambda old, new: seen.append((old, new))))
+        assert inf._process_event(_slim_event(pod, node="n1", rv=9))
+        old, new = seen[0]
+        assert new.spec.node_name == "n1"
+        assert new.metadata.resource_version == "9"
+        assert any(c.type == "PodScheduled" and c.status == "True"
+                   for c in new.status.conditions)
+        assert inf.last_sync_rv == 9
+
+    def test_no_deepcopy_structure_sharing(self):
+        """The fast path must NOT deepcopy: everything the bind doesn't
+        touch is shared by reference with the prior cached revision."""
+        pod = make_pod("p1")
+        inf = _informer_with(pod)
+        assert inf._process_event(_slim_event(pod))
+        new = inf.indexer.get_by_key("default/p1")
+        assert new is not pod
+        assert new.spec is not pod.spec          # bind wrote node_name
+        assert new.spec.containers is pod.spec.containers
+        assert new.metadata.labels is pod.metadata.labels
+        assert new.spec.containers[0].resources.requests \
+            is pod.spec.containers[0].resources.requests
+
+    def test_prior_revision_not_mutated(self):
+        """Applying the slim bind never writes through to the cached
+        prior revision: the pre-bind object stays pending at its rv."""
+        pod = make_pod("p1", rv="5")
+        inf = _informer_with(pod)
+        assert inf._process_event(_slim_event(pod, node="n1", rv=9))
+        assert pod.spec.node_name == ""
+        assert pod.metadata.resource_version == "5"
+        assert not any(c.type == "PodScheduled"
+                       for c in pod.status.conditions)
+
+    def test_handler_mutation_does_not_corrupt_cache(self):
+        """A handler that scribbles on its delivered object (the
+        reference's forbidden-but-common sin) must not corrupt what the
+        NEXT slim frame materializes from the cache."""
+        pod = make_pod("p1", rv="5")
+        inf = _informer_with(pod)
+
+        def vandal(old, new):
+            new.spec.node_name = "wrong-node"
+            new.metadata.resource_version = "999"
+
+        inf.add_event_handlers(EventHandlers(on_update=vandal))
+        assert inf._process_event(_slim_event(pod, node="n1", rv=9))
+        # the vandal mutated the object AFTER it entered the indexer;
+        # scalar fields it wrote are its own copy's — re-binding from
+        # the cache must produce the hub's values, not the vandal's
+        seen = []
+        inf.remove_event_handlers(inf._handlers[0])
+        inf.add_event_handlers(EventHandlers(
+            on_update=lambda old, new: seen.append(new)))
+        assert inf._process_event(_slim_event(pod, node="n2", rv=12))
+        new = seen[-1]
+        assert new.spec.node_name == "n2"
+        assert new.metadata.resource_version == "12"
+        # and the shared payloads the vandal did NOT touch stayed intact
+        assert new.spec.containers is pod.spec.containers
+
+    def test_cache_miss_falls_back_to_get(self):
+        pod = make_pod("p1")
+        got = make_pod("p1", rv="9")
+        got.spec.node_name = "n1"
+
+        class _Getter(_NoHTTP):
+            def get(self, name, namespace=None):
+                return got
+
+        inf = SharedInformer(_Getter())  # empty indexer: miss
+        seen = []
+        inf.add_event_handlers(EventHandlers(
+            on_add=lambda new: seen.append(new)))
+        ev = _slim_event(pod, node="n1", rv=9)
+        ev.type = "ADDED"
+        assert inf._process_event(ev)
+        assert seen[0] is got
+
+    def test_cache_miss_get_failure_drops_event(self):
+        class _Failing(_NoHTTP):
+            def get(self, name, namespace=None):
+                raise ConnectionError("hub gone")
+
+        inf = SharedInformer(_Failing())
+        pod = make_pod("p1")
+        assert not inf._process_event(_slim_event(pod))
+        assert inf.indexer.get_by_key("default/p1") is None

@@ -1,0 +1,185 @@
+"""Binary wire negotiation end-to-end: opt-in, old-peer fallback, mixed
+clusters, and chaos determinism under binary framing.
+
+Ref: the reference negotiates protobuf the same way — the client ASKS
+(Accept/query opt-in), the server ECHOES the Content-Type, and JSON stays
+the universal fallback. A binary-unaware peer must silently keep JSON
+(no errors, no retries), and a mixed-encoding cluster must converge on
+identical objects regardless of which wire each client drew.
+"""
+
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu import api
+from kubernetes_tpu.api import binenc
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver import APIServer, HTTPClient
+from kubernetes_tpu.chaos.harness import ChaosHarness
+
+
+def make_node(name, cpu="4"):
+    alloc = {"cpu": Quantity(cpu), "memory": Quantity("8Gi"),
+             "pods": Quantity(110)}
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            capacity=dict(alloc), allocatable=dict(alloc),
+            conditions=[api.NodeCondition(type="Ready", status="True")]))
+
+
+def make_pod(name, cpu="100m"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu),
+                          "memory": Quantity("64Mi")}))]))
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer().start()
+    yield srv
+    srv.stop()
+
+
+class TestNegotiation:
+    def test_binary_client_confirms_and_lists(self, server):
+        client = HTTPClient(server.address, wire="binary")
+        assert client.wire == "binary"
+        assert not client._wire_state["confirmed"]
+        client.pods("default").create(make_pod("p1"))
+        # the first binary-typed response confirms the wire
+        items = client.pods("default").list()
+        assert client._wire_state["confirmed"]
+        assert [p.metadata.name for p in items] == ["p1"]
+
+    def test_json_client_unaffected(self, server):
+        client = HTTPClient(server.address, wire="json")
+        client.pods("default").create(make_pod("p1"))
+        assert [p.metadata.name for p in client.pods("default").list()] \
+            == ["p1"]
+        assert not client._wire_state["confirmed"]
+
+    def test_old_peer_downgrades_silently(self, monkeypatch):
+        """A hub that never echoes the binary opt-in (KTPU_BINARY_WIRE=0
+        simulates a pre-binenc peer): a binary client keeps asking, the
+        server keeps answering JSON, and everything works — the fallback
+        is silent, not an error path."""
+        monkeypatch.setenv("KTPU_BINARY_WIRE", "0")
+        srv = APIServer().start()
+        try:
+            client = HTTPClient(srv.address, wire="binary")
+            client.pods("default").create(make_pod("p1"))
+            items, rv = client.pods("default").list_rv()
+            assert [p.metadata.name for p in items] == ["p1"]
+            assert not client._wire_state["confirmed"]  # never upgraded
+            w = client.pods("default").watch(resource_version=rv)
+            try:
+                client.pods("default").create(make_pod("p2"))
+                ev = w.events.get(timeout=5)
+                assert ev.type == "ADDED"
+                assert ev.object.metadata.name == "p2"
+            finally:
+                w.stop()
+        finally:
+            srv.stop()
+
+    def test_mixed_encoding_cluster_sees_identical_objects(self, server):
+        """One hub, one JSON client, one binary client: every read —
+        GET, LIST, watch — decodes to the same objects on both wires."""
+        jc = HTTPClient(server.address, wire="json")
+        bc = HTTPClient(server.address, wire="binary")
+        jc.nodes().create(make_node("n1"))
+        for i in range(5):
+            jc.pods("default").create(make_pod(f"pj{i}"))
+        for i in range(5):
+            bc.pods("default").create(make_pod(f"pb{i}"))
+        jl, jrv = jc.pods("default").list_rv()
+        bl, brv = bc.pods("default").list_rv()
+        assert bc._wire_state["confirmed"]   # the binary LIST upgraded
+        assert not jc._wire_state["confirmed"]
+        assert jrv == brv
+        assert jl == bl
+        assert jc.nodes().get("n1") == bc.nodes().get("n1")
+        # watch the same history over both wires
+        jw = jc.pods().watch(namespace=None, resource_version=0)
+        bw = bc.pods().watch(namespace=None, resource_version=0)
+        try:
+            jev = [jw.events.get(timeout=5) for _ in range(10)]
+            bev = [bw.events.get(timeout=5) for _ in range(10)]
+            assert [(e.type, e.object) for e in jev] \
+                == [(e.type, e.object) for e in bev]
+        finally:
+            jw.stop()
+            bw.stop()
+
+    def test_binary_watch_ships_binenc_frames(self, server):
+        """The raw watch stream really is length-prefixed binenc, not
+        JSON lines: read the socket bytes directly and parse a frame."""
+        client = HTTPClient(server.address, wire="binary")
+        client.pods("default").create(make_pod("p1"))
+        client.pods("default").list()  # the binary LIST confirms the wire
+        assert client._wire_state["confirmed"]
+        req = urllib.request.Request(
+            f"{server.address}/api/v1/pods"
+            "?watch=true&resourceVersion=0&binary=true")
+        resp = urllib.request.urlopen(req, timeout=5)
+        try:
+            assert resp.headers.get("Content-Type") \
+                == binenc.CONTENT_TYPE_WATCH
+            hdr = resp.read(binenc.HEADER_SIZE)
+            ftype, blen = binenc.parse_header(hdr)
+            assert ftype == binenc.FT_EVENT
+            body = resp.read(blen)
+            assert binenc.EVENT_NAMES[body[0]] == "ADDED"
+            obj = binenc.unpack(body[1:])
+            assert obj["metadata"]["name"] == "p1"
+        finally:
+            resp.close()
+
+    def test_server_wire_metrics_track_encodings(self, server):
+        jc = HTTPClient(server.address, wire="json")
+        bc = HTTPClient(server.address, wire="binary")
+        jc.pods("default").create(make_pod("p1"))
+        jc.pods("default").list()
+        bc.pods("default").list()
+        bc.pods("default").list()
+        sent = server.request_metrics.wire_bytes_sent
+        assert sent.value(encoding="json") > 0
+        assert sent.value(encoding="binary") > 0
+
+
+class TestWireChaosDeterminism:
+    """ACCEPTANCE (tier-1 cut of the soak): chaos runs with binary
+    framing + replica read fan-out are deterministic per seed, and the
+    end state is encoding-independent."""
+
+    def _run(self, monkeypatch, tmp_path, wire, tag):
+        monkeypatch.setenv("KTPU_WIRE", wire)
+        h = ChaosHarness(seed=11, nodes=8, http=True, replica=True,
+                         replica_reads=True, error_rate=0.02,
+                         watch_drop_rate=0.05,
+                         wal_path=str(tmp_path / f"{tag}.wal"))
+        try:
+            return h.run(n_events=14, quiesce_steps=10)
+        finally:
+            h.close()
+
+    def test_binary_wire_same_seed_identical(self, monkeypatch, tmp_path):
+        r1 = self._run(monkeypatch, tmp_path, "binary", "b1")
+        r2 = self._run(monkeypatch, tmp_path, "binary", "b2")
+        assert not r1.violations, r1.violations
+        assert r1.events == r2.events
+        assert r1.store_state == r2.store_state
+
+    def test_binary_vs_json_store_parity(self, monkeypatch, tmp_path):
+        rb = self._run(monkeypatch, tmp_path, "binary", "pb")
+        rj = self._run(monkeypatch, tmp_path, "json", "pj")
+        assert not rb.violations, rb.violations
+        assert not rj.violations, rj.violations
+        assert rb.store_state == rj.store_state
+        assert rb.events == rj.events
