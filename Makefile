@@ -1,7 +1,8 @@
 # Convenience entries (the reference's hack/ equivalents).
 
 .PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
-	bench-preempt bench-tenancy bench-resilience bench-wire
+	bench-preempt bench-tenancy bench-resilience bench-wire \
+	bench-overload
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -58,3 +59,12 @@ bench-tenancy:
 bench-wire:
 	JAX_PLATFORMS=cpu python bench.py wire > BENCH_r12.json
 	@tail -c 400 BENCH_r12.json; echo
+
+# overload bench: the BENCH_r13 round — tenant LIST/create client storm
+# against a tiny hub, APF on (fair queues + priority levels) vs the
+# storm-free baseline and the no-APF instant-shed control: system-
+# traffic p99 isolation ratio, slow lease renews, per-level 429s,
+# same-seed determinism. Publishes BENCH_r13.json.
+bench-overload:
+	JAX_PLATFORMS=cpu python bench.py overload > BENCH_r13.json
+	@tail -c 400 BENCH_r13.json; echo

@@ -1,0 +1,481 @@
+"""API Priority & Fairness for the hub (ref: k8s API Priority and
+Fairness — flow schemas route requests to priority levels, each level
+fair-queues per flow with shuffle sharding, and client-go's token-bucket
+flowcontrol limiter keeps well-behaved clients from ever meeting the
+server-side queues).
+
+Four pieces live here:
+
+- ``classify`` — the flow-schema table: (user, namespace/tenant, verb,
+  resource) -> (priority level, flow key). Pure function, so the legacy
+  shed path can label its 429s with the same priority levels APF uses.
+- ``FlowController`` — per-priority-level seats carved from the existing
+  read/write pools, bounded per-flow FIFO queues behind a shuffle-shard
+  row (seeded, so chaos schedules stay reproducible), and a
+  deterministic round-robin dispatcher. Overflow and queue timeout
+  answer 429 with a Retry-After computed from queue depth and the
+  observed drain rate.
+- ``TokenBucket`` — the client-go flowcontrol analog: a reservation
+  token bucket on an injectable clock (tokens may go negative; the
+  caller sleeps the deficit).
+- ``RetryBudget`` — a per-client cap on 429-driven retries so a fleet
+  of synchronized clients can't amplify an overload into a herd.
+
+No wall-clock in this module: every timestamp comes from the injected
+``Clock`` (FakeClock in tests and chaos), and shuffle-shard placement is
+a pure function of (seed, flow key).
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.clock import Clock, REAL_CLOCK
+
+# --------------------------------------------------------------- schema
+
+#: priority level names, highest precedence first (exposition order)
+SYSTEM = "system"
+WORKLOAD_HIGH = "workload-high"
+WORKLOAD_LOW = "workload-low"
+CATCH_ALL = "catch-all"
+
+PRIORITY_LEVELS = (SYSTEM, WORKLOAD_HIGH, WORKLOAD_LOW, CATCH_ALL)
+
+#: concurrency shares per level, applied to each verb-class pool (ref:
+#: assuredConcurrencyShares — the suggested config gives system-* the
+#: biggest slice and catch-all the smallest). Every level keeps a >= 1
+#: seat floor, so tiny pools overcommit slightly rather than starve a
+#: level outright, exactly as ACS floors do.
+DEFAULT_SHARES: Dict[str, float] = {
+    SYSTEM: 0.40,
+    WORKLOAD_HIGH: 0.30,
+    WORKLOAD_LOW: 0.20,
+    CATCH_ALL: 0.10,
+}
+
+#: client hint header: a tenant can self-declare bulk traffic as
+#: workload-low (the analog of priority annotations on FlowSchemas)
+PRIORITY_HINT_HEADER = "X-KTPU-Priority"
+
+#: groups whose members are control-plane components (ref: the
+#: system-leader-election / system-nodes FlowSchema subjects)
+_SYSTEM_GROUPS = frozenset({"system:masters", "system:nodes"})
+
+
+@dataclass(frozen=True)
+class FlowClassification:
+    """Where a request landed: priority level, flow key within the
+    level (the shuffle-shard distinguisher), and which schema matched
+    (for /debug/flows attribution)."""
+    level: str
+    flow: str
+    schema: str
+
+
+def classify(verb: str, resource: str, subresource: str, namespace: str,
+             user=None, headers=None,
+             tenant_of: Optional[Callable[[str], str]] = None,
+             ) -> FlowClassification:
+    """The flow-schema table, evaluated in precedence order (ref:
+    FlowSchema matchingPrecedence — first match wins):
+
+    1. control-plane identities (system:* users, system:masters/nodes
+       groups) -> system
+    2. leases (leader election renews) -> system
+    3. bindings / pods/binding (scheduler binds) -> system
+    4. node status + heartbeat writes -> system
+    5. namespaced LISTs and self-declared bulk traffic -> workload-low
+    6. other namespaced (tenant) traffic -> workload-high
+    7. everything else (cluster-scoped reads, discovery) -> catch-all
+
+    The flow key inside tenant levels is the namespace's
+    serving.ktpu/tenant label when ``tenant_of`` resolves one, else the
+    namespace — so one tenant's queues never absorb another's burst.
+    """
+    name = getattr(user, "name", "") or ""
+    groups = frozenset(getattr(user, "groups", ()) or ())
+    if name.startswith("system:") or (groups & _SYSTEM_GROUPS):
+        return FlowClassification(SYSTEM, name or "system",
+                                  "system-components")
+    if resource == "leases":
+        return FlowClassification(SYSTEM, "leader-election",
+                                  "system-leader-election")
+    if resource == "bindings" or (resource == "pods"
+                                  and subresource == "binding"):
+        return FlowClassification(SYSTEM, "scheduler-binds",
+                                  "system-binds")
+    if resource == "nodes" and (subresource == "status"
+                                or verb in ("update", "patch")):
+        return FlowClassification(SYSTEM, "node-heartbeats",
+                                  "system-node-heartbeats")
+    if namespace:
+        tenant = ""
+        if tenant_of is not None:
+            try:
+                tenant = tenant_of(namespace) or ""
+            except Exception:
+                tenant = ""
+        flow = tenant or namespace
+        hint = ""
+        if headers is not None:
+            hint = (headers.get(PRIORITY_HINT_HEADER) or "").strip()
+        if verb == "list" or hint == WORKLOAD_LOW:
+            return FlowClassification(WORKLOAD_LOW, flow, "tenant-bulk")
+        return FlowClassification(WORKLOAD_HIGH, flow, "tenant-traffic")
+    return FlowClassification(CATCH_ALL, name or "cluster", "catch-all")
+
+
+def request_verb(method: str, has_name: bool) -> str:
+    """HTTP method -> flow-control verb (watches never reach APF)."""
+    if method == "GET":
+        return "get" if has_name else "list"
+    return {"POST": "create", "PUT": "update", "PATCH": "patch",
+            "DELETE": "delete"}.get(method, method.lower())
+
+
+# ------------------------------------------------------- drain estimator
+
+class DrainEstimator:
+    """Observed drain rate over a sliding window of dispatch stamps,
+    for Retry-After = ceil(queue_depth / drain_rate). When the window
+    hasn't seen enough dispatches to estimate (cold start, total stall),
+    fall back to assuming one seat-time per queued request so the header
+    is never 0 and never unbounded."""
+
+    def __init__(self, clock: Clock, window: int = 64):
+        self._clock = clock
+        self._stamps: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def note_dispatch(self) -> None:
+        with self._lock:
+            self._stamps.append(self._clock.monotonic())
+
+    def rate(self) -> float:
+        """Dispatches/second over the window; 0.0 when unknown."""
+        with self._lock:
+            if len(self._stamps) < 2:
+                return 0.0
+            span = self._stamps[-1] - self._stamps[0]
+            if span <= 0.0:
+                return 0.0
+            return (len(self._stamps) - 1) / span
+
+    def retry_after(self, depth: int, seats: int = 1) -> int:
+        """Seconds a rejected caller should wait for ``depth`` queued
+        requests to drain. Clamped to [1, 30]: a 429 is advice, not a
+        lease, and a >30s hint would outlive most overloads."""
+        r = self.rate()
+        if r <= 0.0:
+            r = float(max(1, seats))  # cold start: assume 1 req/s/seat
+        return max(1, min(30, int(math.ceil(max(0, depth) / r))))
+
+
+# ----------------------------------------------------------- fair queues
+
+@dataclass
+class _Waiter:
+    """One queued request: the handler thread parks on ``ready`` until
+    the dispatcher hands it a seat or its queue timeout fires."""
+    flow: str
+    enqueued_at: float
+    ready: threading.Event = field(default_factory=threading.Event)
+    dispatched: bool = False
+
+
+class _Ticket:
+    """A held seat; returned by admit, redeemed by release."""
+
+    __slots__ = ("level", "klass", "queue_wait")
+
+    def __init__(self, level: str, klass: str, queue_wait: float = 0.0):
+        self.level = level
+        self.klass = klass
+        self.queue_wait = queue_wait
+
+
+class _PriorityLevel:
+    """Seats + shuffle-shard fair queues for one (level, verb-class)
+    pair. All mutation happens under the controller lock; only the
+    Event wait happens outside it."""
+
+    def __init__(self, name: str, klass: str, seats: int,
+                 n_queues: int, queue_length: int, hand_size: int,
+                 seed: int):
+        self.name = name
+        self.klass = klass
+        self.seats = seats
+        self.in_flight = 0
+        self.n_queues = n_queues
+        self.queue_length = queue_length
+        self.hand_size = min(hand_size, n_queues)
+        self.seed = seed
+        self.queues: List[deque] = [deque() for _ in range(n_queues)]
+        self.rr = 0  # round-robin dispatch cursor
+        self.dispatched = 0
+        self.queued = 0
+        self.rejected = 0
+
+    def hand_for(self, flow: str) -> List[int]:
+        """Shuffle shard: the deterministic hand of candidate queues for
+        a flow — sha1(seed:flow) bytes pick ``hand_size`` distinct
+        indices, so a hot flow collides with any given other flow on at
+        most a fraction of its hand (ref: shufflesharding.Dealer)."""
+        digest = hashlib.sha1(
+            f"{self.seed}:{self.name}:{flow}".encode()).digest()
+        hand: List[int] = []
+        i = 0
+        while len(hand) < self.hand_size and i + 2 <= len(digest):
+            idx = int.from_bytes(digest[i:i + 2], "big") % self.n_queues
+            if idx not in hand:
+                hand.append(idx)
+            i += 2
+        # pathological digest (all collisions): fill sequentially
+        j = 0
+        while len(hand) < self.hand_size:
+            if j not in hand:
+                hand.append(j)
+            j += 1
+        return hand
+
+    def shortest_queue(self, flow: str) -> int:
+        """Enqueue target: the shortest queue in the flow's hand (ties
+        break to the earliest hand position — deterministic)."""
+        hand = self.hand_for(flow)
+        best = hand[0]
+        for idx in hand[1:]:
+            if len(self.queues[idx]) < len(self.queues[best]):
+                best = idx
+        return best
+
+    def next_waiter(self) -> Optional[_Waiter]:
+        """Round-robin over non-empty queues starting after the cursor;
+        advances the cursor past the serviced queue. Deterministic for a
+        given queue state."""
+        for off in range(self.n_queues):
+            idx = (self.rr + off) % self.n_queues
+            if self.queues[idx]:
+                self.rr = (idx + 1) % self.n_queues
+                return self.queues[idx].popleft()
+        return None
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+
+class Rejected(Exception):
+    """APF verdict: shed this request with the carried Retry-After."""
+
+    def __init__(self, level: str, flow: str, retry_after: int,
+                 reason: str):
+        super().__init__(reason)
+        self.level = level
+        self.flow = flow
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class FlowController:
+    """Priority levels, fair queues, and the dispatcher.
+
+    ``admit(classification, klass)`` blocks the handler thread until a
+    seat is free (or raises ``Rejected`` on overflow / queue timeout);
+    ``release(ticket)`` returns the seat and hands it to the next
+    round-robin waiter. Seats are carved per verb class ("read" /
+    "write") from the same pool sizes the legacy inflight limits used,
+    so APF is a drop-in negotiation of the existing capacity, not new
+    capacity.
+    """
+
+    def __init__(self, read_pool: int, write_pool: int,
+                 shares: Optional[Dict[str, float]] = None,
+                 n_queues: int = 8, queue_length: int = 16,
+                 hand_size: int = 2, queue_timeout: float = 5.0,
+                 seed: int = 0, clock: Clock = REAL_CLOCK,
+                 metrics=None, record: bool = False):
+        shares = dict(DEFAULT_SHARES if shares is None else shares)
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.queue_timeout = queue_timeout
+        self.metrics = metrics
+        self.drain = DrainEstimator(clock)
+        #: optional dispatch log for determinism tests: (level, flow)
+        #: in dispatch order. Byte-identical across same-seed runs.
+        self.record = record
+        self.dispatch_log: List[Tuple[str, str]] = []
+        self._levels: Dict[Tuple[str, str], _PriorityLevel] = {}
+        for klass, pool in (("read", read_pool), ("write", write_pool)):
+            for name in PRIORITY_LEVELS:
+                # a 0/None pool means "unlimited" in the legacy limits;
+                # carve nothing — effectively-infinite seats, no queueing
+                seats = max(1, int(pool * shares.get(name, 0.0))) \
+                    if pool else (1 << 30)
+                self._levels[(name, klass)] = _PriorityLevel(
+                    name, klass, seats, n_queues, queue_length,
+                    hand_size, seed)
+
+    # ------------------------------------------------------------ admit
+
+    def admit(self, c: FlowClassification, klass: str) -> _Ticket:
+        """Block until dispatched; raise Rejected on overflow/timeout."""
+        lvl = self._levels[(c.level, klass)]
+        with self._lock:
+            if lvl.in_flight < lvl.seats and lvl.depth() == 0:
+                lvl.in_flight += 1
+                self._note_dispatch(lvl, c.flow)
+                return _Ticket(c.level, klass)
+            depth = lvl.depth()
+            qi = lvl.shortest_queue(c.flow)
+            if len(lvl.queues[qi]) >= lvl.queue_length:
+                lvl.rejected += 1
+                ra = self.drain.retry_after(depth + 1, lvl.seats)
+                if self.metrics is not None:
+                    self.metrics.rejected.inc(
+                        priority_level=c.level, reason="queue-full")
+                raise Rejected(c.level, c.flow, ra, "queue full")
+            w = _Waiter(flow=c.flow,
+                        enqueued_at=self._clock.monotonic())
+            lvl.queues[qi].append(w)
+            lvl.queued += 1
+            if self.metrics is not None:
+                self.metrics.queued.inc(priority_level=c.level)
+        w.ready.wait(self.queue_timeout)
+        with self._lock:
+            if w.dispatched:
+                wait = self._clock.monotonic() - w.enqueued_at
+                if self.metrics is not None:
+                    self.metrics.queue_wait.observe(
+                        wait, priority_level=c.level)
+                return _Ticket(c.level, klass, queue_wait=wait)
+            # timeout: remove self from whichever queue still holds us
+            # (the dispatcher may be about to pick us — dispatched is
+            # re-checked under the lock, so the race resolves cleanly)
+            for q in lvl.queues:
+                try:
+                    q.remove(w)
+                    break
+                except ValueError:
+                    continue
+            lvl.rejected += 1
+            ra = self.drain.retry_after(lvl.depth() + 1, lvl.seats)
+        if self.metrics is not None:
+            self.metrics.rejected.inc(
+                priority_level=c.level, reason="timeout")
+        raise Rejected(c.level, c.flow, ra, "queue timeout")
+
+    def release(self, ticket: _Ticket) -> None:
+        """Return the seat; hand it to the next round-robin waiter."""
+        lvl = self._levels[(ticket.level, ticket.klass)]
+        with self._lock:
+            nxt = lvl.next_waiter()
+            if nxt is not None:
+                nxt.dispatched = True
+                self._note_dispatch(lvl, nxt.flow)
+                nxt.ready.set()
+            else:
+                lvl.in_flight -= 1
+
+    def _note_dispatch(self, lvl: _PriorityLevel, flow: str) -> None:
+        lvl.dispatched += 1
+        self.drain.note_dispatch()
+        if self.record:
+            self.dispatch_log.append((lvl.name, flow))
+        if self.metrics is not None:
+            self.metrics.dispatched.inc(priority_level=lvl.name)
+
+    # ------------------------------------------------------------ debug
+
+    def debug_state(self) -> dict:
+        """The /debug/flows payload: per (level, class) seats, inflight,
+        queue depths, and counters."""
+        out = []
+        with self._lock:
+            for (name, klass) in sorted(self._levels):
+                lvl = self._levels[(name, klass)]
+                out.append({
+                    "priority_level": name,
+                    "class": klass,
+                    "seats": lvl.seats,
+                    "in_flight": lvl.in_flight,
+                    "queued": lvl.depth(),
+                    "queue_lengths": [len(q) for q in lvl.queues],
+                    "dispatched_total": lvl.dispatched,
+                    "queued_total": lvl.queued,
+                    "rejected_total": lvl.rejected,
+                })
+        return {"drain_rate_per_s": round(self.drain.rate(), 3),
+                "priority_levels": out}
+
+
+# --------------------------------------------------------- client side
+
+class TokenBucket:
+    """client-go flowcontrol's reservation token bucket: ``wait()``
+    debits one token and sleeps off any deficit (tokens may go
+    negative, like rate.Limiter reservations), so steady-state
+    throughput is exactly ``qps`` with bursts up to ``burst``.
+    Injectable clock; FakeClock makes waits instantaneous in tests."""
+
+    def __init__(self, qps: float, burst: int = 10,
+                 clock: Clock = REAL_CLOCK):
+        if qps <= 0:
+            raise ValueError("qps must be > 0")
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._clock = clock
+        self._tokens = float(self.burst)
+        self._last = clock.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        self._tokens = min(float(self.burst),
+                           self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def wait(self) -> float:
+        """Take one token, sleeping off any deficit. Returns the delay
+        actually slept (0.0 when a token was free)."""
+        with self._lock:
+            now = self._clock.monotonic()
+            self._refill(now)
+            self._tokens -= 1.0
+            delay = 0.0 if self._tokens >= 0.0 \
+                else -self._tokens / self.qps
+        if delay > 0.0:
+            self._clock.sleep(delay)
+        return delay
+
+
+class RetryBudget:
+    """A cap on 429-driven retries per client: ``cap`` retry tokens,
+    refilled at ``refill_per_s``. When the budget is dry the client
+    surfaces the 429 instead of retrying — the anti-herd valve (ref:
+    client-go's retry-after handling plus the SRE retry-budget
+    pattern)."""
+
+    def __init__(self, cap: int = 10, refill_per_s: float = 0.5,
+                 clock: Clock = REAL_CLOCK):
+        self.cap = max(1, int(cap))
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._tokens = float(self.cap)
+        self._last = clock.monotonic()
+        self._lock = threading.Lock()
+
+    def try_spend(self) -> bool:
+        """Take one retry token if available; False means give up."""
+        with self._lock:
+            now = self._clock.monotonic()
+            self._tokens = min(
+                float(self.cap),
+                self._tokens + (now - self._last) * self.refill_per_s)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False

@@ -30,6 +30,8 @@ from ..runtime.scheme import SCHEME, Scheme
 from ..state.store import (BOOKMARK, MODIFIED, AlreadyExistsError,
                            ConflictError, ExpiredError, NotFoundError,
                            SlimBindRef, WatchEvent)
+from ..utils.backoff import BackoffPolicy
+from ..utils.clock import REAL_CLOCK
 from ..utils.metrics import WIRE_CODEC_BUCKETS, Counter, Histogram
 
 #: terminal watch-stream errors by (resource, reason) — the TRANSPORT
@@ -75,7 +77,14 @@ class WatchStaleError(ConnectionError):
 
 
 class TooManyRequestsError(RuntimeError):
-    """HTTP 429 from the server's overload protection (max-inflight)."""
+    """HTTP 429 from the server's overload protection (an APF fair-queue
+    rejection or the legacy max-inflight shed). Carries the parsed
+    Retry-After seconds so retry layers honor the server's hint instead
+    of hammering back on their own schedule."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 #: wire-hook kinds — an injectable transport interceptor
@@ -89,7 +98,7 @@ WIRE_REQUEST = "request"
 WIRE_WATCH = "watch"
 
 
-def _raise_for(status: int, body: str) -> None:
+def _raise_for(status: int, body: str, headers=None) -> None:
     try:
         msg = json.loads(body).get("message", body)
     except Exception:
@@ -100,12 +109,20 @@ def _raise_for(status: int, body: str) -> None:
         raise PermissionError(f"Forbidden: {msg}")
     if status == 429:
         # two distinct 429s: a PDB-refused eviction vs the server's
-        # inflight overload limiter — callers handle them differently
+        # overload protection — callers handle them differently
         # (drain waits on budgets; overload is a generic retry)
         if "disruption budget" in msg:
             from ..state.client import TooManyDisruptions
             raise TooManyDisruptions(msg)
-        raise TooManyRequestsError(msg)
+        # the header used to be dropped here, leaving callers to guess a
+        # retry delay the server had already computed for them
+        ra = None
+        if headers is not None:
+            try:
+                ra = float(headers.get("Retry-After"))
+            except (TypeError, ValueError):
+                ra = None
+        raise TooManyRequestsError(msg, retry_after=ra)
     if status == 404:
         raise NotFoundError(msg)
     if status == 410:
@@ -353,8 +370,21 @@ class HTTPResourceClient:
                  token: Optional[str] = None, ssl_context=None,
                  wire_hook: Optional[Callable] = None,
                  wire: str = "json",
-                 wire_state: Optional[dict] = None):
+                 wire_state: Optional[dict] = None,
+                 limiter=None, retry_budget=None, retry_429: int = 0,
+                 clock=REAL_CLOCK, seed: int = 0):
         self._ssl = ssl_context
+        #: client-side flow control, SHARED across the per-resource
+        #: clients one HTTPClient hands out (like _wire_state): one
+        #: token bucket and one retry budget per client process —
+        #: per-resource instances would multiply the limit
+        self._limiter = limiter
+        self._retry_budget = retry_budget
+        self._retry_429 = int(retry_429)
+        self._retry_policy = BackoffPolicy(attempts=self._retry_429 + 1) \
+            if self._retry_429 else None
+        self._clock = clock
+        self._seed = seed
         #: transport interceptor (see WIRE_REQUEST/WIRE_WATCH above):
         #: chaos runs inject latency, connection resets, and watch drops
         #: into the REAL http path here, not into a client wrapper
@@ -407,6 +437,37 @@ class HTTPResourceClient:
 
     def _request(self, method: str, url: str, body: Any = None,
                  content_type: Optional[str] = None):
+        if self._limiter is not None:
+            # the client-go flowcontrol analog: smooth this client's
+            # offered load BEFORE the server has to queue or shed it
+            self._limiter.wait()
+        if not self._retry_429:
+            return self._request_once(method, url, body, content_type)
+        # 429 retry loop: safe for every verb because the server sheds
+        # BEFORE handling (the rejected request never executed). Delays
+        # come from the shared backoff policy, floored by the server's
+        # Retry-After, and gated by the per-client retry budget so a
+        # synchronized fleet can't amplify an overload into a herd.
+        op = f"{method}:{urlsplit(url).path}"
+        delays = self._retry_policy.delays(seed=self._seed, op=op)
+        while True:
+            try:
+                return self._request_once(method, url, body, content_type)
+            except TooManyRequestsError as e:
+                delay = next(delays, None)
+                if delay is None:
+                    raise  # policy exhausted: surface the 429
+                if self._retry_budget is not None and \
+                        not self._retry_budget.try_spend():
+                    raise  # budget dry: stop amplifying
+                if e.retry_after:
+                    delay = max(delay, float(e.retry_after))
+                self._clock.sleep(delay)
+                if self._limiter is not None:
+                    self._limiter.wait()
+
+    def _request_once(self, method: str, url: str, body: Any = None,
+                      content_type: Optional[str] = None):
         if content_type is not None:
             if content_type.startswith(binenc.CONTENT_TYPE):
                 data = binenc.pack(body) if body is not None else None
@@ -454,7 +515,8 @@ class HTTPResourceClient:
                                             encoding="json")
                 return out
         except urlerror.HTTPError as e:
-            _raise_for(e.code, e.read().decode(errors="replace"))
+            _raise_for(e.code, e.read().decode(errors="replace"),
+                       headers=e.headers)
 
     def _decode(self, data) -> Any:
         return serde.decode(self._cls, data)
@@ -642,7 +704,8 @@ class HTTPResourceClient:
         try:
             resp = urlrequest.urlopen(req, context=self._ssl)
         except urlerror.HTTPError as e:
-            _raise_for(e.code, e.read().decode(errors="replace"))
+            _raise_for(e.code, e.read().decode(errors="replace"),
+                       headers=e.headers)
         # the server's Content-Type echo decides the pump: an old hub
         # ignores &binary=true and answers json;stream=watch, and the
         # line pump keeps working — negotiation is response-driven,
@@ -759,11 +822,29 @@ class HTTPClient:
                  ca_file: Optional[str] = None,
                  insecure_skip_tls_verify: bool = False,
                  wire_hook: Optional[Callable] = None,
-                 wire: Optional[str] = None):
+                 wire: Optional[str] = None,
+                 qps: Optional[float] = None, burst: int = 10,
+                 retry_429: int = 0, retry_budget=None,
+                 clock=None, seed: int = 0):
         self.base_url = base_url
         self.scheme = scheme
         self.token = token
         self.wire_hook = wire_hook
+        # ---- client-side flow control (ISSUE 19, the client-go
+        # flowcontrol analog): `qps`/`burst` smooth offered load through
+        # a token bucket; `retry_429` > 0 turns on honoring the server's
+        # Retry-After for that many retries, spent from a shared
+        # RetryBudget (default cap 10, +0.5/s) so a herd can't form.
+        # Both default OFF — existing callers see identical behavior.
+        from .flowcontrol import RetryBudget, TokenBucket
+        self._clock = clock if clock is not None else REAL_CLOCK
+        self.seed = seed
+        self.retry_429 = int(retry_429)
+        self.limiter = TokenBucket(qps, burst=burst, clock=self._clock) \
+            if qps else None
+        self.retry_budget = retry_budget if retry_budget is not None \
+            else (RetryBudget(clock=self._clock) if self.retry_429
+                  else None)
         #: payload encoding preference ("json" | "binary"); defaults
         #: from KTPU_WIRE so a whole deployment flips with one env var.
         #: Read ONCE at construction — no per-request env draws.
@@ -796,19 +877,17 @@ class HTTPClient:
             self.ssl_context = ctx
 
     def resource(self, cls: Type, namespace: Optional[str] = None):
-        if cls is corev1.Pod:
-            return HTTPPodClient(self.base_url, self.scheme, cls, namespace,
-                                 token=self.token,
-                                 ssl_context=self.ssl_context,
-                                 wire_hook=self.wire_hook,
-                                 wire=self.wire,
-                                 wire_state=self._wire_state)
-        return HTTPResourceClient(self.base_url, self.scheme, cls, namespace,
-                                  token=self.token,
-                                  ssl_context=self.ssl_context,
-                                  wire_hook=self.wire_hook,
-                                  wire=self.wire,
-                                  wire_state=self._wire_state)
+        kind = HTTPPodClient if cls is corev1.Pod else HTTPResourceClient
+        return kind(self.base_url, self.scheme, cls, namespace,
+                    token=self.token,
+                    ssl_context=self.ssl_context,
+                    wire_hook=self.wire_hook,
+                    wire=self.wire,
+                    wire_state=self._wire_state,
+                    limiter=self.limiter,
+                    retry_budget=self.retry_budget,
+                    retry_429=self.retry_429,
+                    clock=self._clock, seed=self.seed)
 
     def __getattr__(self, name):
         """Convenience accessors (pods(), nodes(), ...) mirror Client's by

@@ -46,9 +46,13 @@ the same schedule, or the faults leaked into outcomes.
 
 from __future__ import annotations
 
+import json
 import random
+import threading
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
 
 from ..api.core import Node, NodeCondition, Pod
 from ..api.meta import ObjectMeta
@@ -90,6 +94,11 @@ _TEAR_ACTIONS = (("tear_wal", 0.04),)
 #: itself at renew_deadline and a standby takes over at lease expiry
 _HA_ACTIONS = (("kill_leader", 0.05), ("suppress_lease", 0.04),
                ("resume_lease", 0.06))
+
+#: appended when overload>0 — a burst tenant's client storm (N real
+#: threads hammering LIST/create at the hub) opens for a drawn number
+#: of ticks; overlapping storms extend the window
+_OVERLOAD_ACTIONS = (("client_storm", 0.16),)
 
 
 def informers_current(admin, factories, classes) -> bool:
@@ -245,6 +254,14 @@ class ChaosReport:
     #: per-class SLO report (slo=True): the SLOTracker's bind/startup
     #: percentiles for the "gang"/"solo" classes
     slo: dict = field(default_factory=dict)
+    #: client-storm accounting (overload>0). REAL-TIME racy by design
+    #: (storm threads race the driver), so these are excluded from the
+    #: same-seed determinism surface — events/store_state carry that.
+    storm_windows: int = 0
+    storm_requests: int = 0
+    storm_ok: int = 0
+    storm_rejected: int = 0
+    storm_errors: int = 0
     #: the semantic end state — sorted (resource, namespace, name,
     #: phase, bound) tuples; node choice and resourceVersions excluded.
     #: Comparable between a faulted and a fault-free run of one schedule.
@@ -279,7 +296,10 @@ class ChaosHarness:
                  autoscaler_cooldown: float = 60.0,
                  autoscaler_max_nodes: int = 64,
                  preempt_storm: bool = False,
-                 slo: bool = False):
+                 slo: bool = False,
+                 overload: int = 0,
+                 enable_storms: bool = True,
+                 apf: Optional[bool] = None):
         self.seed = seed
         #: jax.sharding.Mesh for the scheduler's drain (None = single
         #: device). The determinism contract must survive sharding: the
@@ -312,6 +332,32 @@ class ChaosHarness:
         #: preemption; flag-conditional draws keep flag-off schedules
         #: byte-identical to earlier PRs'
         self.preempt_storm = preempt_storm
+        #: overload drill (ISSUE 19): N real storm threads drive a burst
+        #: tenant's LIST/create traffic straight at the hub (requires
+        #: http=True), self-declared workload-low via the APF priority
+        #: hint. The storm rides a RAW HTTPClient — NOT the injector's
+        #: proxy, which would perturb per-signature attempt counters and
+        #: break the same-seed event-log identity; its outcome counters
+        #: (storm_ok/storm_rejected) are real-time racy by nature and
+        #: deliberately excluded from the determinism surface.
+        #: enable_storms=False keeps the identical schedule but executes
+        #: storms as noops — the storm-free baseline leg, like
+        #: enable_restarts for the restart actions. apf=None leaves the
+        #: hub on its KTPU_APF env default; True/False pins it.
+        self.overload = int(overload)
+        self.enable_storms = enable_storms
+        self.apf = apf
+        if self.overload and not http:
+            raise ValueError("overload drill needs http=True (the storm "
+                             "hammers the real hub over the wire)")
+        self._storm_until = -1
+        self._storm_threads: List = []
+        self._storm_gen = 0
+        self._storm_lock = threading.Lock()
+        self._storm_requests = 0
+        self._storm_ok = 0
+        self._storm_rejected = 0
+        self._storm_errors = 0
         self.clock = FakeClock()
         #: the WALL clock for settle/promote barriers (informer and
         #: follower threads pump in real time regardless of the virtual
@@ -346,8 +392,32 @@ class ChaosHarness:
             # own request counters — the scrape surface under test.
             from ..apiserver.server import APIServer
             from ..apiserver.httpclient import HTTPClient
+            srv_kwargs = {}
+            if self.apf is not None:
+                srv_kwargs["apf"] = self.apf
+            if self.overload:
+                # a hub SMALL enough for `overload` threads to saturate:
+                # tiny read/write pools, short fair queues so overflow
+                # 429s actually fire, a sub-second queue timeout so
+                # rejected storm threads turn around fast, and the run's
+                # seed as the shuffle-shard seed (reproducible hands)
+                srv_kwargs.update(
+                    max_nonmutating_inflight=6,
+                    max_mutating_inflight=2,
+                    flow_queue_length=2,
+                    flow_queue_timeout=0.25,
+                    flow_seed=seed,
+                    # system gets the FULL pool as its assured share
+                    # (the reference gives leader-election and node
+                    # heartbeats the highest assured concurrency): one
+                    # shared seat would serialize binds behind lease
+                    # renews and node status and charge every collision
+                    # a thread wakeup
+                    flow_shares={"system": 1.0, "workload-high": 0.3,
+                                 "workload-low": 0.2, "catch-all": 0.1})
             self._server = APIServer(
-                store=store, metrics=self._make_server_metrics()).start()
+                store=store, metrics=self._make_server_metrics(),
+                **srv_kwargs).start()
             self.client = ChaosHTTPClient(
                 self.injector,
                 HTTPClient(self._server.address,
@@ -699,6 +769,120 @@ class ChaosHarness:
                         f"holder was {holder!r}")
         return out
 
+    # ------------------------------------------------------ overload storm
+
+    def _storm_live(self) -> bool:
+        return self.injector.step < self._storm_until
+
+    def _ensure_storm_threads(self) -> None:
+        """(Re)spawn the burst tenant's worker pool for a storm window.
+        Workers die on their own once the window passes; a later
+        client_storm event spawns a fresh generation."""
+        self._storm_threads = [t for t in self._storm_threads
+                               if t.is_alive()]
+        if self._storm_threads:
+            return  # window extended; the live generation keeps going
+        self._storm_gen += 1
+        gen = self._storm_gen
+        for i in range(self.overload):
+            t = threading.Thread(target=self._storm_worker,
+                                 args=(gen, i), daemon=True,
+                                 name=f"storm-{gen}-{i}")
+            t.start()
+            self._storm_threads.append(t)
+
+    def _storm_worker(self, gen: int, idx: int) -> None:
+        """One burst-tenant client: alternately LIST the default
+        namespace's pods (the dashboard-hammering read) and create
+        ConfigMaps in the "abuse" namespace (the bulk-write side),
+        self-declared workload-low via the APF priority hint. ConfigMaps
+        on purpose: they are invisible to the informers, controllers,
+        and store_state, so the storm's writes cannot perturb scheduling
+        outcomes — only contend for hub capacity. A ~1ms think time per
+        request stands in for client-side RTT: without it the workers
+        busy-spin the GIL and the bench measures interpreter scheduling,
+        not hub overload (the offered load still far exceeds the 2-slot
+        write pool)."""
+        from ..apiserver.flowcontrol import PRIORITY_HINT_HEADER
+        base = self._server.address
+        hint = {PRIORITY_HINT_HEADER: "workload-low"}
+        n = 0
+        while self._storm_live():
+            n += 1
+            self.wall_clock.sleep(0.001)
+            try:
+                if n % 2:
+                    req = urlrequest.Request(
+                        f"{base}/api/v1/namespaces/default/pods",
+                        headers=dict(hint))
+                else:
+                    body = json.dumps({
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {
+                            "name": f"storm-g{gen}-t{idx}-{n}",
+                            "namespace": "abuse"},
+                        "data": {"k": "v" * 64}}).encode()
+                    req = urlrequest.Request(
+                        f"{base}/api/v1/namespaces/abuse/configmaps",
+                        data=body, method="POST",
+                        headers={"Content-Type": "application/json",
+                                 **hint})
+                with urlrequest.urlopen(req, timeout=5) as resp:
+                    resp.read()
+                with self._storm_lock:
+                    self._storm_requests += 1
+                    self._storm_ok += 1
+            except urlerror.HTTPError as e:
+                try:
+                    e.read()  # drain the error body, as a real client would
+                except OSError:
+                    pass
+                with self._storm_lock:
+                    self._storm_requests += 1
+                    if e.code == 429:
+                        self._storm_rejected += 1
+                    else:
+                        self._storm_errors += 1
+            except Exception:
+                with self._storm_lock:
+                    self._storm_requests += 1
+                    self._storm_errors += 1
+
+    def _stop_storms(self) -> None:
+        self._storm_until = -1
+        for t in self._storm_threads:
+            t.join(timeout=10)
+        self._storm_threads = []
+
+    def check_overload(self) -> List[str]:
+        """The overload drill's invariants, valid for an APF-on run
+        whose only scheduled faults are client storms: the system flow's
+        isolation must keep leader leases entirely healthy. Any
+        leader_deposed event is a spurious self-fence (nobody killed a
+        leader), any leader_failover a spurious failover, and any slow
+        renew means a lease write sat behind tenant traffic past half
+        its renew deadline. check_ha_binds covers double-binds."""
+        out: List[str] = []
+        deposed = [ev for ev in self.injector.events
+                   if ev[1] == "leader_deposed"]
+        if deposed:
+            out.append(f"overload-spurious-fence: {len(deposed)} "
+                       f"leader_deposed under client storm "
+                       f"(first: {deposed[0]})")
+        failovers = [ev for ev in self.injector.events
+                     if ev[1] == "leader_failover"]
+        if failovers:
+            out.append(f"overload-spurious-failover: {len(failovers)} "
+                       f"failover(s) under client storm")
+        slow = sum(
+            self.metrics.slow_renews.value(name=e)
+            for e in ("kube-scheduler", "kube-controller-manager"))
+        if slow:
+            out.append(f"overload-starved-renew: {int(slow)} lease "
+                       f"renew(s) landed past half the renew deadline "
+                       f"under client storm")
+        return out
+
     # ------------------------------------------------------------- setup
 
     def _slice_of(self, i: int) -> str:
@@ -709,6 +893,18 @@ class ChaosHarness:
             return
         for i in range(self.n_nodes):
             self._register_node(i)
+        if self.overload:
+            # the burst tenant's namespace, labeled so the hub's flow
+            # key resolves to the tenant ("burst"), not the namespace
+            from ..api.core import Namespace
+            from ..state.store import AlreadyExistsError
+            from ..tenancy import TENANT_LABEL
+            try:
+                self.admin.namespaces().create(Namespace(
+                    metadata=ObjectMeta(name="abuse",
+                                        labels={TENANT_LABEL: "burst"})))
+            except AlreadyExistsError:
+                pass  # WAL replay already restored it
         if self._replica is not None and self._read_client is not None:
             # replica reads: the follower must finish its initial sync
             # BEFORE informers list through the standby hub, or their
@@ -737,6 +933,7 @@ class ChaosHarness:
         self.admin.nodes().create(node)
 
     def close(self) -> None:
+        self._stop_storms()
         for fac in self._factories():
             fac.stop()
         if self._read_server is not None:
@@ -998,6 +1195,8 @@ class ChaosHarness:
             table = table + _TEAR_ACTIONS
         if self.ha:
             table = table + _HA_ACTIONS
+        if self.overload:
+            table = table + _OVERLOAD_ACTIONS
         names = [a for a, _ in table]
         weights = [w for _, w in table]
         out = []
@@ -1018,6 +1217,8 @@ class ChaosHarness:
                                              "kube-controller-manager"))
             if self.preempt_storm:
                 ev["priority"] = rng.choice((0, 10, 100, 1000))
+            if self.overload:
+                ev["storm_ticks"] = rng.randint(2, 4)
             out.append(ev)
         return out
 
@@ -1039,6 +1240,7 @@ class ChaosHarness:
         # quiesce: faults stop, dead nodes STAY dead — eviction timeouts,
         # permit rollbacks, and resubmissions must now converge on their
         # own; the invariants are checked against this settled state
+        self._stop_storms()
         self.injector.error_rate = 0.0
         if self.injector.partitioned:
             self.injector.partition(False)
@@ -1068,6 +1270,17 @@ class ChaosHarness:
             report.failovers = [
                 (ev[2], ev[3]) for ev in self.injector.events
                 if ev[1] == "leader_failover"]
+        if (self.overload and self.ha and self.enable_storms
+                and not self.enable_restarts
+                and self._base_error_rate == 0.0
+                and self._server is not None and self._server.apf):
+            # the strict overload invariants hold only when client
+            # storms are the ONLY fault in play (restarts off, no
+            # injected API errors — an injected lease-patch failure
+            # causes a legitimate slow renew) and APF is actually on —
+            # the KTPU_APF=0 control leg is EXPECTED to starve and must
+            # not be flagged
+            report.violations += self.check_overload()
         report.violations += self._promote_violations
         if self._replica is not None and not self._promoted:
             # the quiesced primary is static: the follower must converge
@@ -1085,6 +1298,11 @@ class ChaosHarness:
             report.replication_reconnects = self._replica.reconnects
         if self.slo is not None:
             report.slo = self.slo.report()
+        with self._storm_lock:
+            report.storm_requests = self._storm_requests
+            report.storm_ok = self._storm_ok
+            report.storm_rejected = self._storm_rejected
+            report.storm_errors = self._storm_errors
         report.fault_counts = dict(self.injector.fault_counts)
         report.promoted = self._promoted
         report.orphans_gced = self._orphans_gced
@@ -1153,7 +1371,12 @@ class ChaosHarness:
                 self.injector.record("delete_node", node)
                 report.nodes_deleted += 1
         elif action == "partition":
-            if not self.injector.partitioned:
+            # overload drills keep the client storm as the ONLY fault: a
+            # scheduled write partition would fence leaders on its own
+            # and confound the starved-renew attribution (the schedule
+            # keeps the partition events so flag-off runs stay
+            # byte-identical; they just don't fire)
+            if not self.injector.partitioned and not self.overload:
                 self.injector.partition(True)
         elif action == "heal":
             if self.injector.partitioned:
@@ -1189,6 +1412,17 @@ class ChaosHarness:
         elif action == "resume_lease":
             if self.ha and self.injector.lease_suppressed:
                 self.injector.suppress_lease(False)
+        elif action == "client_storm":
+            # gated like the restart actions: the storm-free baseline
+            # (enable_storms=False) keeps the identical schedule but
+            # never opens a storm window
+            if self.overload and self.enable_storms:
+                self._storm_until = max(
+                    self._storm_until,
+                    self.injector.step + ev["storm_ticks"])
+                self.injector.record("client_storm", ev["storm_ticks"])
+                report.storm_windows += 1
+                self._ensure_storm_threads()
 
     def _node_exists(self, name: str) -> bool:
         try:

@@ -90,6 +90,13 @@ def retry(fn: Callable[[], object], *,
             last = e
             if metrics is not None:
                 metrics.api_retries.inc(component=component, op=op)
+            # a server-supplied hint (TooManyRequestsError carries the
+            # parsed Retry-After) is a FLOOR under the backoff delay:
+            # retrying sooner than the server asked just re-joins the
+            # overload it was shed from
+            ra = getattr(e, "retry_after", None)
+            if ra:
+                delay = max(delay, float(ra))
             clock.sleep(delay)
     try:
         return fn()

@@ -197,18 +197,24 @@ class Histogram(_Metric):
             self._series.clear()
 
     def quantile(self, q: float, **labels) -> float:
-        """Approximate quantile from bucket upper bounds (scrape-side
-        histogram_quantile equivalent, for tests and bench reporting)."""
+        """Approximate quantile with linear interpolation inside the
+        owning bucket (scrape-side histogram_quantile equivalent, for
+        tests and bench reporting). Interpolation matters when callers
+        RATIO two quantiles: power-of-two buckets would otherwise
+        quantize every ratio to a power of two."""
         with self._lock:
             s = self._series.get(_label_key(labels))
             if not s or s[2] == 0:
                 return 0.0
             target = q * s[2]
             acc = 0
+            lower = 0.0
             for i, c in enumerate(s[0][:-1]):
+                if c > 0 and acc + c >= target:
+                    frac = (target - acc) / c
+                    return lower + (self.buckets[i] - lower) * frac
                 acc += c
-                if acc >= target:
-                    return self.buckets[i]
+                lower = self.buckets[i]
             return float("inf")
 
     def snapshot(self) -> Dict[Tuple, Tuple[list, float, int]]:
@@ -519,6 +525,33 @@ class APIServerMetrics:
             "apiserver_watch_frame_cache_hits_total",
             "Watch frames reused from the shared per-event byte cache, "
             "by encoding")
+
+
+class FlowControlMetrics:
+    """API Priority & Fairness families (ref: apiserver_flowcontrol_*
+    — dispatched/rejected counts and queue-wait by priority level),
+    registered on the hub's /metrics beside the request families."""
+
+    def __init__(self, registry: Optional["Registry"] = None):
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        #: requests handed a seat (immediately or after queueing)
+        self.dispatched = r.counter(
+            "flowcontrol_dispatched_total",
+            "Requests dispatched to a seat, by priority level")
+        #: requests that had to queue before dispatch
+        self.queued = r.counter(
+            "flowcontrol_queued_total",
+            "Requests that entered a fair queue, by priority level")
+        #: requests shed with 429 (queue overflow or queue timeout)
+        self.rejected = r.counter(
+            "flowcontrol_rejected_total",
+            "Requests rejected by flow control, by priority level "
+            "and reason")
+        #: time spent parked in a fair queue before dispatch
+        self.queue_wait = r.histogram(
+            "flowcontrol_queue_wait_seconds",
+            "Fair-queue wait before dispatch, by priority level")
 
 
 class Registry:
