@@ -2,7 +2,7 @@
 
 .PHONY: lint lint-changed test test-tier1 bench-sharded bench-affinity \
 	bench-preempt bench-tenancy bench-resilience bench-wire \
-	bench-overload
+	bench-overload bench-speculative
 
 # full contract lint (tools/ktpulint; exit 1 on findings)
 lint:
@@ -68,3 +68,13 @@ bench-wire:
 bench-overload:
 	JAX_PLATFORMS=cpu python bench.py overload > BENCH_r13.json
 	@tail -c 400 BENCH_r13.json; echo
+
+# speculative-cohort bench: the BENCH_r14 round — cohort assignment
+# (KTPU_SPECULATIVE=1) vs the serial class scan at the cohort-friendly
+# 2k x 1k and the 50k x 5k wire shapes on uniform/anti-affinity/spread
+# mixes: scan-only + end-to-end speedups, per-variant bind parity,
+# collision/repair rates, cohort-width distribution.
+# Publishes BENCH_r14.json.
+bench-speculative:
+	JAX_PLATFORMS=cpu python bench.py speculative > BENCH_r14.json
+	@tail -c 400 BENCH_r14.json; echo

@@ -127,6 +127,15 @@ class PendingBatch:
     #: see schedule_launch's carry-chaining gate
     spread_sig: Optional[Tuple] = None
     soft_sig: Optional[Tuple] = None
+    #: [P/K, 2] int32 device handle of per-cohort (accepted,
+    #: first_collision) stats when this batch ran the speculative cohort
+    #: kernel (kernels/speculative.py); schedule_finish folds it into the
+    #: scheduler_speculative_* counters
+    spec_stats: object = None
+    #: (node_cfg, usage, dev_batch, nom) captured for the divergence
+    #: oracle (KTPU_SPEC_ORACLE=1): schedule_finish replays the serial
+    #: scan on the identical inputs and attributes any mismatch
+    spec_inputs: object = None
 
 
 class _RepairReassigner:
@@ -341,6 +350,25 @@ class BatchScheduler:
         #: kernel — the parity control for the class-indexed fast path
         #: (bench.py affinity measures class-scan vs classic with it)
         self.class_scan = _os.environ.get("KTPU_CLASS_SCAN", "1") != "0"
+        #: KTPU_SPECULATIVE=1 routes unsharded class-table batches to the
+        #: speculative cohort kernel (kernels/speculative.py): vmapped
+        #: cohort proposals with exact collision detection and serial
+        #: whole-cohort repair — decisions stay bit-identical to the
+        #: serial class scan (default off; Scheduler(speculative=True)
+        #: sets it too)
+        self.speculative = _os.environ.get("KTPU_SPECULATIVE", "0") != "0"
+        #: KTPU_SPEC_ORACLE=1 replays EVERY speculative batch through the
+        #: serial scan and counts/attributes mismatches (the divergence
+        #: oracle — a measurement harness, not a production mode)
+        self.spec_oracle = _os.environ.get("KTPU_SPEC_ORACLE", "0") != "0"
+        #: bounded attribution log of oracle divergences (newest last);
+        #: expected empty — each entry is a per-pod dict from
+        #: kernels.speculative.divergence_report
+        from collections import deque as _deque
+        self.spec_divergence_log = _deque(maxlen=64)
+        #: per-batch (cohort_width, n_cohorts, n_collided, repaired_pods)
+        #: records — the bench's cohort-size distribution source
+        self.spec_batch_log = _deque(maxlen=256)
         #: KTPU_PREEMPT_KERNEL=0 pins preemption to the serial per-node
         #: victim search (preemption.py) — the measured control for the
         #: batched victim-pricing kernel (kernels/preempt.py)
@@ -1599,6 +1627,8 @@ class BatchScheduler:
         else:
             node_cfg, usage = self.mirror.device_cfg_usage()
         sharded = False
+        spec_stats = None
+        spec_inputs = None
         if gang_units is not None:
             from .kernels.gang import gang_schedule_batch
             assign_d, scores_d, new_usage = gang_schedule_batch(
@@ -1617,6 +1647,37 @@ class BatchScheduler:
             assign_d, scores_d, new_usage = schedule_batch_sharded(
                 self.mirror.mesh, node_cfg, usage,
                 batch.device(self.mirror.mesh), nom_dev)
+        elif self.speculative and batch._class_tables is not None:
+            # speculative cohort assignment (kernels/speculative.py):
+            # vmapped K-pod cohort proposals against the frozen class
+            # table, exact collision detection, serial whole-cohort
+            # repair — bit-identical decisions to the serial scan, with
+            # per-cohort stats folded into metrics by schedule_finish
+            from .kernels.speculative import (_SPEC_MIN_PLAIN,
+                                              cohort_width,
+                                              schedule_batch_speculative)
+            w = cohort_width(batch.req.shape[0])
+            batch.set_speculative(w)
+            # contention gate: a batch that is mostly non-plain trips
+            # the structural fence on (nearly) every cohort, so the
+            # election + exact collision checks are pure overhead —
+            # measured over the ACTIVE prefix (pads are trivially plain
+            # and would inflate the fraction)
+            frac = (float(batch.spec_plain[:len(pods)].mean())
+                    if pods else 0.0)
+            if frac < _SPEC_MIN_PLAIN:
+                batch.spec_plain = None
+                batch.cohort_id = None
+                assign_d, scores_d, new_usage = schedule_batch(
+                    node_cfg, usage, batch.device(self.mirror.mesh),
+                    nom_dev)
+            else:
+                dev = batch.device(self.mirror.mesh)
+                assign_d, scores_d, new_usage, spec_stats = \
+                    schedule_batch_speculative(node_cfg, usage, dev,
+                                               nom_dev, width=w)
+                if self.spec_oracle:
+                    spec_inputs = (node_cfg, usage, dev, nom_dev)
         else:
             assign_d, scores_d, new_usage = schedule_batch(
                 node_cfg, usage, batch.device(self.mirror.mesh), nom_dev)
@@ -1635,6 +1696,8 @@ class BatchScheduler:
                             usage_epoch=self.mirror.usage_epoch,
                             gang_units=gang_units,
                             spread_sig=spread_sig, soft_sig=soft_sig,
+                            spec_stats=spec_stats,
+                            spec_inputs=spec_inputs,
                             inscan_cover=(affinity_chainable
                                           and topo_cover != "fallback"))
 
@@ -1673,6 +1736,41 @@ class BatchScheduler:
             batch.soft_base = chain.batch.soft_base
         return True
 
+    def _account_speculative(self, pending: "PendingBatch",
+                             assign) -> None:
+        """Fold a speculative batch's per-cohort stats into the
+        scheduler_speculative_* counters and, under the divergence
+        oracle, replay the serial scan on the captured inputs and
+        attribute any mismatch (expected: none — the kernel's contract
+        is bit-identity, and the counter existing is how production
+        proves it rather than assumes it)."""
+        import numpy as np
+        st = np.asarray(pending.spec_stats)          # [n, 2]
+        n = st.shape[0]
+        width = pending.batch.req.shape[0] // max(n, 1)
+        collided = st[:, 0] == 0
+        repaired = int((width - st[collided, 1]).sum())
+        m = self.sched_metrics
+        if m is not None:
+            m.speculative_cohorts.inc(n)
+            m.speculative_collisions.inc(int(collided.sum()))
+            m.speculative_repaired.inc(repaired)
+        # per-batch record for the bench's cohort-size distribution
+        # (counters aggregate across batches; the log keeps the widths)
+        self.spec_batch_log.append(
+            (int(width), int(n), int(collided.sum()), repaired))
+        if pending.spec_inputs is not None:
+            from .kernels.speculative import (divergence_report,
+                                              speculative_reference)
+            node_cfg, usage, dev, nom_dev = pending.spec_inputs
+            ref_assign, _ = speculative_reference(node_cfg, usage, dev,
+                                                  nom_dev)
+            report = divergence_report(assign, ref_assign, width)
+            if report:
+                if m is not None:
+                    m.speculative_divergences.inc(len(report))
+                self.spec_divergence_log.extend(report)
+
     def schedule_finish(self, pending: "PendingBatch") -> List[ScheduleResult]:
         """Back half: fetch results, host repair, adopt chained usage."""
         import time as _time
@@ -1691,6 +1789,8 @@ class BatchScheduler:
         if tr is not None:
             tr.record("scheduler", "scan_wait", t_sw, tr.now(),
                       pods=len(pending.pods))
+        if pending.spec_stats is not None:
+            self._account_speculative(pending, assign)
         out: List[ScheduleResult] = []
         for i, pod in enumerate(pending.pods):
             row = int(assign[i])

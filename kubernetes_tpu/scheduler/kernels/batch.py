@@ -67,6 +67,14 @@ NEG = jnp.float32(-1e30)
 import os as _os
 _STEP_GROUP = int(_os.environ.get("KTPU_SCAN_GROUP", "8"))
 _STEP_GROUP_TOPO = int(_os.environ.get("KTPU_SCAN_GROUP_TOPO", "1"))
+#: sharded scan: pack (score, global row) into ONE int64 key so the
+#: cross-shard winner election is a single pmax instead of the
+#: pmax(score)+pmin(row) pair — halves the per-pod collective count on
+#: the latency-bound scan. Requires jax_enable_x64 (the key is int64);
+#: with x64 off the knob is inert and the two-collective path runs.
+#: Bit-identical winners either way (the key order is exactly
+#: lexicographic (score, -row) — see the packed branch in one_pod).
+_X64_ARGMAX = _os.environ.get("KTPU_X64_ARGMAX", "0") != "0"
 
 # column layout (keep in sync with tensorize.py)
 COL_CPU = 0
@@ -441,6 +449,150 @@ def _nom_feas_usage(usage: dict, nom: dict) -> dict:
             "pod_count": usage["pod_count"] + nom["count"]}
 
 
+def _class_ctx(node_cfg: dict, usage: dict, pod_batch: dict, nom: dict):
+    """Shared setup for the class-indexed kernels: split the batch,
+    resolve the optional term tables, build the [C, N] masked-score
+    table and the initial carry. ONE copy for the serial scan below and
+    the speculative cohort kernel (kernels/speculative.py) — the
+    speculative kernel's serial-replay branch runs _class_pod_step
+    against this exact carry layout, so its decisions cannot diverge
+    from _schedule_batch_classes. Returns (ctx, carry0, per_pod)."""
+    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
+    N = node_cfg["alloc"].shape[0]
+    cls = {k: pod_batch[k] for k in ("class_req", "class_nz",
+                                     "class_blocked", "class_mask_idx",
+                                     "class_score_idx")}
+    anti_dom = pod_batch.get("anti_dom")
+    has_topo = anti_dom is not None
+    has_dir2 = has_topo and "cmatch_tids" in pod_batch
+    has_spread = pod_batch.get("spread_base") is not None
+    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
+    zoh = _zone_onehot(zone_of, zinit)
+    soft = _soft_tables(pod_batch)
+    has_soft = soft is not None
+    has_nom = nom is not None
+    ms0 = _class_ms_init(node_cfg,
+                         _nom_feas_usage(usage, nom) if has_nom else usage,
+                         cls, unique_masks, unique_scores, rw)
+    ctx = {"node_cfg": node_cfg, "cls": cls, "unique_masks": unique_masks,
+           "unique_scores": unique_scores, "rw": rw,
+           "rows": jnp.arange(N, dtype=jnp.int32), "N": N,
+           "anti_dom": anti_dom, "has_topo": has_topo,
+           "has_dir2": has_dir2, "has_spread": has_spread,
+           "spread_w": spread_w, "zone_of": zone_of, "zinit": zinit,
+           "zoh": zoh, "soft": soft, "has_soft": has_soft,
+           "has_nom": has_nom, "nom": nom}
+    carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
+              "pod_count": usage["pod_count"], "ms": ms0}
+    if has_topo:
+        carry0["topo_cnt"] = pod_batch["anti_cnt0"]
+        carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
+        if has_dir2:
+            carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
+    if has_spread:
+        # chained launches seed the spread/soft carries from the
+        # predecessor's finals (same contract as the classic path)
+        sp0 = usage.get("spread")
+        carry0["spread"] = sp0 if sp0 is not None else spread_base
+    if has_soft:
+        sc0 = usage.get("soft_cnt")
+        carry0["soft_cnt"] = sc0 if sc0 is not None else soft[1]
+    return ctx, carry0, per_pod
+
+
+def _class_pod_step(ctx, carry, pod):
+    """One pod's serial class-scan step: gather its class's masked-score
+    row, apply the carry-dependent terms, argmax, scatter the winner's
+    usage and refresh the winner's COLUMN across all classes. Shared by
+    _schedule_batch_classes and the speculative kernel's repair branch
+    (bit-identity contract, like _topo_bad/_topo_scatter)."""
+    node_cfg = ctx["node_cfg"]
+    cls = ctx["cls"]
+    unique_masks, unique_scores = ctx["unique_masks"], ctx["unique_scores"]
+    rw, rows, N = ctx["rw"], ctx["rows"], ctx["N"]
+    nom = ctx["nom"]
+    u = pod["class_idx"]
+    base = carry["ms"][u]                                      # [N]
+    if ctx["has_nom"]:
+        # self-exemption: the pod's own nominated row is recomputed
+        # with eff = (used + nom) - own req / count - 1 — the same
+        # f32 op order as the classic kernel's self_oh subtraction
+        r = pod.get("nom_row", jnp.int32(-1))
+        rc = jnp.clip(r, 0, N - 1)
+        corr = _class_col(
+            node_cfg, cls, unique_masks, unique_scores, rw,
+            carry["used"][rc] + nom["used"][rc] - cls["class_req"][u],
+            carry["nz_used"][rc],
+            carry["pod_count"][rc] + nom["count"][rc] - 1.0, rc)[u]
+        base = jnp.where((r >= 0) & (rows == r), corr, base)
+    fits = base > _NEG_THRESHOLD
+    if ctx["has_topo"]:
+        # both (anti-)affinity directions + waived co-location, from
+        # the running counters (_topo_bad — shared with the classic
+        # kernel so the mask arithmetic can't diverge)
+        fits = fits & ~_topo_bad(ctx["anti_dom"], carry, pod,
+                                 ctx["has_dir2"])
+    score = base
+    if ctx["has_soft"]:
+        soft_dom, _, soft_base, soft_w = ctx["soft"]
+        raw = _soft_raw(soft_dom, carry["soft_cnt"], soft_base, pod)
+        score = score + jnp.where(pod["soft_base_idx"] >= 0,
+                                  _soft_score(raw, fits, soft_w), 0.0)
+    if ctx["has_spread"]:
+        g = pod.get("spread_gidx", jnp.int32(-1))
+        use_spread = jnp.where(g >= 0, 1.0, 0.0)
+        score = score + ctx["spread_w"] * use_spread * _spread_score(
+            carry["spread"][jnp.maximum(g, 0)], fits, ctx["zone_of"],
+            ctx["zinit"], ctx["zoh"])
+    masked = jnp.where(fits, score, NEG)
+    best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
+        .astype(jnp.int32)
+    chosen = masked[best]
+    ok = (chosen > _NEG_THRESHOLD) & pod["active"]
+    ok_f = jnp.where(ok, 1.0, 0.0)
+    used = carry["used"].at[best].add(ok_f * cls["class_req"][u])
+    nz_used = carry["nz_used"].at[best].add(ok_f * cls["class_nz"][u])
+    pod_count = carry["pod_count"].at[best].add(ok_f)
+    if ctx["has_nom"]:
+        col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                         rw, used[best] + nom["used"][best],
+                         nz_used[best],
+                         pod_count[best] + nom["count"][best], best)
+    else:
+        col = _class_col(node_cfg, cls, unique_masks, unique_scores,
+                         rw, used[best], nz_used[best],
+                         pod_count[best], best)
+    out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
+           "ms": carry["ms"].at[:, best].set(col)}
+    if ctx["has_spread"]:
+        sm = pod.get("spread_match")
+        if sm is None:
+            sm = jnp.zeros((carry["spread"].shape[0],), jnp.float32)
+        out["spread"] = carry["spread"].at[:, best].add(sm * ok_f)
+    if ctx["has_topo"]:
+        out.update(_topo_scatter(ctx["anti_dom"], carry, pod, best, ok,
+                                 ctx["has_dir2"]))
+    if ctx["has_soft"]:
+        soft_dom = ctx["soft"][0]
+        out["soft_cnt"] = _soft_write(soft_dom, carry["soft_cnt"],
+                                      pod, best, ok)
+    assign = jnp.where(ok, best, jnp.int32(-1))
+    return out, (assign, chosen)
+
+
+def _class_usage_out(ctx, final) -> dict:
+    """The post-batch usage dict from a class-scan carry final (spread/
+    soft carry finals ride along for the next chained launch)."""
+    new_usage = {"used": final["used"],
+                 "nonzero_used": final["nz_used"],
+                 "pod_count": final["pod_count"]}
+    if ctx["has_spread"]:
+        new_usage["spread"] = final["spread"]
+    if ctx["has_soft"]:
+        new_usage["soft_cnt"] = final["soft_cnt"]
+    return new_usage
+
+
 def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict,
                             nom: dict = None):
     """The class-indexed incremental scan: pods sharing a (template,
@@ -470,107 +622,12 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict,
     A chained launch seeds the spread/soft carries from the predecessor's
     finals (usage["spread"] / usage["soft_cnt"], riding the same device
     handle as the chained usage — core.schedule_launch gates this on the
-    anchor's base tables still applying)."""
-    per_pod, unique_masks, unique_scores, rw = _split_batch(pod_batch)
-    N = node_cfg["alloc"].shape[0]
-    cls = {k: pod_batch[k] for k in ("class_req", "class_nz",
-                                     "class_blocked", "class_mask_idx",
-                                     "class_score_idx")}
-    anti_dom = pod_batch.get("anti_dom")
-    has_topo = anti_dom is not None
-    has_dir2 = has_topo and "cmatch_tids" in pod_batch
-    has_spread = pod_batch.get("spread_base") is not None
-    spread_base, zone_of, zinit, spread_w = _spread_tables(pod_batch, N)
-    zoh = _zone_onehot(zone_of, zinit)
-    soft = _soft_tables(pod_batch)
-    has_soft = soft is not None
-    if has_soft:
-        soft_dom, soft_cnt0, soft_base, soft_w = soft
-    has_nom = nom is not None
-    rows = jnp.arange(N, dtype=jnp.int32)
-    ms0 = _class_ms_init(node_cfg,
-                         _nom_feas_usage(usage, nom) if has_nom else usage,
-                         cls, unique_masks, unique_scores, rw)
+    anchor's base tables still applying).
 
-    def one_pod(carry, pod):
-        u = pod["class_idx"]
-        base = carry["ms"][u]                                      # [N]
-        if has_nom:
-            # self-exemption: the pod's own nominated row is recomputed
-            # with eff = (used + nom) - own req / count - 1 — the same
-            # f32 op order as the classic kernel's self_oh subtraction
-            r = pod.get("nom_row", jnp.int32(-1))
-            rc = jnp.clip(r, 0, N - 1)
-            corr = _class_col(
-                node_cfg, cls, unique_masks, unique_scores, rw,
-                carry["used"][rc] + nom["used"][rc] - cls["class_req"][u],
-                carry["nz_used"][rc],
-                carry["pod_count"][rc] + nom["count"][rc] - 1.0, rc)[u]
-            base = jnp.where((r >= 0) & (rows == r), corr, base)
-        fits = base > _NEG_THRESHOLD
-        if has_topo:
-            # both (anti-)affinity directions + waived co-location, from
-            # the running counters (_topo_bad — shared with the classic
-            # kernel so the mask arithmetic can't diverge)
-            fits = fits & ~_topo_bad(anti_dom, carry, pod, has_dir2)
-        score = base
-        if has_soft:
-            raw = _soft_raw(soft_dom, carry["soft_cnt"], soft_base, pod)
-            score = score + jnp.where(pod["soft_base_idx"] >= 0,
-                                      _soft_score(raw, fits, soft_w), 0.0)
-        if has_spread:
-            g = pod.get("spread_gidx", jnp.int32(-1))
-            use_spread = jnp.where(g >= 0, 1.0, 0.0)
-            score = score + spread_w * use_spread * _spread_score(
-                carry["spread"][jnp.maximum(g, 0)], fits, zone_of, zinit,
-                zoh)
-        masked = jnp.where(fits, score, NEG)
-        best = jnp.argmax(_tie_penalized(masked, rows, pod["seq"])) \
-            .astype(jnp.int32)
-        chosen = masked[best]
-        ok = (chosen > _NEG_THRESHOLD) & pod["active"]
-        ok_f = jnp.where(ok, 1.0, 0.0)
-        used = carry["used"].at[best].add(ok_f * cls["class_req"][u])
-        nz_used = carry["nz_used"].at[best].add(ok_f * cls["class_nz"][u])
-        pod_count = carry["pod_count"].at[best].add(ok_f)
-        if has_nom:
-            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
-                             rw, used[best] + nom["used"][best],
-                             nz_used[best],
-                             pod_count[best] + nom["count"][best], best)
-        else:
-            col = _class_col(node_cfg, cls, unique_masks, unique_scores,
-                             rw, used[best], nz_used[best],
-                             pod_count[best], best)
-        out = {"used": used, "nz_used": nz_used, "pod_count": pod_count,
-               "ms": carry["ms"].at[:, best].set(col)}
-        if has_spread:
-            sm = pod.get("spread_match")
-            if sm is None:
-                sm = jnp.zeros((carry["spread"].shape[0],), jnp.float32)
-            out["spread"] = carry["spread"].at[:, best].add(sm * ok_f)
-        if has_topo:
-            out.update(_topo_scatter(anti_dom, carry, pod, best, ok,
-                                     has_dir2))
-        if has_soft:
-            out["soft_cnt"] = _soft_write(soft_dom, carry["soft_cnt"],
-                                          pod, best, ok)
-        assign = jnp.where(ok, best, jnp.int32(-1))
-        return out, (assign, chosen)
-
-    carry0 = {"used": usage["used"], "nz_used": usage["nonzero_used"],
-              "pod_count": usage["pod_count"], "ms": ms0}
-    if has_topo:
-        carry0["topo_cnt"] = pod_batch["anti_cnt0"]
-        carry0["topo_tot"] = jnp.zeros((anti_dom.shape[0],), jnp.float32)
-        if has_dir2:
-            carry0["topo_carry"] = jnp.zeros_like(pod_batch["anti_cnt0"])
-    if has_spread:
-        sp0 = usage.get("spread")
-        carry0["spread"] = sp0 if sp0 is not None else spread_base
-    if has_soft:
-        sc0 = usage.get("soft_cnt")
-        carry0["soft_cnt"] = sc0 if sc0 is not None else soft_cnt0
+    The per-pod step lives in _class_pod_step and the setup in
+    _class_ctx, both shared with the speculative cohort kernel
+    (kernels/speculative.py) so the two paths cannot drift."""
+    ctx, carry0, per_pod = _class_ctx(node_cfg, usage, pod_batch, nom)
     P = per_pod["seq"].shape[0]
     want = max(1, _STEP_GROUP)
     G = min(1 << (want.bit_length() - 1), P)
@@ -579,7 +636,7 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict,
         outs = []
         for g in range(G):
             pod = {k: v[g] for k, v in podg.items()}
-            carry, out = one_pod(carry, pod)
+            carry, out = _class_pod_step(ctx, carry, pod)
             outs.append(out)
         return carry, (jnp.stack([o[0] for o in outs]),
                        jnp.stack([o[1] for o in outs]))
@@ -587,14 +644,8 @@ def _schedule_batch_classes(node_cfg: dict, usage: dict, pod_batch: dict,
     per_pod_g = {k: v.reshape((P // G, G) + v.shape[1:])
                  for k, v in per_pod.items()}
     final, (assign_g, scores_g) = lax.scan(step, carry0, per_pod_g)
-    new_usage = {"used": final["used"],
-                 "nonzero_used": final["nz_used"],
-                 "pod_count": final["pod_count"]}
-    if has_spread:
-        new_usage["spread"] = final["spread"]
-    if has_soft:
-        new_usage["soft_cnt"] = final["soft_cnt"]
-    return assign_g.reshape(P), scores_g.reshape(P), new_usage
+    return assign_g.reshape(P), scores_g.reshape(P), \
+        _class_usage_out(ctx, final)
 
 
 @jax.jit
@@ -914,9 +965,31 @@ def _sharded_class_scan(node_cfg: dict, usage: dict, pod_batch: dict,
         penalized = _tie_penalized(masked, rows_g, pod["seq"])
         lmax = jnp.max(penalized)
         lbest = jnp.argmax(penalized).astype(jnp.int32)  # first max, local
-        gmax = lax.pmax(lmax, NODE_AXIS)
-        best = lax.pmin(jnp.where(lmax == gmax, offset + lbest, _INT32_MAX),
-                        NODE_AXIS)
+        if _X64_ARGMAX and jax.config.jax_enable_x64:
+            # single-collective winner election: key = (mono(score) -
+            # 2^31) * 2^32 + (INT32_MAX - row). mono() is the standard
+            # sign-flip map of the f32 bit pattern into [0, 2^32) that
+            # preserves float order (negatives reverse-complemented,
+            # positives offset past them), so pmax(key) picks the max
+            # score and, among bit-equal scores, the MIN global row —
+            # exactly the pmax+pmin pair's answer. -0.0 is canonicalized
+            # first: it is ==0.0 to the comparison path but bit-distinct,
+            # the one case where bit order and float order disagree.
+            zmax = jnp.where(lmax == 0.0, jnp.float32(0.0), lmax)
+            b = lax.bitcast_convert_type(zmax, jnp.int32).astype(jnp.int64)
+            mono = jnp.where(b >= 0, b + jnp.int64(0x80000000),
+                             jnp.int64(-1) - b)
+            row_key = (jnp.int64(2147483647)
+                       - (offset + lbest).astype(jnp.int64))
+            key = ((mono - jnp.int64(0x80000000)) * jnp.int64(1 << 32)
+                   + row_key)
+            gkey = lax.pmax(key, NODE_AXIS)
+            best = (jnp.int64(2147483647)
+                    - (gkey % jnp.int64(1 << 32))).astype(jnp.int32)
+        else:
+            gmax = lax.pmax(lmax, NODE_AXIS)
+            best = lax.pmin(jnp.where(lmax == gmax, offset + lbest,
+                                      _INT32_MAX), NODE_AXIS)
         lb = best - offset
         owner = (lb >= 0) & (lb < Nl)
         lbc = jnp.clip(lb, 0, Nl - 1)

@@ -44,6 +44,13 @@ MIN_ADAPTIVE_BATCH = 64
 #: bulk-bind POSTs allowed in flight before the drain blocks on the
 #: oldest — the bounded hub<->scheduler bind pipeline (serving mode)
 MAX_INFLIGHT_BINDS = 2
+#: express-occupancy EWMA blend: old weight per sized cycle (0.8 keeps
+#: the signal hot ~3 cycles after an express burst drains)
+EXPRESS_EWMA_DECAY = 0.8
+#: EWMA of the express share of queue depth above which bulk caps take
+#: an extra shrink unit — express bands have been queueing recently,
+#: so the next arrival should not wait out a mega-batch commit
+EXPRESS_EWMA_HOT = 0.05
 
 
 class Scheduler:
@@ -59,7 +66,8 @@ class Scheduler:
                  min_batch: int = MIN_ADAPTIVE_BATCH,
                  lane_priority: int = DEFAULT_LANE_PRIORITY,
                  max_inflight_binds: int = MAX_INFLIGHT_BINDS,
-                 tracer=None):
+                 tracer=None,
+                 speculative: Optional[bool] = None):
         from .framework import Framework
         from .metrics import SchedulerMetrics
         self.metrics = metrics if metrics is not None else SchedulerMetrics()
@@ -143,6 +151,14 @@ class Scheduler:
         #: the serving smoke asserts caps are monotone in depth off this
         from collections import deque as _dq
         self.batch_cap_log = _dq(maxlen=4096)
+        #: preemption_attempts counter value at the last sized cycle —
+        #: a delta between cycles marks live capacity contention, which
+        #: adds one unit of bulk-cap pressure (see _drain_cap)
+        self._preempt_seen = 0.0
+        #: EWMA of the express-band share of queue depth (BandCatalog
+        #: occupancy: lane_priority is the lowest express band's floor,
+        #: so drain_stats' lane count IS the express-band occupancy)
+        self._express_ewma = 0.0
         #: bulk-bind POSTs currently in flight (binder threads); beyond
         #: max_inflight_binds the drain BLOCKS on the oldest instead of
         #: queueing unboundedly — and the count is the backpressure
@@ -171,6 +187,11 @@ class Scheduler:
             extenders=self.extenders, mesh=mesh)
         #: in-scan fallback counters (scheduler_topo_inscan_fallbacks_total)
         self.algorithm.sched_metrics = self.metrics
+        # speculative cohort assignment (kernels/speculative.py): the
+        # constructor argument overrides KTPU_SPECULATIVE (which the
+        # BatchScheduler read at construction) — explicit beats ambient
+        if speculative is not None:
+            self.algorithm.speculative = bool(speculative)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._in_flight = 0  # pods popped but not yet decided this cycle
@@ -539,7 +560,16 @@ class Scheduler:
             16k batch's tail (an all-priority queue is one big express
             cohort — sized by its depth, never split by pressure);
           - each unit of bind/commit backpressure halves a bulk cap
-            (never an express cap — urgency wins over pacing)."""
+            (never an express cap — urgency wins over pacing);
+          - a preemption_attempts delta since the last sized cycle adds
+            one pressure unit (live capacity contention: victims'
+            evictions and express retries should not queue behind a
+            mega-batch commit);
+          - an EWMA of the express-band occupancy share (lane depth /
+            queue depth, where lane_priority is the BandCatalog's lowest
+            express floor) above EXPRESS_EWMA_HOT adds one shrink unit
+            to BULK caps for a few cycles after an express burst — the
+            next express arrival pops behind a small bulk commit."""
         if not self.adaptive_batch:
             return self.batch_size
         depth, lane = self.queue.drain_stats(self.lane_priority)
@@ -552,7 +582,16 @@ class Scheduler:
             # next cycle sizes against the now-visible depth.
             return self.min_batch
         pressure = self._backpressure()
+        pa = self.metrics.preemption_attempts.value()
+        if pa > self._preempt_seen:
+            pressure += 1
+        self._preempt_seen = pa
+        self._express_ewma = (EXPRESS_EWMA_DECAY * self._express_ewma
+                              + (1.0 - EXPRESS_EWMA_DECAY)
+                              * (lane / depth))
         is_lane = lane > 0
+        if not is_lane and self._express_ewma > EXPRESS_EWMA_HOT:
+            pressure += 1
         cap = lane if is_lane else depth
         cap = 1 << max(0, cap - 1).bit_length()
         cap = max(self.min_batch, min(self.batch_size, cap))
